@@ -1,0 +1,26 @@
+"""parsec_tpu — a TPU-native task-DAG runtime.
+
+A ground-up rebuild of the capabilities of PaRSEC (the Parallel Runtime
+Scheduler and Execution Controller, reference: uiuc-hpc/parsec-1) designed
+for TPU hardware: applications are expressed as DAGs of micro-tasks with
+data-labeled dependency edges (parameterized task graphs or dynamic task
+discovery), tile kernels execute as XLA/Pallas programs on the MXU, tiles
+are staged into TPU HBM by the device layer, and dependency edges between
+ranks lower onto ICI/DCN collective schedules over a `jax.sharding.Mesh`
+instead of funnelled MPI.
+
+Layer map (mirrors reference SURVEY.md §1):
+  0. ``utils``/``containers`` — config registry, logging, concurrent containers
+  1. ``data``                 — Data/DataCopy coherency, arenas, repos, collections
+  2. ``core`` + ``sched``     — taskpools, dep-resolution engine, pluggable schedulers
+  3. ``comm``                 — comm-engine vtable, remote-dep protocol, bcast trees
+  4. ``device``               — device registry, TPU offload module
+  5. ``dsl``                  — PTG (parameterized task graph) and DTD front-ends
+  6. ``data`` collections     — tiled matrices, block-cyclic and friends
+  7. ``profiling``            — binary tracing, PINS instrumentation, DOT grapher
+  8. ``apps``                 — tiled Cholesky/QR/GEMM/stencil drivers
+"""
+
+__version__ = "0.1.0"
+
+from parsec_tpu.utils import mca  # noqa: F401

@@ -1,0 +1,122 @@
+"""Futures and datacopy futures.
+
+Rebuild of the reference's generic future + datacopy future
+(reference: parsec/class/parsec_future.{c,h}, parsec_datacopy_future.c):
+a thread-safe write-once cell with completion callbacks, and a specialized
+future carrying a data copy produced by a triggered "reshape"/transform
+callback — the primitive the reshape engine (layout conversion) is built on.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+
+class Future:
+    """Write-once future (reference: parsec_base_future_t).
+
+    ``set`` may be called exactly once; ``get`` blocks; callbacks registered
+    before or after completion all fire exactly once.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._done = False
+        self._value: Any = None
+        self._callbacks: List[Callable[[Any], None]] = []
+
+    def is_ready(self) -> bool:
+        with self._cond:
+            return self._done
+
+    def set(self, value: Any) -> None:
+        with self._cond:
+            if self._done:
+                raise RuntimeError("future already completed")
+            self._value = value
+            self._done = True
+            cbs, self._callbacks = self._callbacks, []
+            self._cond.notify_all()
+        for cb in cbs:
+            cb(value)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done, timeout=timeout):
+                raise TimeoutError("future wait timed out")
+            return self._value
+
+    def on_ready(self, cb: Callable[[Any], None]) -> None:
+        with self._cond:
+            if not self._done:
+                self._callbacks.append(cb)
+                return
+            value = self._value
+        cb(value)
+
+
+class CountdownFuture(Future):
+    """Completes after ``n`` contributions (used for quiescence joins)."""
+
+    def __init__(self, n: int, value: Any = None):
+        super().__init__()
+        self._remaining = n
+        self._final = value
+        if n == 0:
+            self.set(value)
+
+    def contribute(self) -> None:
+        fire = False
+        with self._cond:
+            self._remaining -= 1
+            if self._remaining == 0:
+                fire = True
+        if fire:
+            self.set(self._final)
+
+
+class DataCopyFuture(Future):
+    """Future of a data copy materialized on demand by a trigger.
+
+    Reference: parsec_datacopy_future_t — created with a trigger callback
+    that produces the target copy (e.g. a reshape/relayout) the first time a
+    consumer requests it; multiple consumers share the single result and the
+    future tracks how many still need it before the copy can be released.
+    """
+
+    def __init__(self, trigger: Callable[[Any], Any], spec: Any = None,
+                 nb_consumers: int = 1,
+                 cleanup: Optional[Callable[[Any], None]] = None):
+        super().__init__()
+        self._trigger = trigger
+        self.spec = spec
+        self._nb_consumers = nb_consumers
+        self._cleanup = cleanup
+        self._trigger_lock = threading.Lock()
+        self._triggered = False
+
+    def start(self) -> None:
+        """Fire the trigger once (idempotent)."""
+        with self._trigger_lock:
+            if self._triggered:
+                return
+            self._triggered = True
+        self.set(self._trigger(self.spec))
+
+    def get_copy(self) -> Any:
+        self.start()
+        return self.get()
+
+    def consume(self) -> None:
+        """One consumer is done with the produced copy; release on last.
+
+        If the trigger is still materializing (or fires later), cleanup is
+        deferred to completion via on_ready so the copy is never leaked.
+        """
+        with self._trigger_lock:
+            self._nb_consumers -= 1
+            last = self._nb_consumers == 0
+            triggered = self._triggered
+        if last and self._cleanup is not None and triggered:
+            self.on_ready(self._cleanup)

@@ -1,0 +1,183 @@
+"""Concurrent list/queue containers.
+
+Rebuild of the reference's lock-free containers
+(reference: parsec/class/{lifo,fifo,list,dequeue}.{c,h}) as thread-safe
+Python structures with the same API surface: LIFO, FIFO, Dequeue (push/pop at
+both ends), and an ordered List supporting priority-sorted insertion — the
+scheduler building blocks.  Items may be any object; priority ordering uses
+``item.priority`` (higher first) like parsec_list's task rings.
+
+A C++ backing (parsec_tpu/native) can replace these hot paths transparently;
+the semantics defined here are the contract.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Iterable, List, Optional
+
+
+class Lifo:
+    """LIFO stack (reference: parsec_lifo_t)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: List[Any] = []
+
+    def push(self, item: Any) -> None:
+        with self._lock:
+            self._items.append(item)
+
+    def push_chain(self, items: Iterable[Any]) -> None:
+        with self._lock:
+            self._items.extend(items)
+
+    def pop(self) -> Optional[Any]:
+        with self._lock:
+            return self._items.pop() if self._items else None
+
+    def try_pop(self) -> Optional[Any]:
+        return self.pop()
+
+    def is_empty(self) -> bool:
+        with self._lock:
+            return not self._items
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class Fifo:
+    """FIFO queue (reference: parsec_fifo_t)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: collections.deque = collections.deque()
+
+    def push(self, item: Any) -> None:
+        with self._lock:
+            self._items.append(item)
+
+    def push_chain(self, items: Iterable[Any]) -> None:
+        with self._lock:
+            self._items.extend(items)
+
+    def pop(self) -> Optional[Any]:
+        with self._lock:
+            return self._items.popleft() if self._items else None
+
+    try_pop = pop
+
+    def is_empty(self) -> bool:
+        with self._lock:
+            return not self._items
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class Dequeue:
+    """Double-ended queue (reference: parsec_dequeue_t).
+
+    Workers push back/pop back locally and steal from the front.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: collections.deque = collections.deque()
+
+    def push_back(self, item: Any) -> None:
+        with self._lock:
+            self._items.append(item)
+
+    def push_front(self, item: Any) -> None:
+        with self._lock:
+            self._items.appendleft(item)
+
+    def pop_back(self) -> Optional[Any]:
+        with self._lock:
+            return self._items.pop() if self._items else None
+
+    def pop_front(self) -> Optional[Any]:
+        with self._lock:
+            return self._items.popleft() if self._items else None
+
+    def chain_back(self, items: Iterable[Any]) -> None:
+        with self._lock:
+            self._items.extend(items)
+
+    def chain_front(self, items: Iterable[Any]) -> None:
+        # extendleft inserts one-by-one; reverse first to splice in order.
+        with self._lock:
+            self._items.extendleft(reversed(list(items)))
+
+    def is_empty(self) -> bool:
+        with self._lock:
+            return not self._items
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+def _prio(item: Any) -> int:
+    return getattr(item, "priority", 0) or 0
+
+
+class OrderedList:
+    """Priority-ordered list (reference: parsec_list_t with sorted insertion).
+
+    Highest priority pops first; FIFO among equal priorities.  Like the
+    reference, sorted insertion scans for the first lower-priority item, so
+    mixing sorted and unsorted pushes stays locally correct.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: List[Any] = []
+
+    def push_sorted(self, item: Any) -> None:
+        with self._lock:
+            p = _prio(item)
+            for idx, other in enumerate(self._items):
+                if _prio(other) < p:
+                    self._items.insert(idx, item)
+                    return
+            self._items.append(item)
+
+    def chain_sorted(self, items: Iterable[Any]) -> None:
+        for it in items:
+            self.push_sorted(it)
+
+    def pop_front(self) -> Optional[Any]:
+        with self._lock:
+            return self._items.pop(0) if self._items else None
+
+    def push_front(self, item: Any) -> None:
+        with self._lock:
+            self._items.insert(0, item)
+
+    def push_back(self, item: Any) -> None:
+        with self._lock:
+            self._items.append(item)
+
+    def pop_back(self) -> Optional[Any]:
+        with self._lock:
+            return self._items.pop() if self._items else None
+
+    def is_empty(self) -> bool:
+        with self._lock:
+            return not self._items
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+def ring_from(items: Iterable[Any]) -> List[Any]:
+    """The reference threads ready tasks into 'rings' (parsec_list_item_ring);
+    here a plain list is the ring representation used across the engine."""
+    return list(items)

@@ -1,0 +1,298 @@
+"""MCA-style parameter system.
+
+Rebuild of the reference's Modular Component Architecture parameter registry
+(reference: parsec/utils/mca_param.c, mca_param.h): typed, hierarchically named
+parameters ``<framework>_<component>_<param>`` sourced with the precedence
+
+    registered default  <  keyval files  <  environment (PARSEC_MCA_<name>)
+                        <  explicit/command-line (--mca <name> <value>)
+
+and introspectable at runtime.  Component-selection strings (e.g.
+``--mca sched lfq`` or ``--mca device_tpu_enabled 0``) drive module selection
+exactly like the reference's MCA repository (parsec/mca/mca_repository.c).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_PREFIX = "PARSEC_MCA_"
+
+# Source precedence, low to high (reference: mca_param.c lookup order).
+SRC_DEFAULT = 0
+SRC_FILE = 1
+SRC_ENV = 2
+SRC_OVERRIDE = 3
+
+_SRC_NAMES = {SRC_DEFAULT: "default", SRC_FILE: "file", SRC_ENV: "env",
+              SRC_OVERRIDE: "override"}
+
+
+@dataclass
+class _Param:
+    name: str
+    type_: type
+    default: Any
+    help: str = ""
+    read_only: bool = False
+    # values[src] = raw value from that source (already coerced)
+    values: Dict[int, Any] = field(default_factory=dict)
+
+    def current(self):
+        for src in (SRC_OVERRIDE, SRC_ENV, SRC_FILE):
+            if src in self.values:
+                return self.values[src], src
+        return self.default, SRC_DEFAULT
+
+
+def _coerce(type_: type, raw: Any) -> Any:
+    if isinstance(raw, type_) and not (type_ is int and isinstance(raw, bool)):
+        return raw
+    if type_ is bool:
+        if isinstance(raw, str):
+            return raw.strip().lower() in ("1", "true", "yes", "on", "y")
+        return bool(raw)
+    if type_ is int:
+        if isinstance(raw, (bool, int, float)):
+            return int(raw)
+        s = str(raw).strip()
+        try:
+            return int(s, 0)       # accept 0x.., 0o.. forms
+        except ValueError:
+            return int(s, 10)      # base-0 rejects zero-padded decimals
+    if type_ is float:
+        return float(raw)
+    return str(raw)
+
+
+class ParamRegistry:
+    """Process-wide named-parameter registry."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._params: Dict[str, _Param] = {}
+        self._pending: Dict[str, Any] = {}   # set before registration
+        self._pending_src: Dict[str, int] = {}
+        self._watchers: Dict[str, List[Callable[[str, Any], None]]] = {}
+
+    # -- registration ----------------------------------------------------
+    def register(self, name: str, default: Any, help: str = "",
+                 type_: Optional[type] = None, read_only: bool = False) -> str:
+        """Register a parameter; returns its full name.
+
+        Mirrors parsec_mca_param_reg_{int,string}_name: registering an
+        already-registered name updates help text but keeps existing values.
+        """
+        if type_ is None:
+            type_ = bool if isinstance(default, bool) else type(default)
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                p = _Param(name=name, type_=type_, default=default, help=help,
+                           read_only=read_only)
+                self._params[name] = p
+                if read_only:
+                    # Immutable params ignore env/pending overrides entirely.
+                    self._pending.pop(name, None)
+                    self._pending_src.pop(name, None)
+                else:
+                    env_raw = os.environ.get(ENV_PREFIX + name.upper(),
+                                             os.environ.get(ENV_PREFIX + name))
+                    if env_raw is not None:
+                        p.values[SRC_ENV] = _coerce(type_, env_raw)
+                    if name in self._pending:
+                        src = self._pending_src.pop(name, SRC_OVERRIDE)
+                        p.values[src] = _coerce(type_, self._pending.pop(name))
+            else:
+                if help:
+                    p.help = help
+            return name
+
+    def reg_int(self, framework: str, component: str, param: str,
+                default: int, help: str = "") -> str:
+        return self.register(_join(framework, component, param), int(default), help)
+
+    def reg_str(self, framework: str, component: str, param: str,
+                default: str, help: str = "") -> str:
+        return self.register(_join(framework, component, param), str(default), help)
+
+    def reg_bool(self, framework: str, component: str, param: str,
+                 default: bool, help: str = "") -> str:
+        return self.register(_join(framework, component, param), bool(default),
+                             help, type_=bool)
+
+    # -- lookup ----------------------------------------------------------
+    def get(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                if name in self._pending:
+                    return self._pending[name]
+                env_raw = os.environ.get(ENV_PREFIX + name.upper())
+                if env_raw is not None:
+                    return env_raw
+                return default
+            return p.current()[0]
+
+    def source_of(self, name: str) -> str:
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                return "unregistered"
+            return _SRC_NAMES[p.current()[1]]
+
+    # -- mutation --------------------------------------------------------
+    def set(self, name: str, value: Any, src: int = SRC_OVERRIDE) -> None:
+        """Set a parameter (``--mca name value``)."""
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                self._pending[name] = value
+                self._pending_src[name] = src
+                return
+            if p.read_only:
+                raise ValueError(f"MCA param {name!r} is read-only")
+            p.values[src] = _coerce(p.type_, value)
+            for cb in self._watchers.get(name, ()):
+                cb(name, p.current()[0])
+
+    def unset(self, name: str, src: int = SRC_OVERRIDE) -> None:
+        with self._lock:
+            p = self._params.get(name)
+            if p is not None:
+                p.values.pop(src, None)
+
+    def watch(self, name: str, cb: Callable[[str, Any], None]) -> None:
+        with self._lock:
+            self._watchers.setdefault(name, []).append(cb)
+
+    # -- files / CLI -----------------------------------------------------
+    def load_keyval_file(self, path: str) -> int:
+        """Load ``name = value`` lines (reference: utils/keyval_parse.c)."""
+        n = 0
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                if "=" in line:
+                    k, v = line.split("=", 1)
+                elif " " in line:
+                    k, v = line.split(None, 1)
+                else:
+                    continue
+                self.set(k.strip(), v.strip().strip('"'), src=SRC_FILE)
+                n += 1
+        return n
+
+    def parse_cmdline(self, argv: List[str]) -> List[str]:
+        """Consume ``--mca <name> <value>`` pairs; return remaining argv.
+
+        Reference: utils/mca_param_cmd_line.c.
+        """
+        out: List[str] = []
+        i = 0
+        while i < len(argv):
+            a = argv[i]
+            if a == "--mca":
+                if i + 2 > len(argv) - 1:
+                    raise ValueError("--mca requires <name> <value>")
+                self.set(argv[i + 1], argv[i + 2])
+                i += 3
+            elif a.startswith("--mca="):
+                kv = a[len("--mca="):]
+                k, v = kv.split("=", 1)
+                self.set(k, v)
+                i += 1
+            else:
+                out.append(a)
+                i += 1
+        return out
+
+    # -- introspection ---------------------------------------------------
+    def dump(self) -> List[str]:
+        """Human-readable dump (reference: parsec_mca_show_mca_params)."""
+        with self._lock:
+            lines = []
+            for name in sorted(self._params):
+                p = self._params[name]
+                val, src = p.current()
+                lines.append(f"{name}={val!r} (source: {_SRC_NAMES[src]}) # {p.help}")
+            return lines
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._params)
+
+
+def _join(framework: str, component: str, param: str) -> str:
+    return "_".join(x for x in (framework, component, param) if x)
+
+
+#: The process-global registry (reference keeps one global table too).
+params = ParamRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Component repository: open/select modules by framework+name
+# (reference: parsec/mca/mca.h + mca_repository.c)
+# ---------------------------------------------------------------------------
+
+class ComponentRepository:
+    """Static registry of pluggable components per framework.
+
+    Frameworks: ``sched``, ``device``, ``termdet``, ``pins``, ``comm``.
+    Selection honors the MCA string param ``<framework>`` — a comma-separated
+    preference list, or a single name; empty means "best available by
+    priority".
+    """
+
+    def __init__(self, registry: ParamRegistry):
+        self._registry = registry
+        self._components: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, framework: str, name: str, component: Any,
+            priority: int = 0) -> None:
+        with self._lock:
+            self._components.setdefault(framework, {})[name] = (priority, component)
+        self._registry.register(framework, "", f"component selection for {framework}")
+
+    def get(self, framework: str, name: str) -> Any:
+        with self._lock:
+            entry = self._components.get(framework, {}).get(name)
+        if entry is None:
+            raise KeyError(f"no MCA component {framework!r}/{name!r}")
+        return entry[1]
+
+    def available(self, framework: str) -> List[str]:
+        with self._lock:
+            comps = self._components.get(framework, {})
+            return [n for n, _ in sorted(comps.items(),
+                                         key=lambda kv: -kv[1][0])]
+
+    def select(self, framework: str, requested: Optional[str] = None) -> Any:
+        """Pick a component: explicit request > MCA param > highest priority."""
+        if requested is None:
+            requested = self._registry.get(framework, "")
+        if requested:
+            for name in str(requested).split(","):
+                name = name.strip()
+                try:
+                    return name, self.get(framework, name)
+                except KeyError:
+                    continue
+            raise KeyError(
+                f"no usable component in {framework!r} from {requested!r}; "
+                f"available: {self.available(framework)}")
+        names = self.available(framework)
+        if not names:
+            raise KeyError(f"no components registered for {framework!r}")
+        return names[0], self.get(framework, names[0])
+
+
+#: Process-global component repository.
+components = ComponentRepository(params)

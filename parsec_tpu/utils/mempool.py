@@ -1,0 +1,42 @@
+"""Thread-local object pools.
+
+Reference: parsec/mempool.{c,h} — per-thread freelists of task/repo/dep
+objects to avoid allocator contention on the hot path.  In Python the win is
+reduced GC churn for Task records; the native core uses real arenas.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List
+
+
+class MemoryPool:
+    """Per-thread freelist of reusable objects (parsec_mempool_t)."""
+
+    def __init__(self, factory: Callable[[], Any],
+                 reset: Callable[[Any], None] = None, max_cached: int = 4096):
+        self._factory = factory
+        self._reset = reset
+        self._max = max_cached
+        self._tls = threading.local()
+
+    def _free_list(self) -> List[Any]:
+        fl = getattr(self._tls, "free", None)
+        if fl is None:
+            fl = []
+            self._tls.free = fl
+        return fl
+
+    def alloc(self) -> Any:
+        fl = self._free_list()
+        if fl:
+            return fl.pop()
+        return self._factory()
+
+    def release(self, obj: Any) -> None:
+        if self._reset is not None:
+            self._reset(obj)
+        fl = self._free_list()
+        if len(fl) < self._max:
+            fl.append(obj)

@@ -1,0 +1,168 @@
+"""Leveled, multiplexed output/debug streams.
+
+Rebuild of the reference's output and debug facilities
+(reference: parsec/utils/output.c, parsec/utils/debug.c, utils/colors.c):
+numbered output streams with independent verbosity, optional color, optional
+per-stream files, plus the ``fatal`` / ``warning`` / ``inform`` /
+``debug_verbose`` entry points.  Verbosity is driven by MCA params
+(``debug_verbose``, ``debug_color``) so ``--mca debug_verbose 10`` works like
+the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, TextIO
+
+from parsec_tpu.utils.mca import params
+
+params.register("debug_verbose", 1, "global debug verbosity (0=errors only)")
+params.register("debug_color", True, "colorize terminal output")
+params.register("debug_history", 64, "debug-mark ring buffer size (0=off)")
+
+_COLORS = {
+    "fatal": "\x1b[1;31m", "warning": "\x1b[33m", "inform": "\x1b[36m",
+    "debug": "\x1b[2m", "reset": "\x1b[0m",
+}
+
+
+@dataclass
+class OutputStream:
+    """One multiplexed output stream (reference: parsec_output_stream_t)."""
+    stream_id: int
+    prefix: str = ""
+    verbosity: int = 1
+    file: Optional[TextIO] = None
+    want_stderr: bool = True
+
+    def close(self):
+        if self.file is not None and self.file not in (sys.stdout, sys.stderr):
+            self.file.close()
+            self.file = None
+
+
+class Output:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._streams: Dict[int, OutputStream] = {
+            0: OutputStream(stream_id=0, verbosity=params.get("debug_verbose", 1))
+        }
+        self._next_id = 1
+        self.rank = 0  # stamped by the comm layer at init
+
+    # -- stream management (parsec_output_open/close/set_verbosity) ------
+    def open(self, prefix: str = "", verbosity: int = 1,
+             filename: Optional[str] = None) -> int:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            f = open(filename, "a") if filename else None
+            self._streams[sid] = OutputStream(stream_id=sid, prefix=prefix,
+                                              verbosity=verbosity, file=f)
+            return sid
+
+    def close(self, sid: int) -> None:
+        with self._lock:
+            s = self._streams.pop(sid, None)
+        if s:
+            s.close()
+
+    def set_verbosity(self, sid: int, level: int) -> None:
+        with self._lock:
+            if sid in self._streams:
+                self._streams[sid].verbosity = level
+
+    def get_verbosity(self, sid: int) -> int:
+        with self._lock:
+            s = self._streams.get(sid)
+            return s.verbosity if s else -1
+
+    # -- emit ------------------------------------------------------------
+    def emit(self, sid: int, level: int, kind: str, msg: str) -> None:
+        with self._lock:
+            s = self._streams.get(sid) or self._streams[0]
+            if level > s.verbosity:
+                return
+            target = s.file if s.file else (sys.stderr if s.want_stderr else sys.stdout)
+            use_color = (params.get("debug_color", True)
+                         and getattr(target, "isatty", lambda: False)())
+            c0 = _COLORS.get(kind, "") if use_color else ""
+            c1 = _COLORS["reset"] if use_color and c0 else ""
+            stamp = time.strftime("%H:%M:%S")
+            line = (f"{c0}[{stamp}][R{self.rank}]"
+                    f"{('[' + s.prefix + ']') if s.prefix else ''}"
+                    f"[{kind[0].upper()}] {msg}{c1}\n")
+            target.write(line)
+            target.flush()
+        _history.record(kind, msg)
+
+
+output = Output()
+
+
+# ---------------------------------------------------------------------------
+# Debug-history ring buffer (reference: parsec/utils/debug_marks, debug.c
+# PARSEC_DEBUG_HISTORY) — cheap always-on marks dumpable post-mortem.
+# ---------------------------------------------------------------------------
+
+class _DebugHistory:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = []
+        self._pos = 0
+
+    def record(self, kind: str, msg: str) -> None:
+        size = params.get("debug_history", 64)
+        if not size:
+            return
+        with self._lock:
+            entry = (time.time(), threading.get_ident(), kind, msg)
+            if len(self._ring) < size:
+                self._ring.append(entry)
+            else:
+                self._ring[self._pos % size] = entry
+            self._pos += 1
+
+    def mark(self, msg: str) -> None:
+        self.record("mark", msg)
+
+    def dump(self) -> list:
+        with self._lock:
+            size = len(self._ring)
+            if size == 0:
+                return []
+            start = self._pos % size if self._pos > size else 0
+            return self._ring[start:] + self._ring[:start]
+
+
+_history = _DebugHistory()
+debug_history = _history
+
+
+# -- reference-style entry points -------------------------------------------
+
+class FatalError(RuntimeError):
+    pass
+
+
+def fatal(msg: str, *args) -> None:
+    """parsec_fatal: unrecoverable — raises instead of abort()."""
+    m = msg % args if args else msg
+    output.emit(0, 0, "fatal", m)
+    raise FatalError(m)
+
+
+def warning(msg: str, *args) -> None:
+    output.emit(0, 0, "warning", msg % args if args else msg)
+
+
+def inform(msg: str, *args) -> None:
+    output.emit(0, 1, "inform", msg % args if args else msg)
+
+
+def debug_verbose(level: int, msg: str, *args, stream: int = 0) -> None:
+    output.emit(stream, level, "debug", msg % args if args else msg)

@@ -1,0 +1,76 @@
+"""Zone (segment) allocator over a pre-reserved slab.
+
+Rebuild of the reference's GPU-memory segment allocator
+(reference: parsec/utils/zone_malloc.{c,h}): first-fit allocation of
+fixed-unit segments from one contiguous zone with coalescing free.  On TPU
+the "zone" is an HBM byte budget managed by the device module — XLA owns
+physical allocation, so this tracks segments logically to drive LRU eviction
+decisions exactly where the reference drove cudaMalloc'd slabs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class ZoneAllocator:
+    def __init__(self, total_bytes: int, unit_bytes: int = 512):
+        if total_bytes <= 0 or unit_bytes <= 0:
+            raise ValueError("zone size and unit must be positive")
+        if total_bytes < unit_bytes:
+            raise ValueError("zone smaller than one allocation unit")
+        self.unit = unit_bytes
+        self.nb_units = total_bytes // unit_bytes
+        self._lock = threading.Lock()
+        # segments: start_unit -> (nb_units, free?)
+        self._segs: Dict[int, list] = {0: [self.nb_units, True]}
+
+    def malloc(self, nbytes: int) -> Optional[int]:
+        """Allocate; returns logical offset in bytes, or None if no room."""
+        units = max(1, -(-nbytes // self.unit))
+        with self._lock:
+            for start in sorted(self._segs):
+                n, free = self._segs[start]
+                if free and n >= units:
+                    if n > units:
+                        self._segs[start + units] = [n - units, True]
+                    self._segs[start] = [units, False]
+                    return start * self.unit
+            return None
+
+    def free(self, offset: int) -> None:
+        start = offset // self.unit
+        with self._lock:
+            seg = self._segs.get(start)
+            if seg is None or seg[1]:
+                raise ValueError(f"bad free at offset {offset}")
+            seg[1] = True
+            self._coalesce()
+
+    def _coalesce(self) -> None:
+        starts = sorted(self._segs)
+        i = 0
+        while i < len(starts) - 1:
+            s, nxt = starts[i], starts[i + 1]
+            n, free = self._segs[s]
+            n2, free2 = self._segs[nxt]
+            if free and free2 and s + n == nxt:
+                self._segs[s] = [n + n2, True]
+                del self._segs[nxt]
+                starts.pop(i + 1)
+            else:
+                i += 1
+
+    def free_bytes(self) -> int:
+        with self._lock:
+            return sum(n for n, free in self._segs.values() if free) * self.unit
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return sum(n for n, free in self._segs.values() if not free) * self.unit
+
+    def check_defrag(self) -> bool:
+        """True if completely free (reference: zone_debug consistency)."""
+        with self._lock:
+            return len(self._segs) == 1 and self._segs.get(0, [0, False])[1]

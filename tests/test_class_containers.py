@@ -1,0 +1,283 @@
+"""Unit + multithreaded stress tests of the base containers.
+
+Mirrors the reference's tests/class/{lifo,list,hash,atomics,rwlock,future,
+future_datacopy}.c pyramid (SURVEY.md §4).
+"""
+
+import threading
+
+import pytest
+
+from parsec_tpu.containers.lists import Dequeue, Fifo, Lifo, OrderedList
+from parsec_tpu.containers.hash_table import ConcurrentHashTable
+from parsec_tpu.containers.futures import CountdownFuture, DataCopyFuture, Future
+from parsec_tpu.containers.sync import AtomicCounter, Barrier, RWLock
+
+NTHREADS = 8
+NITEMS = 2000
+
+
+def run_threads(n, fn):
+    threads = [threading.Thread(target=fn, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class Item:
+    def __init__(self, value, priority=0):
+        self.value = value
+        self.priority = priority
+
+
+def test_lifo_order():
+    s = Lifo()
+    for i in range(10):
+        s.push(i)
+    assert [s.pop() for _ in range(10)] == list(range(9, -1, -1))
+    assert s.pop() is None
+    assert s.is_empty()
+
+
+def test_fifo_order():
+    q = Fifo()
+    q.push_chain(range(10))
+    assert [q.pop() for _ in range(10)] == list(range(10))
+    assert q.pop() is None
+
+
+def test_dequeue_both_ends():
+    d = Dequeue()
+    d.push_back(1)
+    d.push_front(0)
+    d.push_back(2)
+    assert d.pop_front() == 0
+    assert d.pop_back() == 2
+    assert d.pop_back() == 1
+    assert d.pop_back() is None
+
+
+def test_ordered_list_priority():
+    lst = OrderedList()
+    lst.push_sorted(Item("lo", 1))
+    lst.push_sorted(Item("hi", 10))
+    lst.push_sorted(Item("mid", 5))
+    assert lst.pop_front().value == "hi"
+    assert lst.pop_front().value == "mid"
+    assert lst.pop_front().value == "lo"
+
+
+@pytest.mark.parametrize("cls", [Lifo, Fifo])
+def test_queue_mt_stress(cls):
+    """Every pushed item is popped exactly once (reference tests/class/lifo.c)."""
+    q = cls()
+    seen = [set() for _ in range(NTHREADS)]
+
+    def worker(tid):
+        for i in range(NITEMS):
+            q.push((tid, i))
+        got = None
+        while True:
+            got = q.pop()
+            if got is None:
+                break
+            seen[tid].add(got)
+
+    run_threads(NTHREADS, worker)
+    # drain leftovers
+    while True:
+        got = q.pop()
+        if got is None:
+            break
+        seen[0].add(got)
+    all_seen = set().union(*seen)
+    assert len(all_seen) == NTHREADS * NITEMS
+
+
+def test_hash_table_basics():
+    h = ConcurrentHashTable()
+    h.insert(("tc", 1, 2), "v")
+    assert h.find(("tc", 1, 2)) == "v"
+    assert ("tc", 1, 2) in h
+    assert h.remove(("tc", 1, 2)) == "v"
+    assert h.find(("tc", 1, 2)) is None
+    v, ins = h.find_or_insert("k", lambda: [0])
+    assert ins and v == [0]
+    v2, ins2 = h.find_or_insert("k", lambda: [1])
+    assert not ins2 and v2 is v
+
+
+def test_hash_table_mt(n=NTHREADS):
+    """Concurrent find_or_insert yields exactly one value per key."""
+    h = ConcurrentHashTable()
+    winners = [[] for _ in range(n)]
+
+    def worker(tid):
+        for i in range(NITEMS):
+            v, ins = h.find_or_insert(i % 101, lambda: object())
+            winners[tid].append(v)
+
+    run_threads(n, worker)
+    # all threads must agree on the value for each key
+    for i in range(101):
+        agreed = {winners[t][j] for t in range(n)
+                  for j in range(i, NITEMS, 101)}
+        assert len(agreed) == 1
+
+
+def test_hash_update_locked():
+    h = ConcurrentHashTable()
+
+    def worker(tid):
+        for _ in range(NITEMS):
+            h.update_locked("ctr", lambda v: v + 1, default=0)
+
+    run_threads(NTHREADS, worker)
+    assert h.find("ctr") == NTHREADS * NITEMS
+
+
+def test_atomic_counter_mt():
+    c = AtomicCounter()
+
+    def worker(tid):
+        for _ in range(NITEMS):
+            c.add_and_fetch(1)
+
+    run_threads(NTHREADS, worker)
+    assert c.value == NTHREADS * NITEMS
+    assert c.cas(c.value, 0)
+    assert not c.cas(123456, 1)
+    assert c.value == 0
+
+
+def test_future_basic_and_callbacks():
+    f = Future()
+    hits = []
+    f.on_ready(hits.append)
+    assert not f.is_ready()
+    f.set(42)
+    assert f.is_ready() and f.get() == 42
+    f.on_ready(hits.append)  # post-completion callback fires immediately
+    assert hits == [42, 42]
+    with pytest.raises(RuntimeError):
+        f.set(1)
+
+
+def test_future_blocking_get():
+    f = Future()
+
+    def setter():
+        f.set("done")
+
+    t = threading.Timer(0.05, setter)
+    t.start()
+    assert f.get(timeout=5) == "done"
+    t.join()
+
+
+def test_countdown_future():
+    f = CountdownFuture(3, "fin")
+    f.contribute(); f.contribute()
+    assert not f.is_ready()
+    f.contribute()
+    assert f.get() == "fin"
+
+
+def test_datacopy_future_triggers_once():
+    """Reference tests/class/future_datacopy.c: one materialization, shared."""
+    calls = []
+    fut = DataCopyFuture(trigger=lambda spec: calls.append(spec) or spec * 2,
+                         spec=21, nb_consumers=NTHREADS)
+    results = []
+    lock = threading.Lock()
+
+    def worker(tid):
+        v = fut.get_copy()
+        with lock:
+            results.append(v)
+        fut.consume()
+
+    run_threads(NTHREADS, worker)
+    assert calls == [21]
+    assert results == [42] * NTHREADS
+
+
+def test_datacopy_future_cleanup_on_last_consumer():
+    released = []
+    fut = DataCopyFuture(trigger=lambda s: "copy", nb_consumers=2,
+                         cleanup=released.append)
+    assert fut.get_copy() == "copy"
+    fut.consume()
+    assert released == []
+    fut.consume()
+    assert released == ["copy"]
+
+
+def test_rwlock():
+    rw = RWLock()
+    state = {"readers": 0, "max_readers": 0, "writes": 0}
+    mx = threading.Lock()
+
+    def reader(tid):
+        for _ in range(200):
+            with rw.read():
+                with mx:
+                    state["readers"] += 1
+                    state["max_readers"] = max(state["max_readers"],
+                                               state["readers"])
+                with mx:
+                    state["readers"] -= 1
+
+    def writer(tid):
+        for _ in range(50):
+            with rw.write():
+                assert state["readers"] == 0
+                state["writes"] += 1
+
+    threads = ([threading.Thread(target=reader, args=(i,)) for i in range(4)]
+               + [threading.Thread(target=writer, args=(i,)) for i in range(2)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert state["writes"] == 100
+    assert state["max_readers"] >= 1
+
+
+def test_barrier():
+    b = Barrier(NTHREADS)
+    order = []
+    lock = threading.Lock()
+
+    def worker(tid):
+        with lock:
+            order.append(("pre", tid))
+        b.wait()
+        with lock:
+            order.append(("post", tid))
+
+    run_threads(NTHREADS, worker)
+    pres = [i for i, (p, _) in enumerate(order) if p == "pre"]
+    posts = [i for i, (p, _) in enumerate(order) if p == "post"]
+    assert max(pres) < min(posts)
+
+
+def test_dequeue_chain_front_preserves_order():
+    d = Dequeue()
+    d.push_back("tail")
+    d.chain_front(["a", "b", "c"])
+    assert d.pop_front() == "a"
+    assert d.pop_front() == "b"
+    assert d.pop_front() == "c"
+    assert d.pop_front() == "tail"
+
+
+def test_ordered_list_mixed_modes_no_inversion():
+    lst = OrderedList()
+    lst.push_back(Item("p10", 10))
+    lst.push_front(Item("p1", 1))
+    lst.push_sorted(Item("p5", 5))
+    # sorted insertion lands before the first lower-priority item
+    vals = [lst.pop_front().value for _ in range(3)]
+    assert vals.index("p5") < vals.index("p1")

@@ -1,0 +1,177 @@
+"""Tests of the MCA parameter system, component repository, zone allocator,
+mempool, and output streams (reference: utils/mca_param.c behavior)."""
+
+import os
+
+import pytest
+
+from parsec_tpu.utils.mca import (SRC_ENV, SRC_FILE, ComponentRepository,
+                                  ParamRegistry)
+from parsec_tpu.utils.mempool import MemoryPool
+from parsec_tpu.utils.output import Output, FatalError, fatal
+from parsec_tpu.utils.zone_alloc import ZoneAllocator
+
+
+def test_param_register_and_default():
+    r = ParamRegistry()
+    r.register("sched_lfq_queue_size", 4, "queue size")
+    assert r.get("sched_lfq_queue_size") == 4
+    assert r.source_of("sched_lfq_queue_size") == "default"
+
+
+def test_param_precedence_env_over_file():
+    r = ParamRegistry()
+    os.environ["PARSEC_MCA_TEST_PRECEDENCE"] = "7"
+    try:
+        r.register("test_precedence", 1)
+        assert r.get("test_precedence") == 7
+        assert r.source_of("test_precedence") == "env"
+        r.set("test_precedence", 3, src=SRC_FILE)
+        assert r.get("test_precedence") == 7  # env beats file
+        r.set("test_precedence", 9)  # override beats env
+        assert r.get("test_precedence") == 9
+        assert r.source_of("test_precedence") == "override"
+    finally:
+        del os.environ["PARSEC_MCA_TEST_PRECEDENCE"]
+
+
+def test_param_set_before_register():
+    r = ParamRegistry()
+    r.set("late_param", "5")
+    r.register("late_param", 0)
+    assert r.get("late_param") == 5  # coerced to registered int type
+
+
+def test_param_type_coercion_bool():
+    r = ParamRegistry()
+    r.register("device_tpu_enabled", True)
+    r.set("device_tpu_enabled", "0")
+    assert r.get("device_tpu_enabled") is False
+    r.set("device_tpu_enabled", "yes")
+    assert r.get("device_tpu_enabled") is True
+
+
+def test_param_cmdline_and_dump():
+    r = ParamRegistry()
+    r.register("sched", "", "scheduler selection")
+    rest = r.parse_cmdline(["prog", "--mca", "sched", "spq", "positional"])
+    assert rest == ["prog", "positional"]
+    assert r.get("sched") == "spq"
+    assert any("sched" in line for line in r.dump())
+    with pytest.raises(ValueError):
+        r.parse_cmdline(["--mca", "sched"])
+
+
+def test_param_keyval_file(tmp_path):
+    r = ParamRegistry()
+    f = tmp_path / "mca.conf"
+    f.write_text("# comment\nsched = lfq\ndebug_verbose 5\n")
+    assert r.load_keyval_file(str(f)) == 2
+    r.register("sched", "")
+    r.register("debug_verbose", 1)
+    assert r.get("sched") == "lfq"
+    assert r.get("debug_verbose") == 5
+    assert r.source_of("sched") == "file"
+
+
+def test_component_repository_selection():
+    r = ParamRegistry()
+    repo = ComponentRepository(r)
+    repo.add("sched", "gd", "GD", priority=10)
+    repo.add("sched", "lfq", "LFQ", priority=50)
+    assert repo.available("sched") == ["lfq", "gd"]
+    name, comp = repo.select("sched")
+    assert (name, comp) == ("lfq", "LFQ")  # highest priority wins
+    r.set("sched", "gd")
+    name, comp = repo.select("sched")
+    assert (name, comp) == ("gd", "GD")
+    name, comp = repo.select("sched", requested="nope,lfq")
+    assert name == "lfq"  # preference list skips unknown
+    with pytest.raises(KeyError):
+        repo.select("sched", requested="missing")
+
+
+def test_zone_allocator():
+    z = ZoneAllocator(1024, unit_bytes=64)
+    a = z.malloc(100)   # 2 units
+    b = z.malloc(64)    # 1 unit
+    assert a == 0 and b == 128
+    assert z.used_bytes() == 192
+    z.free(a)
+    c = z.malloc(128)   # reuses the coalesced hole at 0
+    assert c == 0
+    z.free(b)
+    z.free(c)
+    assert z.check_defrag()
+    assert z.malloc(2048) is None  # larger than zone
+    with pytest.raises(ValueError):
+        z.free(64)  # not a live segment start
+
+
+def test_mempool_reuse():
+    made = []
+    pool = MemoryPool(factory=lambda: made.append(1) or {"x": 0},
+                      reset=lambda o: o.update(x=0))
+    o1 = pool.alloc()
+    o1["x"] = 5
+    pool.release(o1)
+    o2 = pool.alloc()
+    assert o2 is o1 and o2["x"] == 0
+    assert len(made) == 1
+
+
+def test_output_streams(tmp_path, capsys):
+    out = Output()
+    logfile = tmp_path / "stream.log"
+    sid = out.open(prefix="comm", verbosity=2, filename=str(logfile))
+    out.emit(sid, 1, "inform", "hello")
+    out.emit(sid, 9, "debug", "too verbose, dropped")
+    out.set_verbosity(sid, 9)
+    assert out.get_verbosity(sid) == 9
+    out.emit(sid, 9, "debug", "now visible")
+    out.close(sid)
+    text = logfile.read_text()
+    assert "hello" in text and "now visible" in text
+    assert "dropped" not in text
+
+
+def test_fatal_raises():
+    with pytest.raises(FatalError):
+        fatal("boom %d", 7)
+
+
+def test_param_read_only_ignores_pending_and_env():
+    os.environ["PARSEC_MCA_COMM_RANK"] = "99"
+    try:
+        r = ParamRegistry()
+        r.set("comm_rank", 50)  # pre-registration override attempt
+        r.register("comm_rank", 0, read_only=True)
+        assert r.get("comm_rank") == 0
+        with pytest.raises(ValueError):
+            r.set("comm_rank", 7)
+    finally:
+        del os.environ["PARSEC_MCA_COMM_RANK"]
+
+
+def test_param_int_coercion_edge_cases():
+    r = ParamRegistry()
+    r.register("n_threads", 1)
+    r.set("n_threads", "010")
+    assert r.get("n_threads") == 10
+    r.set("n_threads", "0x10")
+    assert r.get("n_threads") == 16
+    r.set("n_threads", 2.7)
+    assert r.get("n_threads") == 2
+
+
+def test_zone_smaller_than_unit_rejected():
+    with pytest.raises(ValueError):
+        ZoneAllocator(100, unit_bytes=512)
+
+
+def test_logfile_has_no_ansi(tmp_path):
+    out = Output()
+    sid = out.open(verbosity=5, filename=str(tmp_path / "f.log"))
+    out.emit(sid, 1, "inform", "plain")
+    out.close(sid)
+    assert "\x1b[" not in (tmp_path / "f.log").read_text()
