@@ -15,6 +15,9 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 _STRIPES = 64
 
+#: Sentinel a ``mutate`` callback returns to delete the key atomically.
+REMOVE = object()
+
 
 class ConcurrentHashTable:
     def __init__(self, nb_bits: int = 8, max_collisions_hint: int = 16):
@@ -68,6 +71,26 @@ class ConcurrentHashTable:
             new = fn(cur)
             self._maps[s][key] = new
             return new
+
+    def mutate(self, key: Any, fn: Callable[[Any], Tuple[Any, Any]],
+               default: Any = None) -> Any:
+        """Atomic read-modify-write-or-remove under the bucket lock.
+
+        ``fn(current)`` returns ``(new_value, result)``; if ``new_value`` is
+        the REMOVE sentinel the key is deleted.  Returns ``result``.  This is
+        the primitive the data-repo retirement protocol needs so an entry
+        cannot be revived between its usage count reaching zero and its
+        removal from the table.
+        """
+        s = self._stripe(key)
+        with self._locks[s]:
+            cur = self._maps[s].get(key, default)
+            new, result = fn(cur)
+            if new is REMOVE:
+                self._maps[s].pop(key, None)
+            else:
+                self._maps[s][key] = new
+            return result
 
     def pop_if(self, key: Any, pred: Callable[[Any], bool]) -> Optional[Any]:
         s = self._stripe(key)

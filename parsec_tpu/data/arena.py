@@ -1,0 +1,74 @@
+"""Arenas: shaped freelist allocators for temporaries.
+
+Rebuild of the reference's arena system (reference: parsec/arena.{c,h}):
+an arena defines the "shape" (size/alignment/datatype) of the temporary
+buffers a taskpool needs for network staging and NEW flows; allocation goes
+through a freelist so steady-state execution allocates nothing.  Here the
+shape is (shape, dtype) of a numpy buffer, and ``ArenaDatatype`` pairs an
+arena with a layout tag the way parsec_arena_datatype_t pairs arena+MPI
+datatype (reference: parsec/parsec_internal.h:41-45).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from parsec_tpu.data.data import Coherency, Data, DataCopy
+
+
+class Arena:
+    def __init__(self, shape: Tuple[int, ...], dtype: Any = np.float32,
+                 max_cached: int = 256):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.elt_size = int(np.prod(self.shape)) * self.dtype.itemsize
+        self._lock = threading.Lock()
+        self._free: List[np.ndarray] = []
+        self._max = max_cached
+        self.allocated = 0   # live stats (reference: arena used/released counts)
+        self.released = 0
+
+    def get_buffer(self) -> np.ndarray:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+            self.allocated += 1
+        return np.empty(self.shape, self.dtype)
+
+    def release_buffer(self, buf: np.ndarray) -> None:
+        with self._lock:
+            self.released += 1
+            if len(self._free) < self._max:
+                self._free.append(buf)
+
+    def get_copy(self, data: Optional[Data] = None, device: int = 0) -> DataCopy:
+        """Allocate a fresh arena-backed copy, optionally attached to a datum
+        (reference: parsec_arena_get_copy, arena.h:136)."""
+        buf = self.get_buffer()
+        if data is None:
+            data = Data(nb_elts=self.elt_size)
+        copy = DataCopy(data, device, payload=buf,
+                        coherency=Coherency.EXCLUSIVE, version=0)
+        copy.arena = self
+        if data.copy_on(device) is None:
+            data.attach_copy(copy)
+        return copy
+
+    def release_copy(self, copy: DataCopy) -> None:
+        if copy.arena is not self:
+            raise ValueError("copy does not belong to this arena")
+        self.release_buffer(copy.payload)
+        copy.payload = None
+        copy.coherency = Coherency.INVALID
+
+
+class ArenaDatatype:
+    """Arena + layout tag pair, registered per flow datatype
+    (reference: parsec_arena_datatype_t)."""
+
+    def __init__(self, arena: Arena, dtt: Any = None):
+        self.arena = arena
+        self.dtt = dtt if dtt is not None else (arena.shape, arena.dtype.str)

@@ -1,0 +1,109 @@
+"""Data-collection base: the owner-computes mapping vtable.
+
+Rebuild of the reference's data distribution base
+(reference: include/parsec/data_distribution.h:26-66, data_distribution.c):
+a collection maps a global key to ``rank_of`` (which process owns it),
+``vpid_of`` (which NUMA domain / local partition), and ``data_of`` (the
+local Data handle).  Task affinity follows these answers — that is the
+distributed "owner computes" parallelism of the runtime, and on TPU the
+same vtable additionally answers ``device_of`` so tiles pin to chips of the
+mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from parsec_tpu.data.data import Data
+
+
+class DataCollection:
+    """Abstract collection (reference: parsec_data_collection_t)."""
+
+    def __init__(self, nodes: int = 1, myrank: int = 0, name: str = "dc"):
+        self.nodes = nodes
+        self.myrank = myrank
+        self.name = name
+        self.dc_id = None        # registered id (taskpool serialization)
+
+    # -- the vtable -------------------------------------------------------
+    def data_key(self, *indices) -> Any:
+        """Flatten index tuple to a canonical key."""
+        raise NotImplementedError
+
+    def rank_of(self, *indices) -> int:
+        raise NotImplementedError
+
+    def vpid_of(self, *indices) -> int:
+        return 0
+
+    def data_of(self, *indices) -> Data:
+        """The local Data for these indices (only valid on the owner rank)."""
+        raise NotImplementedError
+
+    def rank_of_key(self, key: Any) -> int:
+        return self.rank_of(*self.key_to_indices(key))
+
+    def data_of_key(self, key: Any) -> Data:
+        return self.data_of(*self.key_to_indices(key))
+
+    def key_to_indices(self, key: Any) -> Tuple:
+        raise NotImplementedError
+
+    # -- convenience ------------------------------------------------------
+    def is_local(self, *indices) -> bool:
+        return self.rank_of(*indices) == self.myrank
+
+    def __call__(self, *indices) -> "DataRef":
+        """``A(k)`` in flow specifications resolves through here."""
+        return DataRef(self, indices)
+
+
+class DataRef:
+    """A symbolic reference to a collection element (``A(m, n)``), used by
+    flow endpoint expressions before resolution."""
+
+    __slots__ = ("dc", "indices")
+
+    def __init__(self, dc: DataCollection, indices: Tuple):
+        self.dc = dc
+        self.indices = indices
+
+    @property
+    def rank(self) -> int:
+        return self.dc.rank_of(*self.indices)
+
+    def resolve(self) -> Data:
+        return self.dc.data_of(*self.indices)
+
+    def __repr__(self):
+        return f"{self.dc.name}{self.indices}"
+
+
+_dc_registry_lock = threading.Lock()
+_dc_registry: Dict[int, DataCollection] = {}
+_dc_next_id = [1]
+
+
+def dc_register(dc: DataCollection) -> int:
+    """Register for cross-rank identification
+    (reference: parsec_dc_register_id)."""
+    with _dc_registry_lock:
+        dc_id = _dc_next_id[0]
+        _dc_next_id[0] += 1
+        dc.dc_id = dc_id
+        _dc_registry[dc_id] = dc
+        return dc_id
+
+
+def dc_lookup(dc_id: int) -> Optional[DataCollection]:
+    with _dc_registry_lock:
+        return _dc_registry.get(dc_id)
+
+
+def dc_unregister(dc_id: int) -> None:
+    with _dc_registry_lock:
+        dc = _dc_registry.pop(dc_id, None)
+        if dc is not None:
+            dc.dc_id = None

@@ -1,0 +1,194 @@
+"""Canonical data + per-memory-space copies with coherency.
+
+Rebuild of the reference's data substrate (reference: parsec/data.c,
+parsec/data_internal.h:35-81, parsec/data.h:28-31): a ``Data`` is one logical
+datum (a matrix tile, say); it owns ``DataCopy`` instances, one per memory
+space holding a version of the payload.  Coherency follows the reference's
+MOESI-flavored protocol:
+
+    INVALID    copy exists but its payload is stale
+    SHARED     valid for reading; other valid copies may exist
+    OWNED      valid, authoritative; other SHARED copies may exist
+    EXCLUSIVE  valid and the only valid copy (a write makes it so)
+
+On TPU, memory space 0 is host RAM (numpy payloads) and spaces >=1 are
+device HBM (jax.Array payloads); actual movement is delegated to the device
+layer's transfer hooks, so this module stays device-agnostic.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from enum import IntEnum
+from typing import Any, Dict, Optional
+
+# Flow access modes (reference: parsec/flow modes FLOW_ACCESS_*)
+ACCESS_NONE = 0x0
+ACCESS_READ = 0x1
+ACCESS_WRITE = 0x2
+ACCESS_RW = ACCESS_READ | ACCESS_WRITE
+
+
+class Coherency(IntEnum):
+    INVALID = 0
+    OWNED = 1
+    EXCLUSIVE = 2
+    SHARED = 4
+
+
+_data_keygen = itertools.count()
+
+
+class DataCopy:
+    """One version of a datum in one memory space
+    (reference: parsec_data_copy_t)."""
+
+    __slots__ = ("data", "device", "payload", "coherency", "version",
+                 "readers", "flags", "arena", "dtt")
+
+    def __init__(self, data: "Data", device: int, payload: Any = None,
+                 coherency: Coherency = Coherency.INVALID, version: int = 0):
+        self.data = data
+        self.device = device
+        self.payload = payload
+        self.coherency = coherency
+        self.version = version
+        self.readers = 0          # active reader count (stage-out gating)
+        self.flags = 0
+        self.arena = None         # owning arena, if arena-allocated
+        self.dtt = None           # datatype/layout tag (reshape engine)
+
+    def __repr__(self):
+        return (f"<DataCopy dev={self.device} v={self.version} "
+                f"{self.coherency.name} of {self.data}>")
+
+
+class Data:
+    """One logical datum with per-device copies (reference: parsec_data_t)."""
+
+    def __init__(self, key: Any = None, collection: Any = None,
+                 nb_elts: int = 0, owner_device: int = 0):
+        self.key = key if key is not None else next(_data_keygen)
+        self.collection = collection
+        self.nb_elts = nb_elts
+        self.owner_device = owner_device
+        self.preferred_device = -1
+        self._lock = threading.RLock()
+        self._copies: Dict[int, DataCopy] = {}
+        self._version_clock = 0   # monotonic; never regresses on invalidation
+
+    def __repr__(self):
+        return f"<Data key={self.key}>"
+
+    # -- copy management -------------------------------------------------
+    def attach_copy(self, copy: DataCopy) -> DataCopy:
+        with self._lock:
+            if copy.device in self._copies:
+                raise ValueError(f"device {copy.device} already has a copy")
+            self._copies[copy.device] = copy
+            self._version_clock = max(self._version_clock, copy.version)
+            return copy
+
+    def detach_copy(self, device: int) -> Optional[DataCopy]:
+        with self._lock:
+            return self._copies.pop(device, None)
+
+    def copy_on(self, device: int) -> Optional[DataCopy]:
+        with self._lock:
+            return self._copies.get(device)
+
+    def copies(self) -> Dict[int, DataCopy]:
+        with self._lock:
+            return dict(self._copies)
+
+    def create_copy(self, device: int, payload: Any = None,
+                    coherency: Coherency = Coherency.INVALID,
+                    version: int = 0) -> DataCopy:
+        return self.attach_copy(DataCopy(self, device, payload, coherency,
+                                         version))
+
+    # -- coherency protocol ----------------------------------------------
+    def newest_version(self) -> int:
+        with self._lock:
+            return max((c.version for c in self._copies.values()
+                        if c.coherency != Coherency.INVALID), default=0)
+
+    def newest_copy(self, prefer_device: Optional[int] = None) -> Optional[DataCopy]:
+        """The authoritative valid copy (highest version, OWNED/EXCLUSIVE
+        preferred, then prefer_device)."""
+        with self._lock:
+            best = None
+            v = self.newest_version()
+            for c in self._copies.values():
+                if c.coherency == Coherency.INVALID or c.version != v:
+                    continue
+                if best is None:
+                    best = c
+                elif (c.coherency in (Coherency.OWNED, Coherency.EXCLUSIVE)
+                      and best.coherency == Coherency.SHARED):
+                    best = c
+                elif prefer_device is not None and c.device == prefer_device \
+                        and best.device != prefer_device:
+                    if best.coherency == Coherency.SHARED or \
+                       c.coherency != Coherency.SHARED:
+                        best = c
+            return best
+
+    def transfer_ownership(self, device: int, access: int) -> Optional[DataCopy]:
+        """Update coherency for an upcoming access on ``device``; returns the
+        source copy a transfer must pull from (None if the local copy is
+        already valid).  Mirrors parsec_data_transfer_ownership_to_copy
+        (reference: parsec/data.h:115-126, data.c).
+        """
+        with self._lock:
+            target = self._copies.get(device)
+            if target is None:
+                raise KeyError(f"no copy of {self} on device {device}")
+            newest = self.newest_copy(prefer_device=device)
+            source = None
+            # A pull is only needed when the access actually reads the datum
+            # (WRITE-only flows overwrite it entirely).
+            if (access & ACCESS_READ) and (
+                    target.coherency == Coherency.INVALID or
+                    (newest is not None and target.version < newest.version)):
+                source = newest if newest is not target else None
+            if access & ACCESS_WRITE:
+                for c in self._copies.values():
+                    if c is not target:
+                        c.coherency = Coherency.INVALID
+                target.coherency = Coherency.EXCLUSIVE
+            else:
+                if target.coherency == Coherency.INVALID:
+                    target.coherency = Coherency.SHARED
+                    if newest is not None and newest.coherency == Coherency.EXCLUSIVE:
+                        newest.coherency = Coherency.OWNED
+                # valid copies stay as they are on read
+            return source
+
+    def complete_write(self, device: int) -> None:
+        """Version bump after a write completes on ``device``.  Uses the
+        monotonic clock, not max-over-valid-copies, so invalidated stale
+        copies can never out-version the authoritative one."""
+        with self._lock:
+            c = self._copies[device]
+            self._version_clock += 1
+            c.version = self._version_clock
+
+    def start_read(self, device: int) -> None:
+        with self._lock:
+            self._copies[device].readers += 1
+
+    def end_read(self, device: int) -> None:
+        with self._lock:
+            self._copies[device].readers -= 1
+
+
+def new_data(payload: Any, key: Any = None, device: int = 0,
+             collection: Any = None) -> Data:
+    """Wrap an existing host payload as an OWNED datum (the common path for
+    collection-backed tiles)."""
+    nb = getattr(payload, "nbytes", 0)
+    d = Data(key=key, collection=collection, nb_elts=nb, owner_device=device)
+    d.create_copy(device, payload=payload, coherency=Coherency.OWNED, version=1)
+    return d

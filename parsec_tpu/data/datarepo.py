@@ -1,0 +1,79 @@
+"""Data repositories: hashed storage of completed-task outputs.
+
+Rebuild of the reference's data repo (reference: parsec/datarepo.{c,h}):
+each task class has a repo hashing its completed tasks' output copies by
+task key.  Successors look entries up and consume them; an entry retires
+(releasing its copies) when every registered consumer has used it — the
+usage-count/retirement protocol of datarepo.h:50-58, whose lifetime rules
+the dep engine must follow exactly to avoid leaks and use-after-free.
+
+All usage-count mutations happen under the hash table's bucket lock
+(ConcurrentHashTable.mutate), so an entry whose count reaches zero is
+removed in the same critical section — no revival race between a retiring
+consumer and a concurrent lookup_entry_and_create.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from parsec_tpu.containers.hash_table import REMOVE, ConcurrentHashTable
+
+
+class RepoEntry:
+    __slots__ = ("key", "copies", "usage", "on_retire")
+
+    def __init__(self, key: Any, nb_flows: int):
+        self.key = key
+        self.copies: List[Optional[Any]] = [None] * nb_flows
+        self.usage = 0        # mutated only under the repo's bucket lock
+        self.on_retire: Optional[Callable[["RepoEntry"], None]] = None
+
+
+class DataRepo:
+    """Per-task-class repo (reference: data_repo_t)."""
+
+    def __init__(self, nb_flows: int, name: str = ""):
+        self.nb_flows = nb_flows
+        self.name = name
+        self._table = ConcurrentHashTable()
+
+    def lookup_entry(self, key: Any) -> Optional[RepoEntry]:
+        return self._table.find(key)
+
+    def lookup_entry_and_create(self, key: Any) -> RepoEntry:
+        """Find or atomically create the entry for ``key``, taking a usage
+        hold so it cannot retire under the caller
+        (reference: data_repo_lookup_entry_and_create)."""
+        def fn(cur):
+            e = cur if cur is not None else RepoEntry(key, self.nb_flows)
+            e.usage += 1
+            return e, e
+        return self._table.mutate(key, fn)
+
+    def _addto_usage(self, key: Any, delta: int) -> Optional[RepoEntry]:
+        """Adjust usage; atomically remove on zero. Returns the entry to
+        retire (caller fires on_retire outside the lock) or None."""
+        def fn(cur):
+            if cur is None:
+                raise KeyError(f"repo {self.name}: no entry {key}")
+            cur.usage += delta
+            if cur.usage == 0:
+                return REMOVE, cur
+            return cur, None
+        entry = self._table.mutate(key, fn)
+        if entry is not None and entry.on_retire is not None:
+            entry.on_retire(entry)
+        return entry
+
+    def entry_addto_usage_limit(self, key: Any, nb_usage: int) -> None:
+        """Producer declares how many consumers will use the entry and drops
+        its creation hold (reference: data_repo_entry_addto_usage_limit)."""
+        self._addto_usage(key, nb_usage - 1)
+
+    def entry_used_once(self, key: Any) -> None:
+        """One consumer is done (reference: data_repo_entry_used_once)."""
+        self._addto_usage(key, -1)
+
+    def __len__(self) -> int:
+        return len(self._table)

@@ -1,0 +1,272 @@
+"""Tiled-matrix collections.
+
+Rebuild of the reference's matrix data distributions
+(reference: parsec/data_dist/matrix/matrix.{c,h},
+two_dim_rectangle_cyclic.{c,h}, grid_2Dcyclic.c,
+sym_two_dim_rectangle_cyclic.c, two_dim_tabular.c,
+vector_two_dim_cyclic.c): a logical LM x LN matrix cut into MB x NB tiles,
+distributed over a process grid.  ``TwoDimBlockCyclic`` is the ScaLAPACK
+PxQ block-cyclic layout (with kp/kq repetition factors); the symmetric
+variant stores one triangle only; ``TwoDimTabular`` takes an arbitrary
+tile->rank table; ``VectorTwoDimCyclic`` distributes a 1D tile vector.
+
+Tiles default to TPU-friendly sizes: keep MB/NB multiples of the MXU tile
+(128) and bfloat16/float32 payloads so staged tiles map straight onto the
+systolic array.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from parsec_tpu.data.collection import DataCollection
+from parsec_tpu.data.data import Data, new_data
+
+
+class TiledMatrix(DataCollection):
+    """Base tiled matrix (reference: parsec_tiled_matrix_t)."""
+
+    def __init__(self, mb: int, nb: int, lm: int, ln: int,
+                 dtype: Any = np.float32, nodes: int = 1, myrank: int = 0,
+                 name: str = "A"):
+        super().__init__(nodes=nodes, myrank=myrank, name=name)
+        self.mb, self.nb = mb, nb           # tile rows/cols
+        self.lm, self.ln = lm, ln           # full matrix rows/cols
+        self.mt = -(-lm // mb)              # tiles in row dimension
+        self.nt = -(-ln // nb)
+        self.dtype = np.dtype(dtype)
+        self._lock = threading.Lock()
+        self._tiles: Dict[Tuple[int, int], Data] = {}
+        self._backing: Optional[np.ndarray] = None
+
+    # -- keys -------------------------------------------------------------
+    def data_key(self, m: int, n: int = 0) -> int:
+        return m * self.nt + n
+
+    def key_to_indices(self, key: int) -> Tuple[int, int]:
+        return divmod(key, self.nt)
+
+    # -- local storage ----------------------------------------------------
+    def tile_shape(self, m: int, n: int) -> Tuple[int, int]:
+        """Edge tiles may be partial."""
+        return (min(self.mb, self.lm - m * self.mb),
+                min(self.nb, self.ln - n * self.nb))
+
+    def tile_exists(self, m: int, n: int = 0) -> bool:
+        """Whether (m, n) is a stored tile (symmetric layouts store one
+        triangle only)."""
+        return 0 <= m < self.mt and 0 <= n < self.nt
+
+    def is_local(self, *indices) -> bool:
+        return self.tile_exists(*indices) and \
+            self.rank_of(*indices) == self.myrank
+
+    def from_array(self, a: np.ndarray) -> "TiledMatrix":
+        """Back local tiles with views into an existing LM x LN array
+        (single-rank convenience; multi-rank callers hand local arrays).
+        Must be called before any tile is materialized."""
+        if a.shape != (self.lm, self.ln):
+            raise ValueError(f"expected {(self.lm, self.ln)}, got {a.shape}")
+        with self._lock:
+            if self._tiles:
+                raise ValueError(
+                    "from_array after tiles were materialized would detach "
+                    "them from the backing array; call it first")
+            self._backing = a
+        return self
+
+    def to_array(self) -> np.ndarray:
+        """Gather local tiles into a full array (single-rank only)."""
+        if self.nodes != 1:
+            raise ValueError("to_array is single-rank only")
+        if self._backing is not None:
+            self._sync_backing()
+            return self._backing
+        out = np.zeros((self.lm, self.ln), self.dtype)
+        for (m, n), d in list(self._tiles.items()):
+            c = d.newest_copy(prefer_device=0)
+            tm, tn = self.tile_shape(m, n)
+            payload = np.asarray(c.payload)[:tm, :tn]
+            out[m * self.mb:m * self.mb + tm, n * self.nb:n * self.nb + tn] = payload
+        return out
+
+    def _sync_backing(self) -> None:
+        """Write back tiles whose newest copy isn't the host view."""
+        for (m, n), d in list(self._tiles.items()):
+            c = d.newest_copy()
+            host = d.copy_on(0)
+            if c is not None and host is not None and c is not host:
+                tm, tn = self.tile_shape(m, n)
+                np.copyto(host.payload[:tm, :tn], np.asarray(c.payload)[:tm, :tn])
+                host.version = c.version
+                host.coherency = c.coherency
+
+    def _make_tile(self, m: int, n: int) -> Data:
+        tm, tn = self.tile_shape(m, n)
+        if self._backing is not None:
+            payload = self._backing[m * self.mb:m * self.mb + tm,
+                                    n * self.nb:n * self.nb + tn]
+        else:
+            payload = np.zeros((tm, tn), self.dtype)
+        return new_data(payload, key=(self.name, m, n), collection=self)
+
+    def data_of(self, m: int, n: int = 0) -> Data:
+        with self._lock:
+            t = self._tiles.get((m, n))
+            if t is None:
+                if self.rank_of(m, n) != self.myrank:
+                    raise KeyError(
+                        f"{self.name}({m},{n}) lives on rank "
+                        f"{self.rank_of(m, n)}, not {self.myrank}")
+                t = self._make_tile(m, n)
+                self._tiles[(m, n)] = t
+            return t
+
+    def local_tiles(self) -> List[Tuple[int, int]]:
+        return [(m, n) for m in range(self.mt) for n in range(self.nt)
+                if self.tile_exists(m, n)
+                and self.rank_of(m, n) == self.myrank]
+
+
+class Grid2DCyclic:
+    """PxQ process grid with kp/kq repetition (reference: grid_2Dcyclic.c)."""
+
+    def __init__(self, rank: int, P: int, Q: int, kp: int = 1, kq: int = 1,
+                 ip: int = 0, jq: int = 0):
+        self.rank, self.P, self.Q = rank, P, Q
+        self.kp, self.kq = kp, kq
+        self.ip, self.jq = ip, jq      # origin offsets
+        self.rrank = rank // Q
+        self.crank = rank % Q
+
+    def rank_of(self, m: int, n: int) -> int:
+        p = ((m // self.kp) + self.ip) % self.P
+        q = ((n // self.kq) + self.jq) % self.Q
+        return p * self.Q + q
+
+
+class TwoDimBlockCyclic(TiledMatrix):
+    """ScaLAPACK 2D block-cyclic distribution
+    (reference: two_dim_rectangle_cyclic.{c,h})."""
+
+    def __init__(self, mb: int, nb: int, lm: int, ln: int,
+                 nodes: int = 1, myrank: int = 0, P: int = 1, Q: int = -1,
+                 kp: int = 1, kq: int = 1, dtype: Any = np.float32,
+                 name: str = "A"):
+        super().__init__(mb, nb, lm, ln, dtype=dtype, nodes=nodes,
+                         myrank=myrank, name=name)
+        if Q == -1:
+            Q = nodes // P
+        if P * Q != nodes:
+            raise ValueError(f"grid {P}x{Q} != {nodes} nodes")
+        self.grid = Grid2DCyclic(myrank, P, Q, kp, kq)
+
+    def rank_of(self, m: int, n: int = 0) -> int:
+        return self.grid.rank_of(m, n)
+
+    def vpid_of(self, m: int, n: int = 0) -> int:
+        return 0
+
+
+class SymTwoDimBlockCyclic(TwoDimBlockCyclic):
+    """Symmetric matrix storing one triangle only
+    (reference: sym_two_dim_rectangle_cyclic.c)."""
+
+    LOWER, UPPER = 0, 1
+
+    def __init__(self, *args, uplo: int = LOWER, **kw):
+        super().__init__(*args, **kw)
+        self.uplo = uplo
+
+    def tile_exists(self, m: int, n: int = 0) -> bool:
+        if not super().tile_exists(m, n):
+            return False
+        return n <= m if self.uplo == self.LOWER else m <= n
+
+    def _check(self, m: int, n: int) -> None:
+        if self.uplo == self.LOWER and n > m:
+            raise KeyError(f"{self.name}({m},{n}) not stored (lower)")
+        if self.uplo == self.UPPER and m > n:
+            raise KeyError(f"{self.name}({m},{n}) not stored (upper)")
+
+    def rank_of(self, m: int, n: int = 0) -> int:
+        self._check(m, n)
+        return super().rank_of(m, n)
+
+    def data_of(self, m: int, n: int = 0) -> Data:
+        self._check(m, n)
+        return super().data_of(m, n)
+
+
+class TwoDimTabular(TiledMatrix):
+    """Arbitrary tile->rank table (reference: two_dim_tabular.c)."""
+
+    def __init__(self, mb: int, nb: int, lm: int, ln: int,
+                 table: Sequence[int], nodes: int = 1, myrank: int = 0,
+                 dtype: Any = np.float32, name: str = "T"):
+        super().__init__(mb, nb, lm, ln, dtype=dtype, nodes=nodes,
+                         myrank=myrank, name=name)
+        if len(table) != self.mt * self.nt:
+            raise ValueError("table must have one rank per tile")
+        self.table = list(table)
+
+    def rank_of(self, m: int, n: int = 0) -> int:
+        return self.table[self.data_key(m, n)]
+
+
+class VectorTwoDimCyclic(TiledMatrix):
+    """1D cyclic vector of tiles (reference: vector_two_dim_cyclic.c).
+
+    Payloads are 1D; from_array/to_array work on 1D arrays of length lm.
+    """
+
+    def __init__(self, mb: int, lm: int, nodes: int = 1, myrank: int = 0,
+                 dtype: Any = np.float32, name: str = "V"):
+        super().__init__(mb, 1, lm, 1, dtype=dtype, nodes=nodes,
+                         myrank=myrank, name=name)
+
+    def rank_of(self, m: int, n: int = 0) -> int:
+        return m % self.nodes
+
+    def from_array(self, a: np.ndarray) -> "VectorTwoDimCyclic":
+        if a.shape != (self.lm,):
+            raise ValueError(f"expected ({self.lm},), got {a.shape}")
+        with self._lock:
+            if self._tiles:
+                raise ValueError("from_array must precede tile access")
+            self._backing = a
+        return self
+
+    def to_array(self) -> np.ndarray:
+        if self.nodes != 1:
+            raise ValueError("to_array is single-rank only")
+        if self._backing is not None:
+            self._sync_backing()
+            return self._backing
+        out = np.zeros(self.lm, self.dtype)
+        for (m, _n), d in list(self._tiles.items()):
+            c = d.newest_copy(prefer_device=0)
+            tm = min(self.mb, self.lm - m * self.mb)
+            out[m * self.mb:m * self.mb + tm] = np.asarray(c.payload)[:tm]
+        return out
+
+    def _sync_backing(self) -> None:
+        for (m, _n), d in list(self._tiles.items()):
+            c = d.newest_copy()
+            host = d.copy_on(0)
+            if c is not None and host is not None and c is not host:
+                tm = min(self.mb, self.lm - m * self.mb)
+                np.copyto(host.payload[:tm], np.asarray(c.payload)[:tm])
+                host.version = c.version
+                host.coherency = c.coherency
+
+    def _make_tile(self, m: int, n: int) -> Data:
+        tm = min(self.mb, self.lm - m * self.mb)
+        if self._backing is not None:
+            payload = self._backing[m * self.mb:m * self.mb + tm]
+        else:
+            payload = np.zeros(tm, self.dtype)
+        return new_data(payload, key=(self.name, m, n), collection=self)

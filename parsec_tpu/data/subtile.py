@@ -1,0 +1,32 @@
+"""Recursive sub-tiling of a single tile.
+
+Reference: parsec/data_dist/matrix/subtile.c — wraps one tile of a parent
+collection as its own tiled matrix so hierarchical/recursive algorithms
+(the recursive device, SURVEY.md §2.3) can run an inner taskpool on it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from parsec_tpu.data.data import Data
+from parsec_tpu.data.matrix import TiledMatrix
+
+
+class SubtileMatrix(TiledMatrix):
+    """View one parent tile as an mb x nb tiled matrix (always rank-local)."""
+
+    def __init__(self, parent_tile: Data, mb: int, nb: int, name: str = "sub"):
+        copy = parent_tile.newest_copy(prefer_device=0)
+        if copy is None or copy.payload is None:
+            raise ValueError("parent tile has no materialized host copy")
+        a = np.asarray(copy.payload)
+        super().__init__(mb, nb, a.shape[0], a.shape[1], dtype=a.dtype,
+                         nodes=1, myrank=0, name=name)
+        self.parent = parent_tile
+        self.from_array(a)
+
+    def rank_of(self, m: int, n: int = 0) -> int:
+        return 0

@@ -1,0 +1,311 @@
+"""Tests of the data substrate: coherency protocol, arenas, repos,
+and the tiled-matrix collections (reference: parsec/data.c semantics and
+data_dist/matrix layouts)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.data.arena import Arena, ArenaDatatype
+from parsec_tpu.data.collection import dc_lookup, dc_register, dc_unregister
+from parsec_tpu.data.data import (ACCESS_READ, ACCESS_RW, ACCESS_WRITE,
+                                  Coherency, Data, new_data)
+from parsec_tpu.data.datarepo import DataRepo
+from parsec_tpu.data.hash_datadist import HashDatadist
+from parsec_tpu.data.matrix import (SymTwoDimBlockCyclic, TiledMatrix,
+                                    TwoDimBlockCyclic, TwoDimTabular,
+                                    VectorTwoDimCyclic)
+from parsec_tpu.data.subtile import SubtileMatrix
+
+
+# ---------------------------------------------------------------- coherency
+
+def test_new_data_owned_on_host():
+    d = new_data(np.zeros(4))
+    c = d.copy_on(0)
+    assert c.coherency == Coherency.OWNED and c.version == 1
+    assert d.newest_copy() is c
+
+
+def test_read_transfer_shares():
+    d = new_data(np.arange(4.0))
+    d.create_copy(1)  # INVALID device copy
+    src = d.transfer_ownership(1, ACCESS_READ)
+    assert src is d.copy_on(0)          # must pull from host copy
+    assert d.copy_on(1).coherency == Coherency.SHARED
+    assert d.copy_on(0).coherency == Coherency.OWNED
+
+
+def test_write_transfer_invalidates_others():
+    d = new_data(np.arange(4.0))
+    d.create_copy(1)
+    d.transfer_ownership(1, ACCESS_READ)
+    d.copy_on(1).version = 1
+    src = d.transfer_ownership(1, ACCESS_WRITE)
+    assert src is None                  # already valid locally
+    assert d.copy_on(1).coherency == Coherency.EXCLUSIVE
+    assert d.copy_on(0).coherency == Coherency.INVALID
+    d.complete_write(1)
+    assert d.copy_on(1).version == 2
+    assert d.newest_copy() is d.copy_on(1)
+
+
+def test_stale_copy_needs_transfer():
+    d = new_data(np.arange(4.0))
+    d.create_copy(1)
+    d.transfer_ownership(1, ACCESS_RW)
+    d.complete_write(1)
+    # host copy now stale; reading on host requires a pull from device 1
+    src = d.transfer_ownership(0, ACCESS_READ)
+    assert src is d.copy_on(1)
+
+
+def test_exclusive_demoted_to_owned_on_remote_read():
+    d = new_data(np.arange(4.0))
+    d.create_copy(1)
+    d.transfer_ownership(1, ACCESS_WRITE)
+    d.complete_write(1)
+    d.transfer_ownership(0, ACCESS_READ)
+    assert d.copy_on(1).coherency == Coherency.OWNED
+    assert d.copy_on(0).coherency == Coherency.SHARED
+
+
+def test_reader_counts():
+    d = new_data(np.zeros(2))
+    d.start_read(0)
+    d.start_read(0)
+    assert d.copy_on(0).readers == 2
+    d.end_read(0)
+    d.end_read(0)
+    assert d.copy_on(0).readers == 0
+
+
+# ------------------------------------------------------------------- arena
+
+def test_arena_freelist_reuse():
+    a = Arena((8, 8), np.float32)
+    c1 = a.get_copy()
+    buf1 = c1.payload
+    assert buf1.shape == (8, 8)
+    a.release_copy(c1)
+    c2 = a.get_copy()
+    assert c2.payload is buf1           # freelist reuse
+    assert a.allocated == 1
+    adt = ArenaDatatype(a)
+    assert adt.dtt == ((8, 8), np.dtype(np.float32).str)
+
+
+def test_arena_release_foreign_copy_rejected():
+    a1, a2 = Arena((2,)), Arena((2,))
+    c = a1.get_copy()
+    with pytest.raises(ValueError):
+        a2.release_copy(c)
+
+
+# -------------------------------------------------------------------- repo
+
+def test_repo_usage_and_retirement():
+    repo = DataRepo(nb_flows=2, name="POTRF")
+    retired = []
+    e = repo.lookup_entry_and_create(("k", 0))
+    e.on_retire = lambda entry: retired.append(entry.key)
+    e.copies[0] = "copyA"
+    # producer declares 3 consumers (drops its own hold)
+    repo.entry_addto_usage_limit(("k", 0), 3)
+    assert repo.lookup_entry(("k", 0)) is e
+    repo.entry_used_once(("k", 0))
+    repo.entry_used_once(("k", 0))
+    assert not retired
+    repo.entry_used_once(("k", 0))
+    assert retired == [("k", 0)]
+    assert repo.lookup_entry(("k", 0)) is None
+
+
+def test_repo_producer_first_protocol():
+    """Producer creates (taking the hold), fills copies, declares the limit;
+    consumers then drain it — the reference's PTG discipline where successors
+    only activate after the producer completed."""
+    repo = DataRepo(nb_flows=1)
+    e = repo.lookup_entry_and_create("x")
+    e.copies[0] = "out"
+    repo.entry_addto_usage_limit("x", 2)       # 2 consumers, drop hold
+    assert repo.lookup_entry("x") is e
+    repo.entry_used_once("x")
+    assert repo.lookup_entry("x") is e         # one consumer still pending
+    repo.entry_used_once("x")
+    assert repo.lookup_entry("x") is None      # retired exactly now
+
+
+def test_repo_zero_consumers_retires_immediately():
+    repo = DataRepo(nb_flows=1)
+    repo.lookup_entry_and_create("y")
+    repo.entry_addto_usage_limit("y", 0)
+    assert repo.lookup_entry("y") is None
+
+
+# ------------------------------------------------------------- collections
+
+def test_two_dim_block_cyclic_ranks():
+    # 4 ranks in a 2x2 grid, 4x4 tiles
+    dcs = [TwoDimBlockCyclic(2, 2, 8, 8, nodes=4, myrank=r, P=2)
+           for r in range(4)]
+    A = dcs[0]
+    assert A.mt == A.nt == 4
+    # block-cyclic: rank(m,n) = (m%2)*2 + n%2
+    for m in range(4):
+        for n in range(4):
+            assert A.rank_of(m, n) == (m % 2) * 2 + (n % 2)
+    # every tile is local to exactly one rank
+    for m in range(4):
+        for n in range(4):
+            owners = [r for r, dc in enumerate(dcs) if dc.is_local(m, n)]
+            assert owners == [A.rank_of(m, n)]
+    assert sorted(len(dc.local_tiles()) for dc in dcs) == [4, 4, 4, 4]
+
+
+def test_block_cyclic_kp_kq_repetition():
+    A = TwoDimBlockCyclic(1, 1, 8, 8, nodes=4, myrank=0, P=2, kp=2, kq=2)
+    # with kp=kq=2, 2x2 super-blocks land on the same rank
+    assert A.rank_of(0, 0) == A.rank_of(1, 1) == 0
+    assert A.rank_of(2, 0) == A.rank_of(3, 1) == 2
+
+
+def test_from_array_roundtrip_and_edge_tiles():
+    a = np.arange(30, dtype=np.float32).reshape(5, 6)
+    A = TwoDimBlockCyclic(2, 4, 5, 6).from_array(a)
+    assert A.mt == 3 and A.nt == 2
+    t = A.data_of(2, 1)                 # edge tile: 1x2
+    payload = t.copy_on(0).payload
+    assert payload.shape == (1, 2)
+    assert payload[0, 0] == a[4, 4]
+    payload[0, 0] = -1                  # view writes through
+    assert a[4, 4] == -1
+    assert np.shares_memory(A.to_array(), a)
+
+
+def test_data_key_roundtrip():
+    A = TwoDimBlockCyclic(2, 2, 8, 6)
+    for m in range(A.mt):
+        for n in range(A.nt):
+            assert A.key_to_indices(A.data_key(m, n)) == (m, n)
+
+
+def test_remote_tile_access_rejected():
+    A = TwoDimBlockCyclic(2, 2, 8, 8, nodes=2, myrank=0, P=2, Q=1)
+    with pytest.raises(KeyError):
+        A.data_of(1, 0)  # owned by rank 1
+
+
+def test_sym_matrix_triangle_only():
+    S = SymTwoDimBlockCyclic(2, 2, 8, 8, uplo=SymTwoDimBlockCyclic.LOWER)
+    assert S.rank_of(3, 1) == 0
+    with pytest.raises(KeyError):
+        S.rank_of(1, 3)
+    with pytest.raises(KeyError):
+        S.data_of(0, 2)
+
+
+def test_tabular_distribution():
+    table = [0, 1, 1, 0]
+    T = TwoDimTabular(2, 2, 4, 4, table, nodes=2, myrank=0)
+    assert T.rank_of(0, 0) == 0 and T.rank_of(0, 1) == 1
+    assert T.rank_of(1, 0) == 1 and T.rank_of(1, 1) == 0
+    with pytest.raises(ValueError):
+        TwoDimTabular(2, 2, 4, 4, [0], nodes=2)
+
+
+def test_vector_cyclic():
+    V = VectorTwoDimCyclic(4, 10, nodes=3, myrank=1)
+    assert [V.rank_of(m) for m in range(3)] == [0, 1, 2]
+    t = V.data_of(1)
+    assert t.copy_on(0).payload.shape == (4,)
+
+
+def test_hash_datadist():
+    H = HashDatadist(nodes=2, myrank=0)
+    H.set_rank("root", 0)
+    H.set_rank("leaf", 1)
+    assert H.rank_of("root") == 0 and H.rank_of("leaf") == 1
+    H.set_data("root", np.ones(3))
+    assert H.data_of("root").copy_on(0).payload.sum() == 3
+    with pytest.raises(KeyError):
+        H.data_of("leaf")
+    with pytest.raises(KeyError):
+        H.rank_of("unknown")
+
+
+def test_subtile_views_parent():
+    a = np.arange(16.0).reshape(4, 4)
+    A = TwoDimBlockCyclic(4, 4, 4, 4).from_array(a)
+    parent = A.data_of(0, 0)
+    sub = SubtileMatrix(parent, 2, 2)
+    assert sub.mt == sub.nt == 2
+    s = sub.data_of(1, 1).copy_on(0).payload
+    assert np.shares_memory(s, a)
+    assert s[0, 0] == a[2, 2]
+
+
+def test_dataref_syntax():
+    A = TwoDimBlockCyclic(2, 2, 4, 4)
+    ref = A(1, 1)
+    assert ref.rank == 0
+    assert ref.resolve() is A.data_of(1, 1)
+
+
+def test_dc_registry():
+    A = TwoDimBlockCyclic(2, 2, 4, 4)
+    dc_id = dc_register(A)
+    assert dc_lookup(dc_id) is A
+    dc_unregister(dc_id)
+    assert dc_lookup(dc_id) is None
+
+
+def test_write_only_access_needs_no_pull():
+    d = new_data(np.arange(4.0))
+    d.create_copy(1)
+    assert d.transfer_ownership(1, ACCESS_WRITE) is None
+    assert d.transfer_ownership(1, ACCESS_RW) is None  # now EXCLUSIVE locally
+
+
+def test_rw_access_on_stale_copy_pulls():
+    d = new_data(np.arange(4.0))
+    d.create_copy(1)
+    src = d.transfer_ownership(1, ACCESS_RW)
+    assert src is d.copy_on(0)
+
+
+def test_version_clock_never_regresses():
+    d = new_data(np.arange(4.0))
+    d.transfer_ownership(0, ACCESS_WRITE)
+    d.complete_write(0)                      # host v2
+    d.create_copy(1)
+    d.transfer_ownership(1, ACCESS_WRITE)    # invalidates host (v2)
+    d.complete_write(1)
+    assert d.copy_on(1).version == 3         # monotonic, above stale host
+    assert d.newest_copy() is d.copy_on(1)
+
+
+def test_sym_local_tiles_and_is_local():
+    S = SymTwoDimBlockCyclic(2, 2, 8, 8, uplo=SymTwoDimBlockCyclic.LOWER)
+    tiles = S.local_tiles()
+    assert (0, 1) not in tiles and (1, 0) in tiles
+    assert len(tiles) == 10                  # lower triangle of 4x4 tiles
+    assert not S.is_local(0, 1)
+
+
+def test_vector_array_roundtrip():
+    v = np.arange(10.0, dtype=np.float32)
+    V = VectorTwoDimCyclic(4, 10).from_array(v)
+    t = V.data_of(2)                         # edge tile len 2
+    assert t.copy_on(0).payload.shape == (2,)
+    assert np.shares_memory(V.to_array(), v)
+    V2 = VectorTwoDimCyclic(4, 10)
+    V2.data_of(0)
+    out = V2.to_array()
+    assert out.shape == (10,)
+
+
+def test_from_array_after_materialization_rejected():
+    A = TwoDimBlockCyclic(2, 2, 4, 4)
+    A.data_of(0, 0)
+    with pytest.raises(ValueError):
+        A.from_array(np.zeros((4, 4), np.float32))
