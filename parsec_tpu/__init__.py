@@ -24,3 +24,9 @@ Layer map (mirrors reference SURVEY.md §1):
 __version__ = "0.1.0"
 
 from parsec_tpu.utils import mca  # noqa: F401
+from parsec_tpu.core.context import Context  # noqa: F401
+from parsec_tpu.core.taskpool import (Compound, ParameterizedTaskpool,  # noqa: F401
+                                      Taskpool, compose)
+from parsec_tpu.core.task import (CTL, NULL, READ, RW, WRITE, Dep, Flow,  # noqa: F401
+                                  FromDesc, FromTask, HookReturn, New, Task,
+                                  TaskClass, ToDesc, ToTask)

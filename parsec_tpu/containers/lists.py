@@ -139,18 +139,24 @@ class OrderedList:
         self._lock = threading.Lock()
         self._items: List[Any] = []
 
+    def _insert_sorted(self, item: Any) -> None:
+        p = _prio(item)
+        for idx, other in enumerate(self._items):
+            if _prio(other) < p:
+                self._items.insert(idx, item)
+                return
+        self._items.append(item)
+
     def push_sorted(self, item: Any) -> None:
         with self._lock:
-            p = _prio(item)
-            for idx, other in enumerate(self._items):
-                if _prio(other) < p:
-                    self._items.insert(idx, item)
-                    return
-            self._items.append(item)
+            self._insert_sorted(item)
 
     def chain_sorted(self, items: Iterable[Any]) -> None:
-        for it in items:
-            self.push_sorted(it)
+        """Insert a whole ring atomically (a concurrent consumer sees either
+        none or all of it — the scheduler's ready-ring contract)."""
+        with self._lock:
+            for it in items:
+                self._insert_sorted(it)
 
     def pop_front(self) -> Optional[Any]:
         with self._lock:

@@ -1,0 +1,200 @@
+"""Execution context: worker streams, scheduler, taskpool lifecycle.
+
+Rebuild of the reference's context tree (reference:
+include/parsec/execution_stream.h: parsec_context_t -> parsec_vp_t ->
+parsec_execution_stream_t; bring-up parsec.c:384-900): one Context per
+process holds N worker threads (execution streams), the selected scheduler,
+the device registry, and the set of active taskpools.  API mirrors
+parsec_init / parsec_context_add_taskpool / _start / _test / _wait / _fini
+(reference: parsec/runtime.h:170-323).
+
+TPU notes: worker threads orchestrate host-side task progression; the
+actual FLOPs run inside XLA executables dispatched by the device layer, so
+a handful of streams saturate a chip — the default nb_cores is deliberately
+small, not one-per-CPU-core.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from parsec_tpu.core import scheduling
+from parsec_tpu.core.task import Task
+from parsec_tpu.core.taskpool import Taskpool, TaskpoolState
+from parsec_tpu.core import termdet as termdet_mod
+from parsec_tpu.sched import create as create_scheduler
+from parsec_tpu.utils.mca import components, params
+from parsec_tpu.utils.output import debug_verbose, inform
+
+params.register("runtime_num_cores", 4, "worker execution streams")
+params.register("sched", "", "scheduler component selection")
+params.register("termdet", "", "termination-detection component selection")
+
+
+class ExecutionStream:
+    """One worker stream (reference: parsec_execution_stream_t)."""
+
+    def __init__(self, context: "Context", th_id: int, vp_id: int = 0):
+        self.context = context
+        self.th_id = th_id
+        self.vp_id = vp_id
+        self.nb_tasks_done = 0
+        self.sched_data: Any = None
+        self._pins_cbs = {}
+
+    def pins(self, event: str, task: Task) -> None:
+        """PINS instrumentation point (reference: PARSEC_PINS macros);
+        the profiling layer registers callbacks here."""
+        cbs = self.context._pins.get(event)
+        if cbs:
+            for cb in cbs:
+                cb(self, event, task)
+
+
+class Context:
+    """Process-wide runtime context (reference: parsec_context_t)."""
+
+    def __init__(self, nb_cores: Optional[int] = None,
+                 scheduler: Optional[str] = None,
+                 rank: int = 0, nranks: int = 1,
+                 argv: Optional[List[str]] = None):
+        if argv is not None:
+            params.parse_cmdline(argv)
+        self.rank = rank
+        self.nranks = nranks
+        self.nb_cores = nb_cores if nb_cores is not None \
+            else params.get("runtime_num_cores", 4)
+        self.finished = False
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._active_taskpools = 0
+        self._pending_start: List[Taskpool] = []
+        self._errors: List[tuple] = []
+        self._pins = {}
+        self.devices: List[Any] = []
+        self.comm = None               # comm engine (distributed layer)
+
+        # termination detection factory (per-taskpool module instances share
+        # this class; reference installs termdet per taskpool)
+        _, td_cls = components.select("termdet",
+                                      params.get("termdet", "") or None)
+        self._termdet_cls = td_cls
+        self._termdet = td_cls()
+
+        self.scheduler = create_scheduler(
+            scheduler or (params.get("sched", "") or None))
+        self.scheduler.install(self)
+
+        self.streams = [ExecutionStream(self, i) for i in range(self.nb_cores)]
+        for es in self.streams:
+            self.scheduler.flow_init(es)
+        self._threads = [
+            threading.Thread(target=scheduling.worker_loop, args=(es,),
+                             name=f"parsec-worker-{es.th_id}", daemon=True)
+            for es in self.streams]
+        for t in self._threads:
+            t.start()
+        debug_verbose(3, "context up: %d streams, scheduler=%s",
+                      self.nb_cores, self.scheduler.name)
+
+    # -- PINS registration -------------------------------------------------
+    def pins_register(self, event: str, cb: Callable) -> None:
+        self._pins.setdefault(event, []).append(cb)
+
+    def pins_unregister(self, event: str, cb: Callable) -> None:
+        if event in self._pins and cb in self._pins[event]:
+            self._pins[event].remove(cb)
+
+    # -- doorbell ----------------------------------------------------------
+    def ring_doorbell(self, n: int = 1) -> None:
+        with self._cond:
+            self._cond.notify(n)
+
+    def doorbell_wait(self, timeout: float) -> None:
+        with self._cond:
+            if not self.finished:
+                self._cond.wait(timeout)
+
+    # -- taskpool lifecycle ------------------------------------------------
+    def add_taskpool(self, tp: Taskpool, start: bool = False) -> None:
+        """reference: parsec_context_add_taskpool (scheduling.c:678)."""
+        with self._lock:
+            self._active_taskpools += 1
+            tp.attach(self, self._termdet)
+            self._pending_start.append(tp)
+        if start:
+            self.start()
+
+    def start(self) -> None:
+        """Fire startup hooks of attached pools
+        (reference: parsec_context_start:750)."""
+        while True:
+            with self._lock:
+                if not self._pending_start:
+                    return
+                tp = self._pending_start.pop(0)
+            ready = tp.startup()
+            if ready:
+                scheduling.schedule(self.streams[0], ready)
+            tp.ready()
+
+    def _taskpool_terminated(self, tp: Taskpool) -> None:
+        with self._cond:
+            self._active_taskpools -= 1
+            if self._active_taskpools == 0:
+                self._cond.notify_all()
+
+    def test(self) -> bool:
+        """Non-blocking completion check (reference: parsec_context_test)."""
+        with self._lock:
+            return self._active_taskpools == 0
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until all enqueued taskpools complete
+        (reference: parsec_context_wait:776)."""
+        self.start()
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._active_taskpools == 0 or self._errors,
+                timeout=timeout)
+        if self._errors:
+            exc, task = self._errors[0]
+            raise RuntimeError(f"task {task} failed") from exc
+        if not ok:
+            raise TimeoutError("parsec context wait timed out")
+
+    def record_error(self, exc: Exception, task: Task) -> None:
+        with self._cond:
+            self._errors.append((exc, task))
+            self._cond.notify_all()
+
+    # -- remote deps (filled in by the comm layer) ------------------------
+    def remote_dep_activate(self, es, task, flow, dep, succ_tc, succ_locals,
+                            copy) -> None:
+        if self.comm is None:
+            raise RuntimeError(
+                f"{task}: successor {succ_tc.name}{succ_locals} lives on "
+                f"rank {succ_tc.rank_of(succ_locals)} but no comm engine is "
+                "attached")
+        self.comm.remote_dep_activate(es, task, flow, dep, succ_tc,
+                                      succ_locals, copy)
+
+    # -- shutdown ----------------------------------------------------------
+    def fini(self) -> None:
+        """Stop workers (reference: parsec_fini)."""
+        with self._cond:
+            self.finished = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        stats = self.scheduler.display_stats(None)
+        if stats:
+            inform("scheduler stats: %s", stats)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.fini()
+        return False

@@ -1,0 +1,179 @@
+"""Dependency-resolution engine: the release-deps / activate-successors path.
+
+Rebuild of the reference's generic dep engine (reference: parsec.c:1694-1894
+``parsec_release_local_OUT_dependencies`` / ``parsec_release_dep_fct`` and
+the hashed dependency tracking of parsec_hash_find_deps): when a task
+completes, its output deps are evaluated; each local successor's
+dep-countdown record accumulates arrivals (with the produced data copies
+attached) and the successor instantiates exactly when the count reaches its
+expected number of task-fed inputs.  Remote successors are handed to the
+comm layer (remote-dep activation).
+
+All countdown mutations ride the deps-table bucket locks, mirroring the
+reference's atomic update_deps_with_counter (parsec_internal.h:355-366).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from parsec_tpu.containers.hash_table import REMOVE
+from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency,
+                                  DataCopy)
+from parsec_tpu.core.task import (Dep, Flow, FromDesc, FromTask, New, Null,
+                                  Task, TaskClass, ToDesc, ToTask)
+
+import numpy as np
+
+
+class PendingRecord:
+    """Dep-countdown record for a not-yet-ready task
+    (reference: parsec_dependency_t in hash mode)."""
+
+    __slots__ = ("expected", "arrivals", "inputs", "sources", "locals")
+
+    def __init__(self, expected: int, locals_: Dict[str, int]):
+        self.expected = expected
+        self.arrivals = 0
+        self.inputs: Dict[str, Optional[DataCopy]] = {}
+        self.sources: Dict[str, Tuple[TaskClass, Tuple]] = {}
+        self.locals = locals_
+
+
+def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
+                flow_name: str, copy: Optional[DataCopy],
+                source: Optional[Tuple[TaskClass, Tuple]]) -> Optional[Task]:
+    """Record one dependency arrival at a local successor; return the
+    instantiated Task exactly when it becomes ready."""
+    key = succ_tc.make_key(succ_locals)
+
+    def fn(rec):
+        if rec is None:
+            rec = PendingRecord(succ_tc.nb_task_inputs(succ_locals),
+                                dict(succ_locals))
+        rec.arrivals += 1
+        rec.inputs[flow_name] = copy
+        if source is not None:
+            rec.sources[flow_name] = source
+        if rec.arrivals >= rec.expected:
+            return REMOVE, rec
+        return rec, None
+
+    rec = taskpool.deps_table.mutate(key, fn)
+    if rec is None:
+        return None
+    task = Task(succ_tc, taskpool, rec.locals)
+    task.data.update(rec.inputs)
+    task.input_sources.update(rec.sources)
+    return task
+
+
+def prepare_input(es, task: Task) -> None:
+    """Bind every input flow to a concrete data copy
+    (reference: generated data_lookup, jdf2c.c:43).
+
+    Task-fed flows were bound at delivery time; collection reads resolve
+    through the coherency protocol; NEW flows allocate from the arena.
+    """
+    tp = task.taskpool
+    for flow in task.task_class.flows:
+        if flow.name in task.data:
+            continue
+        dep = flow.active_input(task.locals)
+        if dep is None or isinstance(dep.end, Null):
+            task.data[flow.name] = None
+            continue
+        end = dep.end
+        if isinstance(end, FromDesc):
+            ref = end.ref_fn(task.locals)
+            datum = ref.resolve()
+            copy = datum.copy_on(0)
+            if copy is None:
+                raise RuntimeError(f"{task}: no host copy for {ref}")
+            datum.transfer_ownership(0, flow.access)
+            task.data[flow.name] = copy
+        elif isinstance(end, New):
+            arena = tp.arenas.get(end.arena_name)
+            if arena is None:
+                raise RuntimeError(
+                    f"{task}: flow {flow.name} needs arena "
+                    f"{end.arena_name!r} but the taskpool has none")
+            task.data[flow.name] = arena.get_copy()
+        elif isinstance(end, FromTask):
+            raise RuntimeError(
+                f"{task}: task-fed flow {flow.name} reached prepare_input "
+                f"unbound — activation protocol error")
+        else:
+            task.data[flow.name] = None
+
+
+def _writeback(task: Task, flow: Flow, copy: DataCopy, ref) -> None:
+    """Write a produced copy back into its collection datum
+    (``-> A(m, n)`` on a copy that is not A(m,n)'s own)."""
+    datum = ref.resolve()
+    host = datum.copy_on(0)
+    if host is None or copy is host or copy.data is datum:
+        return  # body wrote the collection tile in place
+    np.copyto(np.asarray(host.payload), np.asarray(copy.payload))
+    datum.transfer_ownership(0, ACCESS_WRITE)
+    datum.complete_write(0)
+
+
+def release_deps(es, task: Task) -> List[Task]:
+    """Evaluate output deps of a completed task, deliver to successors,
+    manage repo lifetime; return newly-ready local tasks
+    (reference: generated release_deps + iterate_successors,
+    jdf2c.c:7175,7631 -> parsec.c:1783)."""
+    tp = task.taskpool
+    tc = task.task_class
+    myrank = tp.context.rank if tp.context else 0
+    ready: List[Task] = []
+    consumers = 0
+    entry = None
+
+    for flow in tc.flows:
+        copy = task.data.get(flow.name)
+        for dep in flow.active_outputs(task.locals):
+            end = dep.end
+            if isinstance(end, ToDesc):
+                if copy is not None:
+                    _writeback(task, flow, copy, end.ref_fn(task.locals))
+            elif isinstance(end, ToTask):
+                succ_tc = tp.task_classes[end.task_class]
+                succ_locals = end.params_fn(task.locals)
+                if succ_tc.rank_of(succ_locals) != myrank:
+                    tp.context.remote_dep_activate(
+                        es, task, flow, dep, succ_tc, succ_locals, copy)
+                    continue
+                if entry is None and copy is not None:
+                    entry = tc.repo.lookup_entry_and_create(task.key)
+                if copy is not None:
+                    entry.copies[flow.flow_index] = copy
+                    consumers += 1
+                src = (tc, task.key) if copy is not None else None
+                t = deliver_dep(tp, succ_tc, succ_locals,
+                                end.flow, copy, src)
+                if t is not None:
+                    ready.append(t)
+            # Null outputs: data is discarded (arena copies will be
+            # released by the repo retirement below, or were views)
+
+    if entry is not None:
+        entry.on_retire = _make_retire(task)
+        tc.repo.entry_addto_usage_limit(task.key, consumers)
+    return ready
+
+
+def _make_retire(task: Task):
+    def retire(entry):
+        for copy in entry.copies:
+            if copy is not None and copy.arena is not None:
+                copy.arena.release_copy(copy)
+    return retire
+
+
+def consume_inputs(task: Task) -> None:
+    """Release our holds on predecessor repo entries
+    (reference: data_repo_entry_used_once calls in generated release_deps)."""
+    for flow_name, (ptc, pkey) in task.input_sources.items():
+        ptc.repo.entry_used_once(pkey)

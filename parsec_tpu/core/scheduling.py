@@ -1,0 +1,125 @@
+"""Task lifecycle progression and the worker loop.
+
+Rebuild of the reference's scheduling core (reference: parsec/scheduling.c):
+``task_progress`` is __parsec_task_progress:472 (prepare_input -> execute ->
+complete), ``execute`` iterates incarnations like __parsec_execute:124, and
+``worker_loop`` is the hot loop of __parsec_context_wait:537-676 with
+exponential backoff on scheduler misses.  ``schedule`` is __parsec_schedule,
+entering tasks through the pluggable scheduler and ringing the doorbell.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from parsec_tpu.core import engine
+from parsec_tpu.core.task import HookReturn, Task, TaskStatus
+from parsec_tpu.data.data import ACCESS_WRITE
+from parsec_tpu.utils.output import debug_verbose, warning
+
+
+def schedule(es, tasks: List[Task], distance: int = 0) -> None:
+    """Enter ready tasks into the scheduler (reference: __parsec_schedule)."""
+    if not tasks:
+        return
+    for t in tasks:
+        t.status = TaskStatus.READY
+    es.context.scheduler.schedule(es, tasks, distance)
+    es.context.ring_doorbell(len(tasks))
+
+
+def execute(es, task: Task) -> HookReturn:
+    """Iterate incarnations by preference until one takes the task
+    (reference: __parsec_execute chore loop, scheduling.c:138-198)."""
+    tc = task.task_class
+    for idx, (dev_type, hook) in enumerate(list(tc.incarnations)):
+        if not (task.chore_mask & (1 << idx)):
+            continue
+        if tc.chore_disabled_mask & (1 << idx):
+            continue
+        ret = hook(es, task)
+        if not isinstance(ret, HookReturn):
+            # bodies opt into lifecycle control by returning HookReturn/int;
+            # any other return value (arrays, bools, None...) means DONE
+            ret = (HookReturn(ret)
+                   if isinstance(ret, int) and not isinstance(ret, bool)
+                   else HookReturn.DONE)
+        if ret == HookReturn.NEXT:
+            task.chore_mask &= ~(1 << idx)
+            continue
+        if ret == HookReturn.DISABLE:
+            # disable class-wide without mutating the list (indices — and
+            # other tasks' chore masks — stay stable)
+            tc.chore_disabled_mask |= 1 << idx
+            continue
+        return ret
+    warning("%s: no incarnation accepted the task", task)
+    return HookReturn.ERROR
+
+
+def task_progress(es, task: Task, distance: int = 0) -> None:
+    """Run one task through its lifecycle
+    (reference: __parsec_task_progress)."""
+    es.pins("exec_begin", task)
+    try:
+        if task.status < TaskStatus.PREPARED:
+            engine.prepare_input(es, task)
+            task.status = TaskStatus.PREPARED
+        task.status = TaskStatus.RUNNING
+        ret = execute(es, task)
+    except Exception as exc:  # body/binding error: fail the context
+        es.context.record_error(exc, task)
+        complete_execution(es, task, failed=True)
+        return
+    if ret == HookReturn.DONE:
+        es.pins("exec_end", task)
+        complete_execution(es, task)
+    elif ret == HookReturn.ASYNC:
+        # a device module owns the task now; it will call complete_execution
+        es.pins("exec_async", task)
+    elif ret == HookReturn.AGAIN:
+        task.status = TaskStatus.READY
+        schedule(es, [task], distance + 1)
+    else:
+        es.context.record_error(
+            RuntimeError(f"{task} failed with {ret!r}"), task)
+        complete_execution(es, task, failed=True)
+
+
+def complete_execution(es, task: Task, failed: bool = False) -> None:
+    """Completion: version bumps, release deps, repo holds, termdet
+    (reference: __parsec_complete_execution:441)."""
+    tc = task.task_class
+    if not failed:
+        for flow in tc.flows:
+            if flow.access & ACCESS_WRITE:
+                copy = task.data.get(flow.name)
+                if copy is not None and copy.data is not None:
+                    copy.data.complete_write(copy.device)
+        ready = engine.release_deps(es, task)
+        if ready:
+            schedule(es, ready)
+    engine.consume_inputs(task)
+    task.status = TaskStatus.COMPLETE
+    es.pins("complete_exec", task)
+    es.nb_tasks_done += 1
+    task.taskpool.termdet.taskpool_addto_nb_tasks(task.taskpool, -1)
+
+
+def worker_loop(es) -> None:
+    """Steady-state worker (reference: __parsec_context_wait hot loop)."""
+    ctx = es.context
+    sched = ctx.scheduler
+    misses = 0
+    while not ctx.finished:
+        task = sched.select(es)
+        if task is None:
+            misses += 1
+            # exponential backoff on miss (reference: scheduling.c:596-635)
+            ctx.doorbell_wait(min(0.0002 * (1 << min(misses, 8)), 0.05))
+            continue
+        misses = 0
+        es.pins("select", task)
+        task_progress(es, task)
+    debug_verbose(9, "worker %d: %d tasks", es.th_id, es.nb_tasks_done)

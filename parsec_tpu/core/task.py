@@ -1,0 +1,301 @@
+"""Task model: task classes, flows, dependencies, task instances.
+
+Rebuild of the reference's task-class vtable
+(reference: parsec/parsec_internal.h:381-425 ``parsec_task_class_t``): a
+TaskClass describes one parameterized family of tasks — its parameter
+space, its data flows with guarded input/output dependencies, its affinity
+(owner-computes placement), and its per-device-type incarnations (hooks).
+A Task is one instantiation with concrete parameter values.
+
+Dependency endpoints mirror the JDF notions (reference:
+interfaces/ptg/ptg-compiler/jdf.h): a flow input comes from another task's
+output flow, from the data collection (``A(k)``), from a fresh arena
+allocation (NEW), or nowhere (NULL); outputs symmetrically go to successor
+tasks and/or back to the collection.
+"""
+
+from __future__ import annotations
+
+import itertools
+from enum import IntEnum
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple, Union)
+
+from parsec_tpu.data.data import (ACCESS_NONE, ACCESS_READ, ACCESS_RW,
+                                  ACCESS_WRITE, DataCopy)
+from parsec_tpu.data.collection import DataRef
+
+
+class HookReturn(IntEnum):
+    """Hook return codes (reference: parsec_hook_return_t)."""
+    DONE = 0       # body executed, completion may proceed
+    AGAIN = 1      # reschedule this task later (with fairness distance)
+    ASYNC = 2      # device took ownership; completion arrives asynchronously
+    NEXT = 3       # this incarnation declined; try the next chore
+    DISABLE = 4    # disable this incarnation for the whole task class
+    ERROR = -1
+
+
+# --------------------------------------------------------------------------
+# Dependency endpoints
+# --------------------------------------------------------------------------
+
+class DepEnd:
+    """Base endpoint of a dependency edge."""
+    __slots__ = ()
+
+
+class FromTask(DepEnd):
+    """Input comes from task_class.flow of the instance params_fn(locals)
+    (reference: jdf dep ``A <- B TASK(k-1)``)."""
+    __slots__ = ("task_class", "flow", "params_fn")
+
+    def __init__(self, task_class: str, flow: str,
+                 params_fn: Callable[[Dict[str, int]], Dict[str, int]]):
+        self.task_class = task_class
+        self.flow = flow
+        self.params_fn = params_fn
+
+
+class ToTask(DepEnd):
+    """Output feeds task_class.flow of params_fn(locals)."""
+    __slots__ = ("task_class", "flow", "params_fn")
+
+    def __init__(self, task_class: str, flow: str,
+                 params_fn: Callable[[Dict[str, int]], Dict[str, int]]):
+        self.task_class = task_class
+        self.flow = flow
+        self.params_fn = params_fn
+
+
+class FromDesc(DepEnd):
+    """Input read directly from a data collection: ``<- A(k, n)``."""
+    __slots__ = ("ref_fn",)
+
+    def __init__(self, ref_fn: Callable[[Dict[str, int]], DataRef]):
+        self.ref_fn = ref_fn
+
+
+class ToDesc(DepEnd):
+    """Output written back to the collection: ``-> A(k, n)``."""
+    __slots__ = ("ref_fn",)
+
+    def __init__(self, ref_fn: Callable[[Dict[str, int]], DataRef]):
+        self.ref_fn = ref_fn
+
+
+class New(DepEnd):
+    """Input is a fresh arena allocation (JDF ``<- NEW``)."""
+    __slots__ = ("arena_name",)
+
+    def __init__(self, arena_name: str = "default"):
+        self.arena_name = arena_name
+
+
+class Null(DepEnd):
+    """No data (JDF ``<- NULL`` / ``-> NULL``)."""
+    __slots__ = ()
+
+
+NULL = Null()
+
+
+class Dep:
+    """One guarded dependency (reference: jdf_dep_t with guard).
+
+    ``guard(locals) -> bool`` decides applicability; ``end`` is the other
+    endpoint; ``dtt`` optionally names the datatype/layout for reshapes;
+    ``count(locals)`` is the edge multiplicity for gather deps — the JDF
+    range form ``<- CTL First(0..3)`` is one dep representing 4 incoming
+    edges, and the dep countdown must expect all of them.
+    """
+    __slots__ = ("guard", "end", "dtt", "count")
+
+    def __init__(self, end: DepEnd,
+                 guard: Optional[Callable[[Dict[str, int]], bool]] = None,
+                 dtt: Any = None,
+                 count: Optional[Callable[[Dict[str, int]], int]] = None):
+        self.end = end
+        self.guard = guard
+        self.dtt = dtt
+        self.count = count
+
+    def applies(self, locals_: Dict[str, int]) -> bool:
+        return True if self.guard is None else bool(self.guard(locals_))
+
+    def multiplicity(self, locals_: Dict[str, int]) -> int:
+        return 1 if self.count is None else int(self.count(locals_))
+
+
+class Flow:
+    """One named data flow of a task class (reference: parsec_flow_t)."""
+
+    __slots__ = ("name", "access", "inputs", "outputs", "flow_index")
+
+    def __init__(self, name: str, access: int,
+                 inputs: Sequence[Dep] = (), outputs: Sequence[Dep] = ()):
+        self.name = name
+        self.access = access
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.flow_index = -1   # assigned by TaskClass
+
+    def active_input(self, locals_: Dict[str, int]) -> Optional[Dep]:
+        """The single input dep applying for these params (JDF semantics:
+        guards are mutually exclusive)."""
+        for dep in self.inputs:
+            if dep.applies(locals_):
+                return dep
+        return None
+
+    def active_outputs(self, locals_: Dict[str, int]) -> List[Dep]:
+        return [dep for dep in self.outputs if dep.applies(locals_)]
+
+    @property
+    def is_ctl(self) -> bool:
+        return self.access == ACCESS_NONE
+
+
+def RW(name: str, inputs=(), outputs=()) -> Flow:
+    return Flow(name, ACCESS_RW, inputs, outputs)
+
+
+def READ(name: str, inputs=(), outputs=()) -> Flow:
+    return Flow(name, ACCESS_READ, inputs, outputs)
+
+
+def WRITE(name: str, inputs=(), outputs=()) -> Flow:
+    return Flow(name, ACCESS_WRITE, inputs, outputs)
+
+
+def CTL(name: str, inputs=(), outputs=()) -> Flow:
+    return Flow(name, ACCESS_NONE, inputs, outputs)
+
+
+# --------------------------------------------------------------------------
+# Task class
+# --------------------------------------------------------------------------
+
+class TaskClass:
+    """Parameterized task family (reference: parsec_task_class_t).
+
+    ``params``: ordered (name, range_fn) pairs; range_fn(globals, locals)
+    yields the values of that parameter given the outer ones — triangular
+    spaces like ``m in k+1..NT`` fall out naturally.
+    ``affinity``: locals -> DataRef; the task runs on rank_of that datum
+    (owner computes, reference: jdf2c.c:2005 affinity generation).
+    ``incarnations``: ordered (device_type, hook) preference list
+    (reference: __parsec_chore_t).
+    """
+
+    def __init__(self, name: str,
+                 params: Sequence[Tuple[str, Callable]] = (),
+                 affinity: Optional[Callable[[Dict[str, int]], DataRef]] = None,
+                 flows: Sequence[Flow] = (),
+                 body: Optional[Callable] = None,
+                 incarnations: Sequence[Tuple[str, Callable]] = (),
+                 priority: Optional[Callable[[Dict[str, int]], int]] = None,
+                 properties: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.params = list(params)
+        self.affinity = affinity
+        self.flows = list(flows)
+        for i, f in enumerate(self.flows):
+            f.flow_index = i
+        self._flow_by_name = {f.name: f for f in self.flows}
+        self.incarnations = list(incarnations)
+        if body is not None:
+            self.incarnations.append(("cpu", body))
+        self.chore_disabled_mask = 0   # class-wide disabled incarnations
+        self.priority = priority
+        self.properties = dict(properties or {})
+        self.task_class_id = -1    # assigned by the taskpool
+        self.repo = None           # DataRepo, created by the taskpool
+        self.taskpool = None
+
+    def flow(self, name: str) -> Flow:
+        return self._flow_by_name[name]
+
+    # -- key machinery (reference: make_key / task_snprintf) --------------
+    def make_key(self, locals_: Dict[str, int]) -> Tuple:
+        return (self.name,) + tuple(locals_[p] for p, _ in self.params)
+
+    def key_to_locals(self, key: Tuple) -> Dict[str, int]:
+        return {p: key[1 + i] for i, (p, _) in enumerate(self.params)}
+
+    # -- parameter space ---------------------------------------------------
+    def iter_space(self, globals_: Dict[str, Any]) -> Iterable[Dict[str, int]]:
+        """Enumerate the full parameter space (generated startup loops in the
+        reference, jdf2c.c:2989)."""
+        def rec(i: int, locals_: Dict[str, int]):
+            if i == len(self.params):
+                yield dict(locals_)
+                return
+            name, range_fn = self.params[i]
+            for v in range_fn(globals_, locals_):
+                locals_[name] = v
+                yield from rec(i + 1, locals_)
+                del locals_[name]
+        yield from rec(0, {})
+
+    def nb_task_inputs(self, locals_: Dict[str, int]) -> int:
+        """How many input flows are fed by other tasks — the dep-countdown
+        goal for this instance (reference: update_deps_with_counter)."""
+        n = 0
+        for f in self.flows:
+            dep = f.active_input(locals_)
+            if dep is not None and isinstance(dep.end, FromTask):
+                n += dep.multiplicity(locals_)
+        return n
+
+    def rank_of(self, locals_: Dict[str, int]) -> int:
+        if self.affinity is None:
+            return 0
+        return self.affinity(locals_).rank
+
+    def __repr__(self):
+        return f"<TaskClass {self.name}>"
+
+
+# --------------------------------------------------------------------------
+# Task instance
+# --------------------------------------------------------------------------
+
+class TaskStatus(IntEnum):
+    PENDING = 0
+    READY = 1
+    PREPARED = 2
+    RUNNING = 3
+    COMPLETE = 4
+
+
+_task_seq = itertools.count()
+
+
+class Task:
+    """One task instance (reference: parsec_task_t)."""
+
+    __slots__ = ("task_class", "taskpool", "locals", "key", "priority",
+                 "status", "data", "input_sources", "chore_mask", "seq",
+                 "device", "prof")
+
+    def __init__(self, task_class: TaskClass, taskpool, locals_: Dict[str, int]):
+        self.task_class = task_class
+        self.taskpool = taskpool
+        self.locals = dict(locals_)
+        self.key = task_class.make_key(self.locals)
+        self.priority = (task_class.priority(self.locals)
+                         if task_class.priority else 0)
+        self.status = TaskStatus.PENDING
+        #: flow name -> DataCopy bound for this execution
+        self.data: Dict[str, Optional[DataCopy]] = {}
+        #: flow name -> (producer TaskClass, producer key) for repo release
+        self.input_sources: Dict[str, Tuple[TaskClass, Tuple]] = {}
+        self.chore_mask = 0xFFFF
+        self.seq = next(_task_seq)
+        self.device = None
+        self.prof = None
+
+    def __repr__(self):
+        args = ",".join(f"{k}={v}" for k, v in self.locals.items())
+        return f"{self.task_class.name}({args})"

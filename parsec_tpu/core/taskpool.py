@@ -1,0 +1,197 @@
+"""Taskpools: DAG containers with lifecycle and termination detection.
+
+Rebuild of the reference's taskpool object
+(reference: parsec/parsec_internal.h:119-161 ``parsec_taskpool_t``,
+scheduling.c:678-727 add_taskpool, compound.c): a taskpool owns task
+classes, global symbols, arenas, and the two termination counters
+(``nb_tasks`` = known-but-unexecuted tasks, ``nb_pending_actions`` =
+runtime activities incl. the pool's own startup hold).  ``Compound``
+chains taskpools sequentially by completion callbacks.
+
+``ParameterizedTaskpool`` is the engine behind the PTG front-end: its
+startup hook enumerates the parameter space, counts local tasks, and
+schedules dependency-free ones (reference: generated startup,
+jdf2c.c:2989,4398).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from enum import IntEnum
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from parsec_tpu.containers.hash_table import ConcurrentHashTable
+from parsec_tpu.data.arena import Arena
+from parsec_tpu.data.datarepo import DataRepo
+from parsec_tpu.core.task import Task, TaskClass
+
+_tp_ids = itertools.count(1)
+
+
+class TaskpoolState(IntEnum):
+    CREATED = 0
+    ATTACHED = 1
+    RUNNING = 2
+    DONE = 3
+
+
+class Taskpool:
+    """Base taskpool (reference: parsec_taskpool_t)."""
+
+    def __init__(self, name: str = "taskpool",
+                 globals_: Optional[Dict[str, Any]] = None):
+        self.taskpool_id = next(_tp_ids)
+        self.name = name
+        self.globals = dict(globals_ or {})
+        self.context = None
+        self.termdet = None
+        self.state = TaskpoolState.CREATED
+        self.nb_tasks = 0              # mutated only through termdet
+        self.nb_pending_actions = 0    # idem
+        self.task_classes: Dict[str, TaskClass] = {}
+        self.arenas: Dict[str, Arena] = {}
+        #: dep-countdown records for not-yet-ready tasks
+        self.deps_table = ConcurrentHashTable()
+        self._complete_cbs: List[Callable[["Taskpool"], None]] = []
+        self._done_event = threading.Event()
+        self.priority = 0
+
+    # -- construction ------------------------------------------------------
+    def add_task_class(self, tc: TaskClass) -> TaskClass:
+        tc.task_class_id = len(self.task_classes)
+        tc.taskpool = self
+        tc.repo = DataRepo(nb_flows=len(tc.flows), name=tc.name)
+        self.task_classes[tc.name] = tc
+        return tc
+
+    def add_arena(self, name: str, arena: Arena) -> None:
+        self.arenas[name] = arena
+
+    def on_complete(self, cb: Callable[["Taskpool"], None]) -> None:
+        self._complete_cbs.append(cb)
+
+    # -- lifecycle (driven by the Context) ---------------------------------
+    def attach(self, context, termdet) -> None:
+        """Install termination detection and take the startup hold
+        (reference: parsec_context_add_taskpool, scheduling.c:692-697)."""
+        self.context = context
+        self.termdet = termdet
+        termdet.monitor(self, self._terminated)
+        # the pool holds one pending action until startup completed, so an
+        # empty pool cannot terminate before being made ready
+        termdet.taskpool_addto_runtime_actions(self, 1)
+        self.state = TaskpoolState.ATTACHED
+
+    def startup(self) -> List[Task]:
+        """Produce the initial ready tasks; return them for scheduling.
+        Subclasses implement enumeration; base pools start empty."""
+        return []
+
+    def ready(self) -> None:
+        """Startup done: drop the hold and let termination fire
+        (reference: parsec_taskpool_enable / termdet ready)."""
+        self.state = TaskpoolState.RUNNING
+        self.termdet.taskpool_ready(self)
+        self.termdet.taskpool_addto_runtime_actions(self, -1)
+
+    def _terminated(self) -> None:
+        self.state = TaskpoolState.DONE
+        cbs = list(self._complete_cbs)
+        for cb in cbs:
+            cb(self)
+        if self.context is not None:
+            self.context._taskpool_terminated(self)
+        self._done_event.set()
+
+    def wait_local(self, timeout: Optional[float] = None) -> bool:
+        return self._done_event.wait(timeout)
+
+    @property
+    def completed(self) -> bool:
+        return self.state == TaskpoolState.DONE
+
+    def __repr__(self):
+        return f"<Taskpool {self.name}#{self.taskpool_id} {self.state.name}>"
+
+
+class ParameterizedTaskpool(Taskpool):
+    """Taskpool whose DAG is a parameterized (problem-size-independent)
+    graph — the PTG execution engine.  Each rank enumerates only its own
+    tasks (owner computes)."""
+
+    def startup(self) -> List[Task]:
+        myrank = self.context.rank if self.context else 0
+        nb_local = 0
+        ready: List[Task] = []
+        for tc in self.task_classes.values():
+            for locals_ in tc.iter_space(self.globals):
+                if tc.rank_of(locals_) != myrank:
+                    continue
+                nb_local += 1
+                if tc.nb_task_inputs(locals_) == 0:
+                    ready.append(Task(tc, self, locals_))
+        if nb_local:
+            self.termdet.taskpool_addto_nb_tasks(self, nb_local)
+        return ready
+
+
+class Compound(Taskpool):
+    """Sequential composition (reference: parsec_compose, compound.c):
+    completion of pool N enqueues pool N+1."""
+
+    def __init__(self, pools: Sequence[Taskpool], name: str = "compound"):
+        super().__init__(name=name)
+        self.pools = list(pools)
+        self._idx = 0
+        self._clock = threading.Lock()
+        self._driving = False
+
+    def attach(self, context, termdet) -> None:
+        super().attach(context, termdet)
+        # the compound holds one action per sub-pool still to run
+        termdet.taskpool_addto_runtime_actions(self, len(self.pools))
+
+    def startup(self) -> List[Task]:
+        self._drive()
+        return []
+
+    def _drive(self) -> None:
+        """Launch sub-pools iteratively.  Empty/instantly-completing pools
+        fire their completion callback synchronously inside add_taskpool;
+        the _driving flag turns that reentrancy into a loop iteration
+        instead of recursion, so long compositions cannot overflow the
+        stack."""
+        while True:
+            with self._clock:
+                if self._driving or self._idx >= len(self.pools):
+                    return
+                self._driving = True
+                launched = self._idx
+                pool = self.pools[launched]
+            pool.on_complete(self._sub_done)
+            self.context.add_taskpool(pool, start=True)
+            with self._clock:
+                self._driving = False
+                advanced = self._idx > launched
+            if not advanced:
+                return   # still running; its completion re-enters _drive
+
+    def _sub_done(self, pool: Taskpool) -> None:
+        with self._clock:
+            self._idx += 1
+            driving = self._driving
+        self.termdet.taskpool_addto_runtime_actions(self, -1)
+        if not driving:
+            self._drive()
+
+
+def compose(*pools: Taskpool) -> Compound:
+    """parsec_compose equivalent; flattens nested compounds."""
+    flat: List[Taskpool] = []
+    for p in pools:
+        if isinstance(p, Compound):
+            flat.extend(p.pools)
+        else:
+            flat.append(p)
+    return Compound(flat)

@@ -1,0 +1,122 @@
+"""Termination detection modules.
+
+Rebuild of the reference's termdet MCA framework
+(reference: parsec/mca/termdet/termdet.h state machine
+NOT_MONITORED -> NOT_READY -> BUSY -> IDLE -> TERMINATED, and the rule that
+a taskpool's nb_tasks / nb_pending_actions may only move through the
+module, parsec_internal.h:123-143).
+
+``LocalTermdet`` is the default single-process module (reference:
+termdet/local): termination fires when both counters reach zero after the
+taskpool was made ready.  The distributed four-counter module lives in
+parsec_tpu/comm once the comm engine exists; it plugs into the same
+interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from enum import IntEnum
+from typing import Callable, Optional
+
+from parsec_tpu.utils.mca import components
+
+
+class TermdetState(IntEnum):
+    NOT_MONITORED = 0
+    NOT_READY = 1      # counters may move, termination cannot fire yet
+    BUSY = 2
+    IDLE = 3
+    TERMINATED = 4
+
+
+class Termdet:
+    """Module interface (reference: parsec_termdet_module_t)."""
+
+    name = "base"
+
+    def monitor(self, taskpool, on_termination: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+    def unmonitor(self, taskpool) -> None:
+        pass
+
+    def taskpool_ready(self, taskpool) -> None:
+        raise NotImplementedError
+
+    def taskpool_addto_nb_tasks(self, taskpool, delta: int) -> int:
+        raise NotImplementedError
+
+    def taskpool_addto_runtime_actions(self, taskpool, delta: int) -> int:
+        raise NotImplementedError
+
+    # message-counting hooks for distributed modules (no-ops locally;
+    # reference: termdet.h:171-243)
+    def outgoing_message_start(self, taskpool, dst: int) -> None:
+        pass
+
+    def incoming_message_end(self, taskpool, src: int) -> None:
+        pass
+
+
+class LocalTermdet(Termdet):
+    """Counter-based local termination (reference: termdet/local module)."""
+
+    name = "local"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: dict = {}
+
+    def monitor(self, taskpool, on_termination: Callable[[], None]) -> None:
+        with self._lock:
+            self._state[id(taskpool)] = {
+                "state": TermdetState.NOT_READY,
+                "cb": on_termination,
+            }
+
+    def unmonitor(self, taskpool) -> None:
+        with self._lock:
+            self._state.pop(id(taskpool), None)
+
+    def _check(self, taskpool, st) -> bool:
+        return (st["state"] == TermdetState.BUSY
+                and taskpool.nb_tasks == 0
+                and taskpool.nb_pending_actions == 0)
+
+    def taskpool_ready(self, taskpool) -> None:
+        fire = False
+        with self._lock:
+            st = self._state[id(taskpool)]
+            if st["state"] == TermdetState.NOT_READY:
+                st["state"] = TermdetState.BUSY
+                fire = self._check(taskpool, st)
+                if fire:
+                    st["state"] = TermdetState.TERMINATED
+        if fire:
+            st["cb"]()
+
+    def _addto(self, taskpool, field: str, delta: int) -> int:
+        fire = False
+        with self._lock:
+            st = self._state.get(id(taskpool))
+            setattr(taskpool, field, getattr(taskpool, field) + delta)
+            val = getattr(taskpool, field)
+            if val < 0:
+                raise RuntimeError(
+                    f"{field} of {taskpool} went negative ({val})")
+            if st is not None and self._check(taskpool, st):
+                st["state"] = TermdetState.TERMINATED
+                fire = True
+        if fire:
+            st["cb"]()
+        return val
+
+    def taskpool_addto_nb_tasks(self, taskpool, delta: int) -> int:
+        return self._addto(taskpool, "nb_tasks", delta)
+
+    def taskpool_addto_runtime_actions(self, taskpool, delta: int) -> int:
+        return self._addto(taskpool, "nb_pending_actions", delta)
+
+
+components.add("termdet", "local", LocalTermdet, priority=50)

@@ -7,10 +7,13 @@ task key.  Successors look entries up and consume them; an entry retires
 usage-count/retirement protocol of datarepo.h:50-58, whose lifetime rules
 the dep engine must follow exactly to avoid leaks and use-after-free.
 
-All usage-count mutations happen under the hash table's bucket lock
-(ConcurrentHashTable.mutate), so an entry whose count reaches zero is
-removed in the same critical section — no revival race between a retiring
-consumer and a concurrent lookup_entry_and_create.
+Like the reference, an entry carries a usage *limit* (declared by the
+producer once it knows its consumer count) and a usage *count* (incremented
+by consumers); retirement requires BOTH that the limit was declared and
+that the count reached it — consumers racing ahead of the producer's
+declaration can never retire the entry early.  All mutations ride the hash
+table's bucket locks (ConcurrentHashTable.mutate) so retire-vs-revive races
+are structurally impossible.
 """
 
 from __future__ import annotations
@@ -21,12 +24,15 @@ from parsec_tpu.containers.hash_table import REMOVE, ConcurrentHashTable
 
 
 class RepoEntry:
-    __slots__ = ("key", "copies", "usage", "on_retire")
+    __slots__ = ("key", "copies", "usagelmt", "usagecnt", "declared",
+                 "on_retire")
 
     def __init__(self, key: Any, nb_flows: int):
         self.key = key
         self.copies: List[Optional[Any]] = [None] * nb_flows
-        self.usage = 0        # mutated only under the repo's bucket lock
+        self.usagelmt = 0      # mutated only under the repo's bucket lock
+        self.usagecnt = 0      # idem
+        self.declared = False  # producer has set the limit
         self.on_retire: Optional[Callable[["RepoEntry"], None]] = None
 
 
@@ -42,38 +48,39 @@ class DataRepo:
         return self._table.find(key)
 
     def lookup_entry_and_create(self, key: Any) -> RepoEntry:
-        """Find or atomically create the entry for ``key``, taking a usage
-        hold so it cannot retire under the caller
-        (reference: data_repo_lookup_entry_and_create)."""
+        """Find or atomically create the entry for ``key``
+        (reference: data_repo_lookup_entry_and_create).  The entry cannot
+        retire before the producer declares its usage limit."""
         def fn(cur):
             e = cur if cur is not None else RepoEntry(key, self.nb_flows)
-            e.usage += 1
             return e, e
         return self._table.mutate(key, fn)
 
-    def _addto_usage(self, key: Any, delta: int) -> Optional[RepoEntry]:
-        """Adjust usage; atomically remove on zero. Returns the entry to
-        retire (caller fires on_retire outside the lock) or None."""
+    def _mutate_counts(self, key: Any, fn_counts) -> None:
         def fn(cur):
             if cur is None:
                 raise KeyError(f"repo {self.name}: no entry {key}")
-            cur.usage += delta
-            if cur.usage == 0:
+            fn_counts(cur)
+            if cur.declared and cur.usagecnt >= cur.usagelmt:
                 return REMOVE, cur
             return cur, None
         entry = self._table.mutate(key, fn)
         if entry is not None and entry.on_retire is not None:
             entry.on_retire(entry)
-        return entry
 
     def entry_addto_usage_limit(self, key: Any, nb_usage: int) -> None:
-        """Producer declares how many consumers will use the entry and drops
-        its creation hold (reference: data_repo_entry_addto_usage_limit)."""
-        self._addto_usage(key, nb_usage - 1)
+        """Producer declares how many consumptions will occur
+        (reference: data_repo_entry_addto_usage_limit)."""
+        def bump(e):
+            e.usagelmt += nb_usage
+            e.declared = True
+        self._mutate_counts(key, bump)
 
     def entry_used_once(self, key: Any) -> None:
         """One consumer is done (reference: data_repo_entry_used_once)."""
-        self._addto_usage(key, -1)
+        def bump(e):
+            e.usagecnt += 1
+        self._mutate_counts(key, bump)
 
     def __len__(self) -> int:
         return len(self._table)

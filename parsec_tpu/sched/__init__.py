@@ -1,0 +1,57 @@
+"""Pluggable schedulers (MCA framework ``sched``).
+
+Rebuild of the reference's scheduler component framework
+(reference: parsec/mca/sched/sched.h:325-340 interface; module inventory
+SURVEY.md §2.4).  A scheduler provides install / per-stream flow_init /
+schedule(es, tasks, distance) / select(es) / display_stats / remove.
+The ``distance`` argument is the fairness contract of sched.h:58-99: a
+task rescheduled with growing distance must not be immediately re-selected
+by the same stream, or AGAIN-returning tasks livelock.
+
+Selection: ``--mca sched <name>`` (reference: parsec_set_scheduler).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from parsec_tpu.utils.mca import components
+from parsec_tpu.core.task import Task
+
+
+class Scheduler:
+    name = "base"
+
+    def install(self, context) -> None:
+        self.context = context
+
+    def flow_init(self, es) -> None:
+        pass
+
+    def schedule(self, es, tasks: List[Task], distance: int = 0) -> None:
+        raise NotImplementedError
+
+    def select(self, es) -> Optional[Task]:
+        raise NotImplementedError
+
+    def display_stats(self, es) -> str:
+        return ""
+
+    def remove(self, context) -> None:
+        pass
+
+
+def register(name: str, cls, priority: int = 0) -> None:
+    components.add("sched", name, cls, priority=priority)
+
+
+def create(name: Optional[str] = None) -> Scheduler:
+    selected, cls = components.select("sched", name)
+    inst = cls()
+    inst.name = selected
+    return inst
+
+
+# Import modules so they self-register.
+from parsec_tpu.sched import simple as _simple          # noqa: E402,F401
+from parsec_tpu.sched import local_queues as _lq        # noqa: E402,F401

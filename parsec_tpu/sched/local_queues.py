@@ -1,0 +1,215 @@
+"""Local-queue work-stealing schedulers: ll, lfq, pbq, ltq, lhq, llp.
+
+Reference modules: parsec/mca/sched/{ll,lfq,pbq,ltq,lhq,llp}/ and the
+shared helpers of sched_local_queues_utils.h: per-execution-stream queues
+(LIFOs, bounded hbbuffers, or heaps) with overflow to a system queue and
+locality-ordered stealing.  Without hwloc depth on this platform the
+hierarchy degenerates to (my queue) -> (neighbors by stream id) -> (system
+queue), which preserves each policy's ordering semantics if not its cache
+topology.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import List, Optional
+
+from parsec_tpu.containers.lists import Dequeue, Lifo, OrderedList
+from parsec_tpu.core.task import Task
+from parsec_tpu.sched import Scheduler, register
+from parsec_tpu.utils.mca import params
+
+params.register("sched_lfq_queue_size", 16,
+                "bounded local queue size before overflow to system queue")
+
+
+class _PerStream(Scheduler):
+    """Shared machinery: per-stream structure + steal + system queue.
+
+    Distance-rescheduled tasks always go to the back of the system queue —
+    the fairness contract (sched/__init__.py): an AGAIN task must not be
+    immediately re-selected by the same stream ahead of the work it waits
+    on.
+    """
+
+    def install(self, context):
+        super().install(context)
+        self._locals = {}
+        self._system = Dequeue()
+
+    def _defer(self, tasks, distance) -> bool:
+        if distance > 0:
+            self._system.chain_back(tasks)
+            return True
+        return False
+
+    def _make_local(self):
+        raise NotImplementedError
+
+    def flow_init(self, es):
+        self._locals[es.th_id] = self._make_local()
+
+    def _steal_order(self, es):
+        ids = sorted(self._locals)
+        me = ids.index(es.th_id) if es.th_id in ids else 0
+        return [self._locals[ids[(me + i) % len(ids)]]
+                for i in range(1, len(ids))]
+
+
+class LocalLifo(_PerStream):
+    """ll: one LIFO per stream, steal from others
+    (reference: sched_ll_module.c)."""
+
+    def _make_local(self):
+        return Lifo()
+
+    def schedule(self, es, tasks, distance=0):
+        if self._defer(tasks, distance):
+            return
+        q = self._locals.get(es.th_id)
+        if q is None:
+            self._system.chain_back(tasks)
+            return
+        q.push_chain(tasks)
+
+    def select(self, es):
+        q = self._locals.get(es.th_id)
+        if q is not None:
+            t = q.pop()
+            if t is not None:
+                return t
+        for other in self._steal_order(es):
+            t = other.pop()
+            if t is not None:
+                return t
+        return self._system.pop_front()
+
+
+class LocalFlatQueues(_PerStream):
+    """lfq: bounded per-stream buffer, overflow to the system queue,
+    locality-aware steal (reference: sched_lfq_module.c + hbbuffer)."""
+
+    def _make_local(self):
+        return Dequeue()
+
+    def schedule(self, es, tasks, distance=0):
+        if self._defer(tasks, distance):
+            return
+        q = self._locals.get(es.th_id)
+        cap = params.get("sched_lfq_queue_size", 16)
+        if q is None:
+            self._system.chain_back(tasks)
+            return
+        for t in tasks:
+            if len(q) < cap:
+                q.push_back(t)
+            else:
+                self._system.push_back(t)   # hbbuffer overflow to parent
+
+    def select(self, es):
+        q = self._locals.get(es.th_id)
+        if q is not None:
+            t = q.pop_front()
+            if t is not None:
+                return t
+        for other in self._steal_order(es):
+            t = other.pop_back()            # steal the cold end
+            if t is not None:
+                return t
+        return self._system.pop_front()
+
+
+class PriorityBasedQueues(_PerStream):
+    """pbq: priority-ordered local queues + bounded overflow
+    (reference: sched_pbq_module.c)."""
+
+    def _make_local(self):
+        return OrderedList()
+
+    def schedule(self, es, tasks, distance=0):
+        if self._defer(tasks, distance):
+            return
+        q = self._locals.get(es.th_id)
+        if q is None:
+            self._system.chain_back(tasks)
+            return
+        q.chain_sorted(tasks)
+
+    def select(self, es):
+        q = self._locals.get(es.th_id)
+        if q is not None:
+            t = q.pop_front()
+            if t is not None:
+                return t
+        for other in self._steal_order(es):
+            t = other.pop_back()            # steal lowest-priority end
+            if t is not None:
+                return t
+        return self._system.pop_front()
+
+
+class _HeapLocal:
+    """Lock-protected max-heap of tasks (reference: parsec/maxheap.c)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._heap = []
+        self._seq = itertools.count()
+
+    def push(self, tasks):
+        with self._lock:
+            for t in tasks:
+                heapq.heappush(self._heap, (-t.priority, next(self._seq), t))
+
+    def pop(self):
+        with self._lock:
+            return heapq.heappop(self._heap)[2] if self._heap else None
+
+
+class LocalTreeQueues(_PerStream):
+    """ltq: per-stream maxheaps with stealing
+    (reference: sched_ltq_module.c)."""
+
+    def _make_local(self):
+        return _HeapLocal()
+
+    def schedule(self, es, tasks, distance=0):
+        if self._defer(tasks, distance):
+            return
+        q = self._locals.get(es.th_id)
+        if q is None:
+            self._system.chain_back(tasks)
+            return
+        q.push(tasks)
+
+    def select(self, es):
+        q = self._locals.get(es.th_id)
+        if q is not None:
+            t = q.pop()
+            if t is not None:
+                return t
+        for other in self._steal_order(es):
+            t = other.pop()
+            if t is not None:
+                return t
+        return self._system.pop_front()
+
+
+class LocalHierQueues(LocalFlatQueues):
+    """lhq: hierarchical local queues; with a flat topology behaves as lfq
+    with deeper overflow (reference: sched_lhq_module.c)."""
+
+
+class LifoLocalPrio(LocalTreeQueues):
+    """llp: per-VP LIFO of priority heaps; degenerates to ltq on one VP
+    (reference: sched_llp_module.c)."""
+
+
+register("ll", LocalLifo, priority=40)
+register("lfq", LocalFlatQueues, priority=50)   # reference default
+register("pbq", PriorityBasedQueues, priority=35)
+register("ltq", LocalTreeQueues, priority=25)
+register("lhq", LocalHierQueues, priority=15)
+register("llp", LifoLocalPrio, priority=15)

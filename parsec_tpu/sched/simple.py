@@ -1,0 +1,122 @@
+"""Globally-shared-structure schedulers: gd, ip, ap, spq, rnd.
+
+Reference modules: parsec/mca/sched/{gd,ip,ap,spq,rnd}/ — the simplest
+correct policies, all built on one shared structure per virtual process.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+from typing import List, Optional
+
+from parsec_tpu.containers.lists import Dequeue, OrderedList
+from parsec_tpu.core.task import Task
+from parsec_tpu.sched import Scheduler, register
+
+
+class GlobalDequeue(Scheduler):
+    """gd: one global FIFO dequeue — push back, pop front
+    (reference: sched_gd_module.c)."""
+
+    def install(self, context):
+        super().install(context)
+        self._q = Dequeue()
+
+    def schedule(self, es, tasks, distance=0):
+        self._q.chain_back(tasks)
+
+    def select(self, es):
+        return self._q.pop_front()
+
+
+class InPlace(Scheduler):
+    """ip: LIFO-ordered global list — newly released tasks run first
+    (reference: sched_ip_module.c)."""
+
+    def install(self, context):
+        super().install(context)
+        self._q = Dequeue()
+
+    def schedule(self, es, tasks, distance=0):
+        if distance > 0:
+            self._q.chain_back(tasks)
+        else:
+            self._q.chain_front(tasks)
+
+    def select(self, es):
+        return self._q.pop_front()
+
+
+class AbsolutePriority(Scheduler):
+    """ap: single shared priority list (reference: sched_ap_module.c).
+    Distance-rescheduled tasks go to the cold end so an AGAIN task cannot
+    starve the work it waits on (fairness contract, sched/__init__.py)."""
+
+    def install(self, context):
+        super().install(context)
+        self._q = OrderedList()
+
+    def schedule(self, es, tasks, distance=0):
+        if distance > 0:
+            for t in tasks:
+                self._q.push_back(t)
+        else:
+            self._q.chain_sorted(tasks)
+
+    def select(self, es):
+        return self._q.pop_front()
+
+
+class SortedPriorityQueue(Scheduler):
+    """spq: sorted by scheduling distance then priority — the documented
+    example scheduler (reference: sched.h:87-99, sched_spq_module.c)."""
+
+    def install(self, context):
+        super().install(context)
+        self._lock = threading.Lock()
+        self._heap = []
+        self._seq = itertools.count()
+
+    def schedule(self, es, tasks, distance=0):
+        with self._lock:
+            for t in tasks:
+                heapq.heappush(self._heap,
+                               (distance, -t.priority, next(self._seq), t))
+
+    def select(self, es):
+        with self._lock:
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[3]
+
+
+class RandomSched(Scheduler):
+    """rnd: random selection from a global list
+    (reference: sched_rnd_module.c)."""
+
+    def install(self, context):
+        super().install(context)
+        self._lock = threading.Lock()
+        self._items: List[Task] = []
+
+    def schedule(self, es, tasks, distance=0):
+        with self._lock:
+            self._items.extend(tasks)
+
+    def select(self, es):
+        with self._lock:
+            if not self._items:
+                return None
+            i = random.randrange(len(self._items))
+            self._items[i], self._items[-1] = self._items[-1], self._items[i]
+            return self._items.pop()
+
+
+register("gd", GlobalDequeue, priority=10)
+register("ip", InPlace, priority=5)
+register("ap", AbsolutePriority, priority=20)
+register("spq", SortedPriorityQueue, priority=30)
+register("rnd", RandomSched, priority=1)

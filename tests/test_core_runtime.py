@@ -1,0 +1,381 @@
+"""Core-runtime tests: hand-built task classes through the full lifecycle
+(reference analogs: examples/Ex02_Chain, Ex04_ChainData, Ex05_Broadcast,
+tests/runtime/multichain — SURVEY.md §3.2 call stack)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import (Context, ParameterizedTaskpool, TaskClass, Dep, RW,
+                        READ, WRITE, CTL, FromDesc, FromTask, ToDesc, ToTask,
+                        New, compose)
+from parsec_tpu.data.arena import Arena
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.core.task import HookReturn
+
+
+def make_ctx(**kw):
+    kw.setdefault("nb_cores", 2)
+    return Context(**kw)
+
+
+def chain_taskpool(A, NT, body):
+    """Ex02/Ex04-style linear chain on tile A(0,0):
+    Step(0..NT-1), T flows through the chain and back to A."""
+    tp = ParameterizedTaskpool("chain", globals_={"NT": NT})
+    tc = TaskClass(
+        "Step",
+        params=[("k", lambda g, l: range(g["NT"]))],
+        affinity=lambda l: A(0, 0),
+        flows=[RW("T",
+                  inputs=[Dep(FromDesc(lambda l: A(0, 0)),
+                              guard=lambda l: l["k"] == 0),
+                          Dep(FromTask("Step", "T",
+                                       lambda l: {"k": l["k"] - 1}),
+                              guard=lambda l: l["k"] > 0)],
+                  outputs=[Dep(ToTask("Step", "T",
+                                      lambda l: {"k": l["k"] + 1}),
+                               guard=lambda l: l["k"] < NT - 1),
+                           Dep(ToDesc(lambda l: A(0, 0)),
+                               guard=lambda l: l["k"] == NT - 1)])],
+        body=body)
+    tp.add_task_class(tc)
+    return tp
+
+
+def test_chain_sequences_and_writes_back():
+    a = np.zeros((4, 4), np.float32)
+    A = TwoDimBlockCyclic(4, 4, 4, 4).from_array(a)
+    seen = []
+
+    def body(es, task):
+        k = task.locals["k"]
+        seen.append(k)
+        task.data["T"].payload += 1
+
+    with make_ctx() as ctx:
+        ctx.add_taskpool(chain_taskpool(A, 10, body))
+        ctx.wait(timeout=30)
+    assert seen == list(range(10))          # strict chain order
+    assert a[0, 0] == 10                    # all increments landed
+
+
+@pytest.mark.parametrize("sched", ["gd", "ip", "ap", "spq", "rnd", "ll",
+                                   "lfq", "pbq", "ltq", "lhq", "llp"])
+def test_all_schedulers_run_chain(sched):
+    a = np.zeros((2, 2), np.float32)
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(a)
+
+    def body(es, task):
+        task.data["T"].payload += 1
+
+    with make_ctx(scheduler=sched) as ctx:
+        ctx.add_taskpool(chain_taskpool(A, 6, body))
+        ctx.wait(timeout=30)
+    assert a[0, 0] == 6
+
+
+def test_broadcast_fanout():
+    """Ex05-style: one Root output fans out to N Reader tasks."""
+    N = 8
+    a = np.full((2, 2), 7.0, np.float32)
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(a)
+    got = []
+    lock = threading.Lock()
+
+    tp = ParameterizedTaskpool("bcast")
+
+    def root_body(es, task):
+        task.data["T"].payload *= 2
+
+    def reader_body(es, task):
+        with lock:
+            got.append((task.locals["i"], float(task.data["X"].payload[0, 0])))
+
+    root = TaskClass(
+        "Root", params=[],
+        affinity=lambda l: A(0, 0),
+        flows=[RW("T",
+                  inputs=[Dep(FromDesc(lambda l: A(0, 0)))],
+                  outputs=[Dep(ToTask("Reader", "X", lambda l, i=i: {"i": i}))
+                           for i in range(N)] +
+                          [Dep(ToDesc(lambda l: A(0, 0)))])],
+        body=root_body)
+    reader = TaskClass(
+        "Reader", params=[("i", lambda g, l: range(N))],
+        affinity=lambda l: A(0, 0),
+        flows=[READ("X", inputs=[Dep(FromTask("Root", "T", lambda l: {}))])],
+        body=reader_body)
+    tp.add_task_class(root)
+    tp.add_task_class(reader)
+
+    with make_ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert sorted(got) == [(i, 14.0) for i in range(N)]
+    assert a[0, 0] == 14.0
+
+
+def test_diamond_join_counts_two_inputs():
+    """Fork -> (Left, Right) -> Join: join waits for both arrivals."""
+    a = np.ones((2, 2), np.float32)
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(a)
+    order = []
+    lock = threading.Lock()
+
+    def mk_body(name, delta):
+        def body(es, task):
+            with lock:
+                order.append(name)
+            for c in task.data.values():
+                if c is not None and task.task_class.flows[0].access & 0x2:
+                    c.payload += delta
+        return body
+
+    tp = ParameterizedTaskpool("diamond")
+    arena = Arena((2, 2), np.float32)
+    tp.add_arena("default", arena)
+
+    fork = TaskClass(
+        "Fork", params=[], affinity=lambda l: A(0, 0),
+        flows=[RW("T", inputs=[Dep(FromDesc(lambda l: A(0, 0)))],
+                  outputs=[Dep(ToTask("Left", "L", lambda l: {})),
+                           Dep(ToTask("Right", "R", lambda l: {}))])],
+        body=mk_body("fork", 1))
+    left = TaskClass(
+        "Left", params=[], affinity=lambda l: A(0, 0),
+        flows=[READ("L", inputs=[Dep(FromTask("Fork", "T", lambda l: {}))]),
+               WRITE("O", inputs=[Dep(New("default"))],
+                     outputs=[Dep(ToTask("Join", "A", lambda l: {}))])],
+        body=lambda es, task: task.data["O"].payload.fill(
+            task.data["L"].payload[0, 0] + 10))
+    right = TaskClass(
+        "Right", params=[], affinity=lambda l: A(0, 0),
+        flows=[READ("R", inputs=[Dep(FromTask("Fork", "T", lambda l: {}))]),
+               WRITE("O", inputs=[Dep(New("default"))],
+                     outputs=[Dep(ToTask("Join", "B", lambda l: {}))])],
+        body=lambda es, task: task.data["O"].payload.fill(
+            task.data["R"].payload[0, 0] + 20))
+    out = {}
+
+    def join_body(es, task):
+        out["sum"] = float(task.data["A"].payload[0, 0]
+                           + task.data["B"].payload[0, 0])
+
+    join = TaskClass(
+        "Join", params=[], affinity=lambda l: A(0, 0),
+        flows=[READ("A", inputs=[Dep(FromTask("Left", "O", lambda l: {}))]),
+               READ("B", inputs=[Dep(FromTask("Right", "O", lambda l: {}))])],
+        body=join_body)
+    for tc in (fork, left, right, join):
+        tp.add_task_class(tc)
+
+    with make_ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    # fork ran first; join saw both arena outputs (2+10) + (2+20)
+    assert order[0] == "fork"
+    assert out["sum"] == 34.0
+    # arena copies were retired after join consumed them
+    assert arena.released == arena.allocated
+
+
+def test_ctl_flow_ordering():
+    """CTL edges order tasks with no data payload
+    (reference: examples Ex07 CTL)."""
+    order = []
+    lock = threading.Lock()
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(np.zeros((2, 2), np.float32))
+
+    tp = ParameterizedTaskpool("ctl", globals_={"N": 4})
+
+    def first_body(es, task):
+        with lock:
+            order.append(("first", task.locals["i"]))
+
+    def second_body(es, task):
+        with lock:
+            order.append(("second", 0))
+
+    first = TaskClass(
+        "First", params=[("i", lambda g, l: range(4))],
+        affinity=lambda l: A(0, 0),
+        flows=[CTL("C", outputs=[Dep(ToTask("Second", "C", lambda l: {}))])],
+        body=first_body)
+    # CTL gather: the JDF range form "<- CTL First(0..3)" is one dep with
+    # multiplicity 4 — Second must wait for all four arrivals.
+    second = TaskClass(
+        "Second", params=[], affinity=lambda l: A(0, 0),
+        flows=[CTL("C", inputs=[Dep(FromTask("First", "C", lambda l: {}),
+                                    count=lambda l: 4)])],
+        body=second_body)
+    tp.add_task_class(first)
+    tp.add_task_class(second)
+
+    with make_ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert order[-1] == ("second", 0)
+    assert len(order) == 5
+
+
+def test_compound_sequencing():
+    a = np.zeros((2, 2), np.float32)
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(a)
+    marks = []
+
+    def mk(mark):
+        def body(es, task):
+            marks.append(mark)
+            task.data["T"].payload += 1
+        return body
+
+    tp1 = chain_taskpool(A, 3, mk("a"))
+    tp2 = chain_taskpool(A, 3, mk("b"))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(compose(tp1, tp2))
+        ctx.wait(timeout=30)
+    assert marks == ["a"] * 3 + ["b"] * 3
+    assert a[0, 0] == 6
+
+
+def test_body_error_propagates():
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(np.zeros((2, 2), np.float32))
+
+    def body(es, task):
+        raise ValueError("kaboom")
+
+    with make_ctx() as ctx:
+        ctx.add_taskpool(chain_taskpool(A, 2, body))
+        with pytest.raises(RuntimeError, match="failed"):
+            ctx.wait(timeout=30)
+
+
+def test_again_reschedules():
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(np.zeros((2, 2), np.float32))
+    tries = {"n": 0}
+
+    def body(es, task):
+        tries["n"] += 1
+        if tries["n"] < 3:
+            return HookReturn.AGAIN
+        return HookReturn.DONE
+
+    tp = ParameterizedTaskpool("again")
+    tp.add_task_class(TaskClass(
+        "T", params=[], affinity=lambda l: A(0, 0),
+        flows=[READ("X", inputs=[Dep(FromDesc(lambda l: A(0, 0)))])],
+        body=body))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert tries["n"] == 3
+
+
+def test_priority_order_with_ap():
+    """Higher-priority startup tasks run first under the ap scheduler with
+    a single worker."""
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(np.zeros((2, 2), np.float32))
+    ran = []
+
+    tp = ParameterizedTaskpool("prio", globals_={"N": 6})
+    tp.add_task_class(TaskClass(
+        "P", params=[("i", lambda g, l: range(6))],
+        affinity=lambda l: A(0, 0),
+        priority=lambda l: l["i"],
+        flows=[READ("X", inputs=[Dep(FromDesc(lambda l: A(0, 0)))])],
+        body=lambda es, task: ran.append(task.locals["i"])))
+    with make_ctx(nb_cores=1, scheduler="ap") as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert ran == sorted(ran, reverse=True)
+
+
+def test_context_test_and_empty_pool():
+    with make_ctx() as ctx:
+        tp = ParameterizedTaskpool("empty")
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=10)
+        assert ctx.test()
+        assert tp.completed
+
+
+def test_disable_falls_through_to_next_incarnation():
+    """DISABLE must disable class-wide WITHOUT skipping the next chore
+    (reference: PARSEC_HOOK_RETURN_DISABLE semantics)."""
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(np.zeros((2, 2), np.float32))
+    hits = []
+    tc = TaskClass(
+        "D", params=[("i", lambda g, l: range(3))],
+        affinity=lambda l: A(0, 0),
+        incarnations=[("tpu", lambda es, t: hits.append("tpu")
+                       or HookReturn.DISABLE)],
+        flows=[READ("X", inputs=[Dep(FromDesc(lambda l: A(0, 0)))])],
+        body=lambda es, t: hits.append("cpu"))
+    tp = ParameterizedTaskpool("dis")
+    tp.add_task_class(tc)
+    with make_ctx(nb_cores=1) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    # first task tried tpu then fell through to cpu; later tasks skip tpu
+    assert hits.count("tpu") == 1
+    assert hits.count("cpu") == 3
+
+
+def test_body_returning_true_is_done_not_again():
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(np.zeros((2, 2), np.float32))
+    runs = []
+    tp = ParameterizedTaskpool("boolret")
+    tp.add_task_class(TaskClass(
+        "B", params=[], affinity=lambda l: A(0, 0),
+        flows=[READ("X", inputs=[Dep(FromDesc(lambda l: A(0, 0)))])],
+        body=lambda es, t: runs.append(1) or True))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=10)
+    assert runs == [1]
+
+
+@pytest.mark.parametrize("sched", ["ll", "ap", "ltq", "pbq", "lfq"])
+def test_again_no_livelock_single_worker(sched):
+    """Fairness contract: an AGAIN task waiting on a sibling must not
+    starve it on a single stream (reference: sched.h:58-99 distance)."""
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(np.zeros((2, 2), np.float32))
+    state = {"sibling_ran": False, "spins": 0}
+
+    def waiter(es, task):
+        if not state["sibling_ran"]:
+            state["spins"] += 1
+            if state["spins"] > 1000:
+                raise RuntimeError("livelock")
+            return HookReturn.AGAIN
+        return HookReturn.DONE
+
+    def sibling(es, task):
+        state["sibling_ran"] = True
+
+    tp = ParameterizedTaskpool("fair")
+    tp.add_task_class(TaskClass(
+        "Waiter", params=[], affinity=lambda l: A(0, 0),
+        priority=lambda l: 100,
+        flows=[READ("X", inputs=[Dep(FromDesc(lambda l: A(0, 0)))])],
+        body=waiter))
+    tp.add_task_class(TaskClass(
+        "Sibling", params=[], affinity=lambda l: A(0, 0),
+        priority=lambda l: 0,
+        flows=[READ("X", inputs=[Dep(FromDesc(lambda l: A(0, 0)))])],
+        body=sibling))
+    with make_ctx(nb_cores=1, scheduler=sched) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert state["sibling_ran"]
+
+
+def test_long_compound_of_empty_pools_no_recursion():
+    from parsec_tpu import ParameterizedTaskpool as PTP
+    pools = [PTP(f"p{i}") for i in range(300)]
+    with make_ctx() as ctx:
+        ctx.add_taskpool(compose(*pools))
+        ctx.wait(timeout=30)
+    assert all(p.completed for p in pools)

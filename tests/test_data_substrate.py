@@ -109,8 +109,7 @@ def test_repo_usage_and_retirement():
     e = repo.lookup_entry_and_create(("k", 0))
     e.on_retire = lambda entry: retired.append(entry.key)
     e.copies[0] = "copyA"
-    # producer declares 3 consumers (drops its own hold)
-    repo.entry_addto_usage_limit(("k", 0), 3)
+    repo.entry_addto_usage_limit(("k", 0), 3)   # 3 consumers declared
     assert repo.lookup_entry(("k", 0)) is e
     repo.entry_used_once(("k", 0))
     repo.entry_used_once(("k", 0))
@@ -120,19 +119,18 @@ def test_repo_usage_and_retirement():
     assert repo.lookup_entry(("k", 0)) is None
 
 
-def test_repo_producer_first_protocol():
-    """Producer creates (taking the hold), fills copies, declares the limit;
-    consumers then drain it — the reference's PTG discipline where successors
-    only activate after the producer completed."""
+def test_repo_consumers_racing_ahead_of_declaration():
+    """The two-counter protocol (usagelmt/usagecnt, reference datarepo.h):
+    consumers finishing before the producer declares the limit must NOT
+    retire the entry — retirement requires the declaration."""
     repo = DataRepo(nb_flows=1)
     e = repo.lookup_entry_and_create("x")
     e.copies[0] = "out"
-    repo.entry_addto_usage_limit("x", 2)       # 2 consumers, drop hold
-    assert repo.lookup_entry("x") is e
-    repo.entry_used_once("x")
-    assert repo.lookup_entry("x") is e         # one consumer still pending
-    repo.entry_used_once("x")
-    assert repo.lookup_entry("x") is None      # retired exactly now
+    repo.entry_used_once("x")                  # consumer done FIRST
+    repo.entry_used_once("x")                  # second consumer too
+    assert repo.lookup_entry("x") is e         # still alive: limit unknown
+    repo.entry_addto_usage_limit("x", 2)       # producer declares
+    assert repo.lookup_entry("x") is None      # retires exactly now
 
 
 def test_repo_zero_consumers_retires_immediately():
