@@ -52,6 +52,11 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
             rec = PendingRecord(succ_tc.nb_task_inputs(succ_locals),
                                 dict(succ_locals))
         rec.arrivals += 1
+        if copy is not None and rec.inputs.get(flow_name) is not None:
+            # JDF forbids data gathers: a data flow has exactly one source
+            raise RuntimeError(
+                f"{succ_tc.name}{succ_locals}: data flow {flow_name!r} "
+                "received two copies — range deps may only gather CTL")
         rec.inputs[flow_name] = copy
         if source is not None:
             rec.sources[flow_name] = source
@@ -100,6 +105,10 @@ def prepare_input(es, task: Task) -> None:
                     f"{end.arena_name!r} but the taskpool has none")
             task.data[flow.name] = arena.get_copy()
         elif isinstance(end, FromTask):
+            if dep.multiplicity(task.locals) == 0:
+                # empty JDF range at a boundary instance: no edge, no data
+                task.data[flow.name] = None
+                continue
             raise RuntimeError(
                 f"{task}: task-fed flow {flow.name} reached prepare_input "
                 f"unbound — activation protocol error")
@@ -140,21 +149,21 @@ def release_deps(es, task: Task) -> List[Task]:
                     _writeback(task, flow, copy, end.ref_fn(task.locals))
             elif isinstance(end, ToTask):
                 succ_tc = tp.task_classes[end.task_class]
-                succ_locals = end.params_fn(task.locals)
-                if succ_tc.rank_of(succ_locals) != myrank:
-                    tp.context.remote_dep_activate(
-                        es, task, flow, dep, succ_tc, succ_locals, copy)
-                    continue
-                if entry is None and copy is not None:
-                    entry = tc.repo.lookup_entry_and_create(task.key)
-                if copy is not None:
-                    entry.copies[flow.flow_index] = copy
-                    consumers += 1
-                src = (tc, task.key) if copy is not None else None
-                t = deliver_dep(tp, succ_tc, succ_locals,
-                                end.flow, copy, src)
-                if t is not None:
-                    ready.append(t)
+                for succ_locals in end.instances(task.locals):
+                    if succ_tc.rank_of(succ_locals) != myrank:
+                        tp.context.remote_dep_activate(
+                            es, task, flow, dep, succ_tc, succ_locals, copy)
+                        continue
+                    if entry is None and copy is not None:
+                        entry = tc.repo.lookup_entry_and_create(task.key)
+                    if copy is not None:
+                        entry.copies[flow.flow_index] = copy
+                        consumers += 1
+                    src = (tc, task.key) if copy is not None else None
+                    t = deliver_dep(tp, succ_tc, succ_locals,
+                                    end.flow, copy, src)
+                    if t is not None:
+                        ready.append(t)
             # Null outputs: data is discarded (arena copies will be
             # released by the repo retirement below, or were views)
 

@@ -92,15 +92,23 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
     (reference: __parsec_complete_execution:441)."""
     tc = task.task_class
     if not failed:
-        for flow in tc.flows:
-            if flow.access & ACCESS_WRITE:
-                copy = task.data.get(flow.name)
-                if copy is not None and copy.data is not None:
-                    copy.data.complete_write(copy.device)
-        ready = engine.release_deps(es, task)
-        if ready:
-            schedule(es, ready)
-    engine.consume_inputs(task)
+        try:
+            for flow in tc.flows:
+                if flow.access & ACCESS_WRITE:
+                    copy = task.data.get(flow.name)
+                    if copy is not None and copy.data is not None:
+                        copy.data.complete_write(copy.device)
+            ready = engine.release_deps(es, task)
+            if ready:
+                schedule(es, ready)
+        except Exception as exc:
+            # a dep-expression or write-back error must fail the context,
+            # not silently kill the worker thread
+            es.context.record_error(exc, task)
+    try:
+        engine.consume_inputs(task)
+    except Exception as exc:
+        es.context.record_error(exc, task)
     task.status = TaskStatus.COMPLETE
     es.pins("complete_exec", task)
     es.nb_tasks_done += 1

@@ -45,27 +45,33 @@ class DepEnd:
     __slots__ = ()
 
 
-class FromTask(DepEnd):
-    """Input comes from task_class.flow of the instance params_fn(locals)
+class _TaskEnd(DepEnd):
+    """Shared base of task-to-task endpoints.  ``params_fn`` may return a
+    list of param dicts — the JDF range form (``-> TRSM(k+1..NT, k)`` /
+    ``<- CTL First(0..3)``) — in which case the dep represents that many
+    edges."""
+    __slots__ = ("task_class", "flow", "params_fn")
+
+    def __init__(self, task_class: str, flow: str,
+                 params_fn: Callable[[Dict[str, int]], Any]):
+        self.task_class = task_class
+        self.flow = flow
+        self.params_fn = params_fn
+
+    def instances(self, locals_: Dict[str, int]) -> List[Dict[str, int]]:
+        res = self.params_fn(locals_)
+        return list(res) if isinstance(res, (list, tuple)) else [res]
+
+
+class FromTask(_TaskEnd):
+    """Input comes from task_class.flow of the instance(s) params_fn(locals)
     (reference: jdf dep ``A <- B TASK(k-1)``)."""
-    __slots__ = ("task_class", "flow", "params_fn")
-
-    def __init__(self, task_class: str, flow: str,
-                 params_fn: Callable[[Dict[str, int]], Dict[str, int]]):
-        self.task_class = task_class
-        self.flow = flow
-        self.params_fn = params_fn
+    __slots__ = ()
 
 
-class ToTask(DepEnd):
-    """Output feeds task_class.flow of params_fn(locals)."""
-    __slots__ = ("task_class", "flow", "params_fn")
-
-    def __init__(self, task_class: str, flow: str,
-                 params_fn: Callable[[Dict[str, int]], Dict[str, int]]):
-        self.task_class = task_class
-        self.flow = flow
-        self.params_fn = params_fn
+class ToTask(_TaskEnd):
+    """Output feeds task_class.flow of the instance(s) params_fn(locals)."""
+    __slots__ = ()
 
 
 class FromDesc(DepEnd):
@@ -124,7 +130,13 @@ class Dep:
         return True if self.guard is None else bool(self.guard(locals_))
 
     def multiplicity(self, locals_: Dict[str, int]) -> int:
-        return 1 if self.count is None else int(self.count(locals_))
+        """Incoming-edge count: explicit ``count`` wins; a range FromTask
+        contributes one edge per instance."""
+        if self.count is not None:
+            return int(self.count(locals_))
+        if isinstance(self.end, FromTask):
+            return len(self.end.instances(locals_))
+        return 1
 
 
 class Flow:
@@ -239,13 +251,16 @@ class TaskClass:
         yield from rec(0, {})
 
     def nb_task_inputs(self, locals_: Dict[str, int]) -> int:
-        """How many input flows are fed by other tasks — the dep-countdown
-        goal for this instance (reference: update_deps_with_counter)."""
+        """How many incoming task-fed dep EDGES this instance has — the
+        dep-countdown goal (reference: update_deps_with_counter counts every
+        edge).  Data flows have mutually-exclusive guards (one source), but
+        CTL flows may gather through several simultaneously-applying deps,
+        and each counts."""
         n = 0
         for f in self.flows:
-            dep = f.active_input(locals_)
-            if dep is not None and isinstance(dep.end, FromTask):
-                n += dep.multiplicity(locals_)
+            for dep in f.inputs:
+                if dep.applies(locals_) and isinstance(dep.end, FromTask):
+                    n += dep.multiplicity(locals_)
         return n
 
     def rank_of(self, locals_: Dict[str, int]) -> int:

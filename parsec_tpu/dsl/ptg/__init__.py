@@ -1,0 +1,2 @@
+from parsec_tpu.dsl.ptg.api import (PTG, IN, OUT, Range, TASK, DATA, NEW,
+                                    NULL_END)  # noqa: F401

@@ -1,0 +1,265 @@
+"""PTG front-end: a Python-embedded JDF.
+
+Rebuild of the reference's PTG/JDF interface (reference:
+parsec/interfaces/ptg/ptg-compiler — grammar parsec.y/parsec.l, code
+generator jdf2c.c).  Where the reference compiles a textual JDF into a
+generated C taskpool, this front-end builds the same parameterized-task-
+graph structures directly from Python declarations, preserving the JDF
+concepts one-for-one:
+
+  JDF                                  here
+  ---------------------------------   ------------------------------------
+  k = 0 .. NT-1                        k=Range(0, lambda NT: NT - 1)
+  : A(k, k)        (partitioning)      .affinity(lambda k: A(k, k))
+  RW T <- (k==0) ? A(k) : S(k-1)       .flow("T", "RW", IN(DATA(...),
+        -> (k<NT-1) ? S(k+1) : A(k)        when=...), IN(TASK(...)), ...)
+  -> TRSM(k+1..NT-1, k)                TASK("TRSM", "T", lambda k:
+                                         [dict(m=m, k=k) for m in ...])
+  BODY ... END                         .body(fn)  # named args by flow/param
+
+All user lambdas take the task's parameters BY NAME (``lambda k, m: ...``);
+bodies additionally receive flow payloads by flow name, plus the optional
+``es`` and ``task`` magic names.  Taskpool globals (NT, ...) are visible to
+Range bounds by name and to everything else via Python closures.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from parsec_tpu.core.task import (CTL as _CTL_FLOW, Dep, Flow, FromDesc,
+                                  FromTask, New, Null, TaskClass, ToDesc,
+                                  ToTask)
+from parsec_tpu.core.taskpool import ParameterizedTaskpool
+from parsec_tpu.data.arena import Arena
+from parsec_tpu.data.collection import DataRef
+from parsec_tpu.data.data import (ACCESS_NONE, ACCESS_READ, ACCESS_RW,
+                                  ACCESS_WRITE)
+
+_MODES = {"RW": ACCESS_RW, "READ": ACCESS_READ, "WRITE": ACCESS_WRITE,
+          "CTL": ACCESS_NONE}
+
+
+def _named(fn: Callable) -> Callable[[Dict[str, int]], Any]:
+    """Adapt a named-parameter lambda to a locals-dict callable.
+
+    Parameters with defaults (the ``lambda k, NB=NT: ...`` capture idiom)
+    keep their defaults when the name is not a task parameter.
+    """
+    if fn is None:
+        return None
+    sig = [(p.name, p.default is not inspect.Parameter.empty)
+           for p in inspect.signature(fn).parameters.values()
+           if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                         inspect.Parameter.KEYWORD_ONLY)]
+
+    def wrapper(locals_: Dict[str, int]):
+        kwargs = {}
+        for name, has_default in sig:
+            if name in locals_:
+                kwargs[name] = locals_[name]
+            elif not has_default:
+                raise KeyError(
+                    f"dep expression needs {name!r} but the task has "
+                    f"params {sorted(locals_)}; capture globals with a "
+                    f"default arg (lambda k, {name}={name}: ...)")
+        return fn(**kwargs)
+    return wrapper
+
+
+def _resolve(v: Any, globals_: Dict[str, Any], locals_: Dict[str, int]) -> int:
+    if callable(v):
+        scope = {**globals_, **locals_}
+        names = [p.name for p in inspect.signature(v).parameters.values()]
+        return v(**{n: scope[n] for n in names})
+    return int(v)
+
+
+class Range:
+    """JDF-style INCLUSIVE parameter range ``lo .. hi [.. step]``.
+    Bounds may be ints or named lambdas over globals and earlier params."""
+
+    def __init__(self, lo: Any, hi: Any, step: Any = 1):
+        self.lo, self.hi, self.step = lo, hi, step
+
+    def to_fn(self):
+        def fn(globals_, locals_):
+            lo = _resolve(self.lo, globals_, locals_)
+            hi = _resolve(self.hi, globals_, locals_)
+            st = _resolve(self.step, globals_, locals_)
+            return range(lo, hi + (1 if st > 0 else -1), st)
+        return fn
+
+
+# -- dependency endpoint constructors ---------------------------------------
+
+class _End:
+    pass
+
+
+class TASK(_End):
+    """Reference to a peer task's flow: TASK("TRSM", "T", lambda k: dict(...))
+    — a list-returning lambda expresses a JDF range dep."""
+
+    def __init__(self, task_class: str, flow: str, params: Callable):
+        self.task_class, self.flow = task_class, flow
+        self.params = _named(params)
+
+
+class DATA(_End):
+    """Direct collection access: DATA(lambda k: A(k, k))."""
+
+    def __init__(self, ref: Callable):
+        self.ref = _named(ref)
+
+
+class NEW(_End):
+    """Fresh arena allocation (JDF NEW)."""
+
+    def __init__(self, arena: str = "default"):
+        self.arena = arena
+
+
+class NULL_END(_End):
+    """JDF NULL."""
+
+
+def _to_core_end(e: Union[_End, Callable], is_input: bool):
+    if isinstance(e, TASK):
+        return (FromTask(e.task_class, e.flow, e.params) if is_input
+                else ToTask(e.task_class, e.flow, e.params))
+    if isinstance(e, DATA):
+        return FromDesc(e.ref) if is_input else ToDesc(e.ref)
+    if isinstance(e, NEW):
+        if not is_input:
+            raise ValueError("NEW is only valid on inputs")
+        return New(e.arena)
+    if isinstance(e, NULL_END) or e is NULL_END:
+        return Null()
+    if callable(e):   # bare lambda returning a DataRef == DATA shorthand
+        return _to_core_end(DATA(e), is_input)
+    raise TypeError(f"bad dependency endpoint {e!r}")
+
+
+class IN:
+    """Input dependency: IN(endpoint, when=guard, count=gather_multiplicity)."""
+
+    def __init__(self, end, when: Optional[Callable] = None,
+                 count: Optional[Callable] = None, dtt: Any = None):
+        self.dep = Dep(_to_core_end(end, is_input=True), guard=_named(when),
+                       count=_named(count), dtt=dtt)
+
+
+class OUT:
+    """Output dependency: OUT(endpoint, when=guard)."""
+
+    def __init__(self, end, when: Optional[Callable] = None, dtt: Any = None):
+        self.dep = Dep(_to_core_end(end, is_input=False), guard=_named(when),
+                       dtt=dtt)
+
+
+# -- task-class builder ------------------------------------------------------
+
+class TaskBuilder:
+    def __init__(self, ptg: "PTG", name: str, params: Dict[str, Any]):
+        self._ptg = ptg
+        self.name = name
+        self._params = []
+        for pname, r in params.items():
+            if isinstance(r, Range):
+                self._params.append((pname, r.to_fn()))
+            elif callable(r):
+                self._params.append((pname, r))
+            else:
+                raise TypeError(f"param {pname}: expected Range or callable")
+        self._affinity = None
+        self._priority = None
+        self._flows: List[Flow] = []
+        self._incarnations: List = []
+        self._properties: Dict[str, Any] = {}
+
+    def affinity(self, fn: Callable) -> "TaskBuilder":
+        """JDF partitioning line ``: A(k, n)``."""
+        self._affinity = _named(fn)
+        return self
+
+    def priority(self, fn: Callable) -> "TaskBuilder":
+        self._priority = _named(fn)
+        return self
+
+    def flow(self, name: str, mode: str, *deps: Union[IN, OUT]) -> "TaskBuilder":
+        ins = [d.dep for d in deps if isinstance(d, IN)]
+        outs = [d.dep for d in deps if isinstance(d, OUT)]
+        self._flows.append(Flow(name, _MODES[mode.upper()], ins, outs))
+        return self
+
+    def body(self, fn: Callable, device: str = "cpu") -> "TaskBuilder":
+        """Register an incarnation.  The function's named args are bound
+        from task params, flow payloads, and the magic names es/task."""
+        flow_names = {f.name for f in self._flows}
+        names = [p.name for p in inspect.signature(fn).parameters.values()]
+
+        def hook(es, task):
+            kwargs = {}
+            for n in names:
+                if n == "es":
+                    kwargs[n] = es
+                elif n == "task":
+                    kwargs[n] = task
+                elif n in flow_names:
+                    copy = task.data.get(n)
+                    kwargs[n] = None if copy is None else copy.payload
+                elif n in task.locals:
+                    kwargs[n] = task.locals[n]
+                else:
+                    kwargs[n] = self._ptg.globals_.get(n)
+            return fn(**kwargs)
+
+        self._incarnations.append((device, hook))
+        return self
+
+    def property(self, key: str, value: Any) -> "TaskBuilder":
+        self._properties[key] = value
+        return self
+
+    def _build(self) -> TaskClass:
+        return TaskClass(
+            self.name, params=self._params, affinity=self._affinity,
+            flows=self._flows, incarnations=self._incarnations,
+            priority=self._priority, properties=self._properties)
+
+
+class PTG:
+    """A parameterized-task-graph taskpool under construction.
+
+    ``PTG("name", NT=4, ...)`` declares globals; ``.task(...)`` declares
+    task classes; ``.build()`` (or passing the PTG straight to
+    Context.add_taskpool via ``.taskpool``) yields the runnable pool.
+    """
+
+    def __init__(self, name: str, **globals_):
+        self.name = name
+        self.globals_ = dict(globals_)
+        self._tasks: List[TaskBuilder] = []
+        self._arenas: Dict[str, Arena] = {}
+
+    def task(self, name: str, **params) -> TaskBuilder:
+        tb = TaskBuilder(self, name, params)
+        self._tasks.append(tb)
+        return tb
+
+    def arena(self, name: str, shape: Sequence[int],
+              dtype: Any = np.float32) -> "PTG":
+        self._arenas[name] = Arena(tuple(shape), dtype)
+        return self
+
+    def build(self) -> ParameterizedTaskpool:
+        tp = ParameterizedTaskpool(self.name, globals_=self.globals_)
+        for aname, arena in self._arenas.items():
+            tp.add_arena(aname, arena)
+        for tb in self._tasks:
+            tp.add_task_class(tb._build())
+        return tp

@@ -1,0 +1,64 @@
+"""Tests of the map/apply/reduce operator taskpools
+(reference: tests/collections/reduce.c, api/operator.c)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data.matrix import SymTwoDimBlockCyclic, TwoDimBlockCyclic
+from parsec_tpu.data.operators import apply_op, map_op, reduce_op
+
+
+def test_apply_scales_every_tile():
+    a = np.arange(36, dtype=np.float32).reshape(6, 6)
+    want = a * 2
+    A = TwoDimBlockCyclic(2, 2, 6, 6).from_array(a)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(apply_op(A, lambda T, m, n: T.__imul__(2)))
+        ctx.wait(timeout=10)
+    np.testing.assert_allclose(A.to_array(), want)
+
+
+def test_apply_sym_touches_stored_triangle_only():
+    a = np.ones((4, 4), np.float32)
+    S = SymTwoDimBlockCyclic(2, 2, 4, 4,
+                             uplo=SymTwoDimBlockCyclic.LOWER).from_array(a)
+    touched = []
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(apply_op(S, lambda T, m, n: touched.append((m, n))))
+        ctx.wait(timeout=10)
+    assert sorted(touched) == [(0, 0), (1, 0), (1, 1)]
+
+
+def test_map_reads_a_writes_b():
+    a = np.arange(16, dtype=np.float32).reshape(4, 4)
+    b = np.zeros((4, 4), np.float32)
+    A = TwoDimBlockCyclic(2, 2, 4, 4).from_array(a)
+    B = TwoDimBlockCyclic(2, 2, 4, 4).from_array(b)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(map_op(A, B, lambda X, Y, m, n: np.copyto(Y, X + 1)))
+        ctx.wait(timeout=10)
+    np.testing.assert_allclose(b, a + 1)
+    with pytest.raises(ValueError):
+        map_op(A, TwoDimBlockCyclic(4, 4, 4, 4), lambda X, Y, m, n: None)
+
+
+@pytest.mark.parametrize("mt,nt", [(1, 1), (2, 2), (3, 3), (4, 1)])
+def test_reduce_tree_sums_all_tiles(mt, nt):
+    lm, ln = 2 * mt, 2 * nt
+    a = np.arange(lm * ln, dtype=np.float64).reshape(lm, ln)
+    A = TwoDimBlockCyclic(2, 2, lm, ln, dtype=np.float64).from_array(a)
+    tp, holder = reduce_op(A, lambda x, y: x + y)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=10)
+    # sum of all tiles elementwise == sum over tile grid positions
+    want = sum(a[2 * m:2 * m + 2, 2 * n:2 * n + 2]
+               for m in range(mt) for n in range(nt))
+    np.testing.assert_allclose(holder["value"], want)
+
+
+def test_reduce_rejects_ragged_tiles():
+    A = TwoDimBlockCyclic(4, 4, 6, 6)
+    with pytest.raises(ValueError):
+        reduce_op(A, lambda x, y: x + y)

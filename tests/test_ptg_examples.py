@@ -1,0 +1,322 @@
+"""Ports of the reference examples Ex00-Ex07 onto the PTG front-end
+(reference: /root/reference/examples/Ex00_StartStop.c .. Ex07_RAW_CTL.jdf —
+behavior reproduced, not translated; the DSL replaces the JDF compiler)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data.matrix import TwoDimBlockCyclic, VectorTwoDimCyclic
+from parsec_tpu.dsl.ptg import DATA, IN, NEW, OUT, PTG, Range, TASK
+
+
+def make_ctx(**kw):
+    kw.setdefault("nb_cores", 2)
+    return Context(**kw)
+
+
+def test_ex00_start_stop():
+    """Ex00_StartStop.c: init / start / wait / fini cycles, no tasks."""
+    for _ in range(3):
+        with make_ctx() as ctx:
+            ctx.start()
+            assert ctx.test()
+
+
+def test_ex01_hello_world():
+    """Ex01_HelloWorld.jdf: one task, no data."""
+    said = []
+    g = PTG("hello")
+    g.task("HelloWorld").flow("X", "CTL").body(lambda: said.append("hi"))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    assert said == ["hi"]
+
+
+def test_ex02_chain():
+    """Ex02_Chain.jdf: NB tasks ordered by a CTL-less RW chain on one tile."""
+    NB = 8
+    A = VectorTwoDimCyclic(1, 1).from_array(np.zeros(1, np.float32))
+    order = []
+
+    g = PTG("chain", NB=NB)
+    g.task("Task", k=Range(0, NB - 1)) \
+     .affinity(lambda k: A(0)) \
+     .flow("T", "RW",
+           IN(DATA(lambda k: A(0)), when=lambda k: k == 0),
+           IN(TASK("Task", "T", lambda k: dict(k=k - 1)),
+              when=lambda k: k > 0),
+           OUT(TASK("Task", "T", lambda k: dict(k=k + 1)),
+               when=lambda k, NB=NB: k < NB - 1)) \
+     .body(lambda k: order.append(k))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    assert order == list(range(NB))
+
+
+def test_ex03_chain_distributed_placement():
+    """Ex03_ChainMPI.jdf: owner-computes placement — each rank instantiates
+    only its own tasks.  Two independent per-rank chains; the rank-0 context
+    must execute exactly the rank-0 chain."""
+    ran = []
+    # two tiles, one per rank (1D cyclic over 2 nodes)
+    V = VectorTwoDimCyclic(1, 2, nodes=2, myrank=0)
+
+    g = PTG("chainmpi", NB=4)
+    g.task("Task", r=Range(0, 1), k=Range(0, 3)) \
+     .affinity(lambda r: V(r)) \
+     .flow("T", "RW",
+           IN(DATA(lambda r: V(r)), when=lambda k: k == 0),
+           IN(TASK("Task", "T", lambda r, k: dict(r=r, k=k - 1)),
+              when=lambda k: k > 0),
+           OUT(TASK("Task", "T", lambda r, k: dict(r=r, k=k + 1)),
+               when=lambda k: k < 3)) \
+     .body(lambda r, k: ran.append((r, k)))
+    tp = g.build()
+    with make_ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=10)
+    assert ran == [(0, k) for k in range(4)]   # rank-1 tasks never ran here
+
+
+def test_ex04_chain_data():
+    """Ex04_ChainData.jdf: data value flows down the chain and back home."""
+    NB = 6
+    a = np.zeros(1, np.float32)
+    V = VectorTwoDimCyclic(1, 1).from_array(a)
+
+    g = PTG("chaindata", NB=NB)
+    g.task("Task", k=Range(0, NB - 1)) \
+     .affinity(lambda k: V(0)) \
+     .flow("T", "RW",
+           IN(DATA(lambda k: V(0)), when=lambda k: k == 0),
+           IN(TASK("Task", "T", lambda k: dict(k=k - 1)),
+              when=lambda k: k > 0),
+           OUT(TASK("Task", "T", lambda k: dict(k=k + 1)),
+               when=lambda k, NB=NB: k < NB - 1),
+           OUT(DATA(lambda k: V(0)), when=lambda k, NB=NB: k == NB - 1)) \
+     .body(lambda T, k: T.__iadd__(k))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    assert a[0] == sum(range(NB))
+
+
+def test_ex05_broadcast_range_dep():
+    """Ex05_Broadcast.jdf: one task broadcasts to a range of receivers via
+    a single JDF range dep (-> A TaskRecv(1 .. WORLD-1))."""
+    WORLD = 7
+    a = np.full(1, 3.0, np.float32)
+    V = VectorTwoDimCyclic(1, 1).from_array(a)
+    got = []
+    lock = threading.Lock()
+
+    def recv(A, k):
+        with lock:
+            got.append((k, float(A[0])))
+
+    g = PTG("bcast", WORLD=WORLD)
+    g.task("TaskBcast") \
+     .affinity(lambda: V(0)) \
+     .flow("A", "RW",
+           IN(DATA(lambda: V(0))),
+           OUT(TASK("TaskRecv", "A",
+                    lambda WORLD=WORLD: [dict(k=k) for k in range(1, WORLD)]))) \
+     .body(lambda A: A.__imul__(2))
+    g.task("TaskRecv", k=Range(1, WORLD - 1)) \
+     .affinity(lambda k: V(0)) \
+     .flow("A", "READ", IN(TASK("TaskBcast", "A", lambda k: dict()))) \
+     .body(recv)
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    assert sorted(got) == [(k, 6.0) for k in range(1, WORLD)]
+
+
+def _raw_pools(with_ctl: bool, NB: int = 6):
+    """Shared structure of Ex06_RAW / Ex07_RAW_CTL: TaskBcast(k) sends A to
+    NB/2+1 TaskRecv readers and one TaskUpdate writer; without CTL the
+    update may race the readers, with CTL it is ordered after all of them."""
+    events = []
+    lock = threading.Lock()
+    K = 2
+    a = np.zeros(K, np.float32)
+    V = VectorTwoDimCyclic(1, K).from_array(a)
+    recv_range = list(range(0, NB + 1, 2))
+
+    g = PTG("raw_ctl" if with_ctl else "raw", NB=NB, K=K)
+    g.task("TaskBcast", k=Range(0, K - 1)) \
+     .affinity(lambda k: V(k)) \
+     .flow("A", "RW",
+           IN(DATA(lambda k: V(k))),
+           OUT(TASK("TaskUpdate", "A", lambda k: dict(k=k))),
+           OUT(TASK("TaskRecv", "A",
+                    lambda k: [dict(k=k, n=n) for n in recv_range]))) \
+     .body(lambda A, k: A.fill(k + 1))
+
+    def recv_body(A, k, n):
+        with lock:
+            events.append(("recv", k, n, float(A[0])))
+
+    g.task("TaskRecv", k=Range(0, K - 1), n=Range(0, NB, 2)) \
+     .affinity(lambda k: V(k)) \
+     .flow("A", "READ", IN(TASK("TaskBcast", "A", lambda k: dict(k=k)))) \
+     .flow("ctl", "CTL",
+           *([OUT(TASK("TaskUpdate", "ctl", lambda k: dict(k=k)))]
+             if with_ctl else [])) \
+     .body(recv_body)
+
+    def update_body(A, k):
+        with lock:
+            events.append(("update", k))
+        A.fill(-(k + 1))
+
+    g.task("TaskUpdate", k=Range(0, K - 1)) \
+     .affinity(lambda k: V(k)) \
+     .flow("A", "RW",
+           IN(TASK("TaskBcast", "A", lambda k: dict(k=k))),
+           OUT(DATA(lambda k: V(k)))) \
+     .flow("ctl", "CTL",
+           *([IN(TASK("TaskRecv", "ctl",
+                      lambda k: [dict(k=k, n=n) for n in recv_range]))]
+             if with_ctl else [])) \
+     .body(update_body)
+    return g, events, a, recv_range
+
+
+def test_ex07_raw_ctl_orders_update_after_reads():
+    """Ex07_RAW_CTL.jdf: the CTL gather guarantees every reader saw the
+    broadcast value before the anti-dependent update overwrote it."""
+    g, events, a, recv_range = _raw_pools(with_ctl=True)
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    for k in (0, 1):
+        upd = events.index(("update", k))
+        recvs = [i for i, e in enumerate(events)
+                 if e[0] == "recv" and e[1] == k]
+        assert len(recvs) == len(recv_range)
+        assert all(i < upd for i in recvs)          # CTL ordering held
+    # every reader saw the pre-update value
+    assert all(e[3] == e[1] + 1 for e in events if e[0] == "recv")
+    assert list(a) == [-1.0, -2.0]                  # updates wrote home
+
+
+def test_ex06_raw_runs_all_tasks():
+    """Ex06_RAW.jdf (no CTL): all tasks still execute; read values may race
+    the update by design (the example exists to show the hazard)."""
+    g, events, a, recv_range = _raw_pools(with_ctl=False)
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    assert sum(1 for e in events if e[0] == "recv") == 2 * len(recv_range)
+    assert sum(1 for e in events if e[0] == "update") == 2
+    assert list(a) == [-1.0, -2.0]
+
+
+def test_range_with_step_and_derived_bounds():
+    hits = []
+    g = PTG("steps", N=10)
+    g.task("S", i=Range(0, lambda N: N - 1, 3),
+           j=Range(lambda i: i, lambda i, N: min(i + 1, N - 1))) \
+     .flow("X", "CTL") \
+     .body(lambda i, j: hits.append((i, j)))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    expect = [(i, j) for i in range(0, 10, 3)
+              for j in range(i, min(i + 1, 9) + 1)]
+    assert sorted(hits) == sorted(expect)
+
+
+def test_body_magic_names_and_globals():
+    seen = {}
+    V = VectorTwoDimCyclic(1, 1).from_array(np.ones(1, np.float32))
+    g = PTG("magic", ANSWER=42)
+
+    def body(es, task, X, ANSWER):
+        seen["es"] = es is not None
+        seen["task"] = str(task)
+        seen["X"] = float(X[0])
+        seen["ANSWER"] = ANSWER
+
+    g.task("M").affinity(lambda: V(0)) \
+     .flow("X", "READ", IN(DATA(lambda: V(0)))) \
+     .body(body)
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    assert seen == {"es": True, "task": "M()", "X": 1.0, "ANSWER": 42}
+
+
+def test_ctl_two_guarded_deps_count_as_two_edges():
+    """A 2x2 wavefront: W(1,1) has TWO simultaneously-applying CTL input
+    deps (from W(0,1) and W(1,0)) and must run exactly once, after both."""
+    order = []
+    V = VectorTwoDimCyclic(1, 1).from_array(np.zeros(1, np.float32))
+    g = PTG("wave")
+    g.task("W", m=Range(0, 1), n=Range(0, 1)) \
+     .affinity(lambda: V(0)) \
+     .flow("c", "CTL",
+           IN(TASK("W", "c", lambda m, n: dict(m=m - 1, n=n)),
+              when=lambda m: m > 0),
+           IN(TASK("W", "c", lambda m, n: dict(m=m, n=n - 1)),
+              when=lambda n: n > 0),
+           OUT(TASK("W", "c", lambda m, n: dict(m=m + 1, n=n)),
+               when=lambda m: m < 1),
+           OUT(TASK("W", "c", lambda m, n: dict(m=m, n=n + 1)),
+               when=lambda n: n < 1)) \
+     .body(lambda m, n: order.append((m, n)))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    assert sorted(order) == [(0, 0), (0, 1), (1, 0), (1, 1)]  # exactly once
+    assert order[0] == (0, 0) and order[-1] == (1, 1)
+
+
+def test_empty_range_gather_is_no_dep():
+    """Boundary instances with an empty JDF range gather run immediately."""
+    ran = []
+    g = PTG("empty_range", N=3)
+    g.task("Leaf", k=Range(0, 2)) \
+     .flow("c", "CTL",
+           IN(TASK("Leaf", "c",
+                   lambda k: [dict(k=j) for j in range(k + 1, 0)])),
+           OUT(TASK("Leaf", "c", lambda k: []))) \
+     .body(lambda k: ran.append(k))
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        ctx.wait(timeout=10)
+    assert sorted(ran) == [0, 1, 2]
+
+
+def test_apply_with_int_returning_op_terminates():
+    from parsec_tpu.data.operators import apply_op
+    a = np.zeros((2, 2), np.float32)
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_array(a)
+    with make_ctx() as ctx:
+        ctx.add_taskpool(apply_op(A, lambda T, m, n: 1))  # op returns int
+        ctx.wait(timeout=10)
+
+
+def test_data_gather_rejected():
+    """Two data-carrying arrivals on one flow must fail loudly, not drop."""
+    V = VectorTwoDimCyclic(1, 2).from_array(np.zeros(2, np.float32))
+    g = PTG("badgather")
+    g.task("P", i=Range(0, 1)) \
+     .affinity(lambda i: V(i)) \
+     .flow("T", "RW", IN(DATA(lambda i: V(i))),
+           OUT(TASK("C", "X", lambda i: dict()))) \
+     .body(lambda T: None)
+    g.task("C").affinity(lambda: V(0)) \
+     .flow("X", "READ",
+           IN(TASK("P", "T", lambda: [dict(i=0), dict(i=1)]))) \
+     .body(lambda X: None)
+    with make_ctx() as ctx:
+        ctx.add_taskpool(g.build())
+        with pytest.raises(RuntimeError):
+            ctx.wait(timeout=10)
