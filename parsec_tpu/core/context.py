@@ -72,8 +72,12 @@ class Context:
         self._pending_start: List[Taskpool] = []
         self._errors: List[tuple] = []
         self._pins = {}
-        self.devices: List[Any] = []
         self.comm = None               # comm engine (distributed layer)
+
+        # device layer (reference: parsec_mca_device_init, parsec.c:823)
+        from parsec_tpu.devices import init_devices
+        self.device_registry = init_devices(self)
+        self.devices = self.device_registry.devices
 
         # termination detection factory (per-taskpool module instances share
         # this class; reference installs termdet per taskpool)
@@ -188,6 +192,7 @@ class Context:
             self._cond.notify_all()
         for t in self._threads:
             t.join(timeout=5)
+        self.device_registry.fini()
         stats = self.scheduler.display_stats(None)
         if stats:
             inform("scheduler stats: %s", stats)

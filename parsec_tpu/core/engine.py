@@ -95,7 +95,11 @@ def prepare_input(es, task: Task) -> None:
             copy = datum.copy_on(0)
             if copy is None:
                 raise RuntimeError(f"{task}: no host copy for {ref}")
-            datum.transfer_ownership(0, flow.access)
+            # Bind only; coherency (and any pull) is resolved at the
+            # execution site — stage_in_host for CPU incarnations, the
+            # device module's stage-in for accelerator ones — so a tile
+            # resident on the device that will run the task moves zero
+            # bytes (reference: the data_lookup / stage_in split).
             task.data[flow.name] = copy
         elif isinstance(end, New):
             arena = tp.arenas.get(end.arena_name)
@@ -116,14 +120,52 @@ def prepare_input(es, task: Task) -> None:
             task.data[flow.name] = None
 
 
+def stage_in_host(task: Task) -> None:
+    """Make every bound data flow valid on the host before a CPU body runs
+    (the host-side analog of the device module's stage-in; reference:
+    generated data_lookup resolving CPU-side copies).  Pulls from a
+    newer device-resident copy when one exists and rebinds the flow to
+    the host copy so in-place numpy mutation works."""
+    for flow in task.task_class.flows:
+        copy = task.data.get(flow.name)
+        if copy is None or copy.data is None:
+            continue
+        datum = copy.data
+        with datum._lock:
+            host = datum.copy_on(0)
+            if host is None:
+                host = datum.create_copy(0)
+            src = datum.transfer_ownership(0, flow.access)
+            if src is not None:
+                arr = np.asarray(src.payload)
+                if host.payload is None:
+                    host.payload = arr.copy()
+                else:
+                    np.copyto(np.asarray(host.payload), arr)
+                host.version = src.version
+            elif host.payload is None and copy.payload is not None \
+                    and copy is not host:
+                host.payload = np.asarray(copy.payload).copy()
+                host.version = copy.version
+        task.data[flow.name] = host
+
+
 def _writeback(task: Task, flow: Flow, copy: DataCopy, ref) -> None:
     """Write a produced copy back into its collection datum
-    (``-> A(m, n)`` on a copy that is not A(m,n)'s own)."""
+    (``-> A(m, n)``) — the pushout path.  A host copy that already is the
+    datum's own was written in place; a device-resident copy of the datum
+    is pulled home (reference: GPU stage-out of pushout flows,
+    device_cuda_module.c:2197)."""
     datum = ref.resolve()
     host = datum.copy_on(0)
-    if host is None or copy is host or copy.data is datum:
-        return  # body wrote the collection tile in place
-    np.copyto(np.asarray(host.payload), np.asarray(copy.payload))
+    if copy is host:
+        return
+    if copy.data is datum and copy.device == 0:
+        return  # body wrote the host tile in place
+    if host is None:
+        host = datum.create_copy(0, payload=np.asarray(copy.payload).copy())
+    else:
+        np.copyto(np.asarray(host.payload), np.asarray(copy.payload))
     datum.transfer_ownership(0, ACCESS_WRITE)
     datum.complete_write(0)
 

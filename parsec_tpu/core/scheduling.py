@@ -33,11 +33,15 @@ def execute(es, task: Task) -> HookReturn:
     """Iterate incarnations by preference until one takes the task
     (reference: __parsec_execute chore loop, scheduling.c:138-198)."""
     tc = task.task_class
+    host_staged = False
     for idx, (dev_type, hook) in enumerate(list(tc.incarnations)):
         if not (task.chore_mask & (1 << idx)):
             continue
         if tc.chore_disabled_mask & (1 << idx):
             continue
+        if dev_type == "cpu" and not host_staged:
+            engine.stage_in_host(task)
+            host_staged = True
         ret = hook(es, task)
         if not isinstance(ret, HookReturn):
             # bodies opt into lifecycle control by returning HookReturn/int;

@@ -36,6 +36,29 @@ class HookReturn(IntEnum):
     ERROR = -1
 
 
+def normalize_body_outputs(ret: Any, writable: Sequence[str],
+                           what: str = "body") -> Dict[str, Any]:
+    """Normalize a functional body/kernel return value to {flow: value}.
+
+    Shared by CPU bodies and device kernels so both incarnations of a task
+    class follow one convention: a dict keyed by flow name, a tuple in
+    written-flow declaration order, or a single value when exactly one
+    flow is written.
+    """
+    if isinstance(ret, dict):
+        return ret
+    if isinstance(ret, (tuple, list)):
+        if len(ret) != len(writable):
+            raise ValueError(
+                f"{what} returned {len(ret)} values for "
+                f"{len(writable)} written flows {list(writable)}")
+        return dict(zip(writable, ret))
+    if len(writable) != 1:
+        raise ValueError(
+            f"{what} returned one value but writes {list(writable)}")
+    return {writable[0]: ret}
+
+
 # --------------------------------------------------------------------------
 # Dependency endpoints
 # --------------------------------------------------------------------------

@@ -45,7 +45,7 @@ class DataCopy:
     (reference: parsec_data_copy_t)."""
 
     __slots__ = ("data", "device", "payload", "coherency", "version",
-                 "readers", "flags", "arena", "dtt")
+                 "readers", "flags", "arena", "dtt", "__weakref__")
 
     def __init__(self, data: "Data", device: int, payload: Any = None,
                  coherency: Coherency = Coherency.INVALID, version: int = 0):

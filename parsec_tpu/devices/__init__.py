@@ -1,0 +1,48 @@
+"""Device layer: registry + XLA (TPU) device modules.
+
+reference: parsec/mca/device/ — see device.py and xla.py in this package.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from parsec_tpu.devices.device import Device, DeviceRegistry, DeviceStats
+from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import debug_verbose, warning
+
+params.register("device_enabled", 1, "attach XLA accelerator devices")
+params.register("device_max", 0, "max XLA devices to attach (0 = all)")
+
+# Relative throughput weights per platform, in rough TFLOPS (reference:
+# the CUDA module's per-architecture flop-rate table,
+# device_cuda_module.c:53).  Used only for load balancing ratios.
+_PLATFORM_WEIGHTS = {"tpu": 100.0, "axon": 100.0, "gpu": 50.0,
+                     "cuda": 50.0, "cpu": 1.0}
+
+
+def init_devices(context) -> DeviceRegistry:
+    """Attach every visible jax device as a runtime device module
+    (reference: parsec_mca_device_init/attach, parsec.c:823-828)."""
+    reg = DeviceRegistry(context)
+    if not params.get("device_enabled", 1):
+        return reg
+    try:
+        import jax
+        jdevs = jax.devices()
+    except Exception as exc:   # no jax / no backend: host-only runtime
+        warning("device init: jax unavailable (%s); host-only", exc)
+        return reg
+    limit = int(params.get("device_max", 0))
+    if limit > 0:
+        jdevs = jdevs[:limit]
+    from parsec_tpu.devices.xla import XlaDevice
+    for jd in jdevs:
+        w = _PLATFORM_WEIGHTS.get(jd.platform, 1.0)
+        reg.attach(XlaDevice(jd, weight=w))
+    debug_verbose(3, "attached %d XLA devices (%s)", len(jdevs),
+                  jdevs[0].platform if jdevs else "-")
+    return reg
+
+
+__all__ = ["Device", "DeviceRegistry", "DeviceStats", "init_devices"]

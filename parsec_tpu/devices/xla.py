@@ -1,0 +1,396 @@
+"""XLA device module: TPU (and any jax backend) task offload.
+
+Rebuild of the reference's GPU device machinery on the XLA execution model
+(reference: parsec/mca/device/device_gpu.{c,h} generic GPU base +
+parsec/mca/device/cuda/device_cuda_module.c offload pipeline;
+parsec/mca/device/template/ is the seam this module fills): each attached
+jax device gets a manager thread (stage-in + kernel dispatch — the
+reference's mutex-elected manager loop, device_cuda_module.c:2537-2763)
+and a completer thread (the analog of CUDA-event polling in
+progress_stream:1961).  Kernel dispatch through jax is asynchronous, so the
+manager pipelines stage-in and launch while the completer blocks on the
+oldest in-flight task's outputs, preserving the reference's
+``PARSEC_HOOK_RETURN_ASYNC`` completion contract: the device owns the task
+until it re-enters ``complete_execution``.
+
+Device memory is a coherency-tracked cache of datum copies with LRU
+eviction and byte accounting (reference: gpu_mem_lru + zone_malloc; here
+XLA owns the actual HBM, we manage copy lifetime).  Kernels are pure jax
+functions over flow payloads; they are jitted once per (shape, dtype)
+signature with input buffers of written flows donated so XLA reuses their
+HBM (the moral equivalent of in-place tile updates).
+
+TPU notes: keep tiles MXU-friendly (multiples of 128, bf16/f32); the jit
+cache means steady-state execution launches pre-compiled executables only.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from parsec_tpu.core.task import HookReturn, Task
+from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency,
+                                  DataCopy)
+from parsec_tpu.devices.device import Device
+from parsec_tpu.core.task import ToDesc
+from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import debug_verbose
+
+params.register("device_inflight_depth", 4,
+                "max in-flight device tasks per XLA device")
+params.register("device_mem_mb", 0,
+                "device copy-cache capacity in MiB (0 = unlimited)")
+params.register("device_donate", 1,
+                "donate written-flow input buffers to XLA (TPU/GPU only)")
+
+
+class XlaKernel:
+    """Device incarnation spec: a pure jax function over flow payloads.
+
+    The function's named arguments are bound from flow payloads (as jax
+    arrays) and task parameters (passed as static arguments, so a kernel
+    indexing by a parameter recompiles per value — keep parameters out of
+    kernels on hot paths).  It returns the new values of the written flows:
+    a dict {flow: array}, a tuple in written-flow declaration order, or a
+    single array when exactly one flow is written.
+    (reference: the BODY [type=CUDA] incarnation of a JDF task class,
+    jdf2c.c:6556 GPU hook generation.)
+    """
+
+    def __init__(self, fn, arg_names: Sequence[str],
+                 flow_names: Sequence[str], writable_flows: Sequence[str]):
+        self.fn = fn
+        self.arg_names = list(arg_names)
+        self.flow_names = set(flow_names)
+        self.writable = list(writable_flows)   # flow declaration order
+        self._jits: Dict[bool, Any] = {}
+        self._lock = threading.Lock()
+
+    def jitted(self, donate: bool):
+        with self._lock:
+            jf = self._jits.get(donate)
+            if jf is None:
+                import jax
+                static = tuple(i for i, n in enumerate(self.arg_names)
+                               if n not in self.flow_names)
+                dn = tuple(i for i, n in enumerate(self.arg_names)
+                           if n in self.flow_names and n in self.writable) \
+                    if donate else ()
+                jf = jax.jit(self.fn, static_argnums=static, donate_argnums=dn)
+                self._jits[donate] = jf
+            return jf
+
+    def bind_outputs(self, result: Any) -> Dict[str, Any]:
+        from parsec_tpu.core.task import normalize_body_outputs
+        return normalize_body_outputs(result, self.writable, what="kernel")
+
+
+class _Inflight:
+    __slots__ = ("es", "task", "spec", "outputs", "pinned", "load",
+                 "release_after")
+
+    def __init__(self, es, task, spec, outputs, pinned, load, release_after):
+        self.es = es
+        self.task = task
+        self.spec = spec
+        self.outputs = outputs
+        self.pinned = pinned
+        self.load = load
+        #: host arena copies to return to their freelist once the kernel
+        #: (and therefore the H2D transfer reading them) has completed
+        self.release_after = release_after
+
+
+class XlaDevice(Device):
+    """One jax device as a runtime device module."""
+
+    kind = "xla"
+
+    def __init__(self, jdev, weight: float = 1.0):
+        super().__init__(f"{jdev.platform}:{jdev.id}")
+        self.jdev = jdev
+        self.platform = jdev.platform
+        self.weight = weight
+        # "axon" is the tunneled-TPU PJRT platform name
+        self.kind = "tpu" if self.platform in ("tpu", "axon") else "xla"
+        self._donate = (bool(params.get("device_donate", 1))
+                        and self.platform in ("tpu", "axon", "gpu", "cuda",
+                                              "rocm"))
+        self._depth = max(1, int(params.get("device_inflight_depth", 4)))
+        cap_mb = int(params.get("device_mem_mb", 0))
+        self._capacity = cap_mb * (1 << 20) if cap_mb > 0 else None
+        self._bytes_used = 0
+        #: datum-id -> (weakref to device copy, nbytes); insertion order =
+        #: LRU order.  Weak so per-task temporaries (NEW-flow datums) do
+        #: not accumulate here forever — a finalizer drops the accounting
+        #: when the copy dies with its datum.
+        self._lru: "OrderedDict[int, Tuple[Any, int]]" = OrderedDict()
+        self._pins: Dict[int, int] = {}
+        self._mem_lock = threading.Lock()
+
+        self._pending: deque = deque()
+        self._inflight: deque = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self.es = None   # device execution stream, set on first submit
+        self._manager = threading.Thread(
+            target=self._manager_loop, name=f"xla-mgr-{self.name}",
+            daemon=True)
+        self._completer = threading.Thread(
+            target=self._completer_loop, name=f"xla-fin-{self.name}",
+            daemon=True)
+        self._manager.start()
+        self._completer.start()
+
+    # ------------------------------------------------------------------
+    # submit: worker thread -> device ownership (HOOK_RETURN_ASYNC)
+    # ------------------------------------------------------------------
+    def submit(self, es, task: Task, spec: XlaKernel) -> HookReturn:
+        flops = task.task_class.properties.get("flops", 1.0)
+        load = float(flops(task.locals)) if callable(flops) else float(flops)
+        self.load_add(load)
+        with self._cond:
+            if self.es is None:
+                from parsec_tpu.core.context import ExecutionStream
+                self.es = ExecutionStream(es.context, th_id=900 + self.space)
+            self._pending.append((task, spec, load))
+            self._cond.notify_all()
+        return HookReturn.ASYNC
+
+    # ------------------------------------------------------------------
+    # manager: stage-in + dispatch (reference: parsec_cuda_kernel_push /
+    # submit phases of the manager state machine)
+    # ------------------------------------------------------------------
+    def _manager_loop(self):
+        while True:
+            with self._cond:
+                while not self._pending and not self._stop:
+                    self._cond.wait(0.1)
+                if self._stop and not self._pending:
+                    return
+                task, spec, load = self._pending.popleft()
+            try:
+                self._launch(task, spec, load)
+            except Exception as exc:   # stage-in/compile failure
+                from parsec_tpu.core import scheduling
+                self.stats.faults += 1
+                self.load_sub(load)
+                self.es.context.record_error(exc, task)
+                scheduling.complete_execution(self.es, task, failed=True)
+
+    def _launch(self, task: Task, spec: XlaKernel, load: float) -> None:
+        tc = task.task_class
+        pinned: List[Any] = []
+        staged: Dict[str, Any] = {}
+        release_after: List[DataCopy] = []
+        # pin every datum this task touches before any eviction decision
+        for flow in tc.flows:
+            copy = task.data.get(flow.name)
+            if copy is not None and copy.data is not None:
+                self._pin(copy.data)
+                pinned.append(copy.data)
+        try:
+            for flow in tc.flows:
+                copy = task.data.get(flow.name)
+                if copy is None:
+                    continue
+                dc = self._stage_in(copy, flow.access)
+                if dc is not copy and copy.device == 0 \
+                        and copy.arena is not None:
+                    # host arena temp fully superseded by the device copy:
+                    # return it to the freelist once the kernel completes
+                    # (the H2D transfer may still be reading it)
+                    copy.data.detach_copy(0)
+                    release_after.append(copy)
+                task.data[flow.name] = dc
+                staged[flow.name] = dc.payload
+            args = []
+            for n in spec.arg_names:
+                if n in staged:
+                    args.append(staged[n])
+                elif n in task.locals:
+                    args.append(task.locals[n])
+                else:
+                    args.append(task.taskpool.globals.get(n))
+            outs = spec.bind_outputs(spec.jitted(self._donate)(*args))
+        except Exception:
+            for d in pinned:
+                self._unpin(d)
+            raise
+        self.stats.executed_tasks += 1
+        with self._cond:
+            while len(self._inflight) >= self._depth and not self._stop:
+                self._cond.wait(0.1)
+            self._inflight.append(
+                _Inflight(self.es, task, spec, outs, pinned, load,
+                          release_after))
+            self._cond.notify_all()
+
+    def _stage_in(self, copy: DataCopy, access: int) -> DataCopy:
+        """Ensure a valid copy of ``copy``'s datum on this device
+        (reference: parsec_gpu_data_stage_in, device_cuda_module.c:1261)."""
+        import jax
+        datum = copy.data
+        dc = datum.copy_on(self.space)
+        fresh = dc is None
+        if fresh:
+            dc = datum.create_copy(self.space)
+        src = datum.transfer_ownership(self.space, access)
+        if src is not None or dc.payload is None:
+            payload = src.payload if src is not None else copy.payload
+            nbytes = getattr(payload, "nbytes", 0)
+            self._reserve(nbytes)
+            dc.payload = jax.device_put(payload, self.jdev)
+            dc.version = src.version if src is not None else copy.version
+            self.stats.bytes_in += nbytes
+            if fresh:
+                self._account(datum, dc, nbytes)
+        self._touch(datum)
+        return dc
+
+    # ------------------------------------------------------------------
+    # completer: block on oldest in-flight outputs, rebind, complete
+    # (reference: parsec_cuda_kernel_pop/epilog + progress_stream events)
+    # ------------------------------------------------------------------
+    def _completer_loop(self):
+        from parsec_tpu.core import scheduling
+        while True:
+            with self._cond:
+                while not self._inflight and not self._stop:
+                    self._cond.wait(0.1)
+                if not self._inflight:
+                    if self._stop:
+                        return
+                    continue
+                inf = self._inflight.popleft()
+                self._cond.notify_all()
+            try:
+                import jax
+                jax.block_until_ready(list(inf.outputs.values()))
+                for fname, arr in inf.outputs.items():
+                    dc = inf.task.data.get(fname)
+                    if dc is not None:
+                        dc.payload = arr
+                scheduling.complete_execution(inf.es, inf.task)
+            except Exception as exc:
+                self.stats.faults += 1
+                inf.es.context.record_error(exc, inf.task)
+                scheduling.complete_execution(inf.es, inf.task, failed=True)
+            finally:
+                self.load_sub(inf.load)
+                for d in inf.pinned:
+                    self._unpin(d)
+                for copy in inf.release_after:
+                    copy.arena.release_copy(copy)
+
+    # ------------------------------------------------------------------
+    # device memory cache management (reference: gpu_mem_lru / zone_malloc)
+    # ------------------------------------------------------------------
+    def _pin(self, datum) -> None:
+        with self._mem_lock:
+            self._pins[id(datum)] = self._pins.get(id(datum), 0) + 1
+
+    def _unpin(self, datum) -> None:
+        with self._mem_lock:
+            n = self._pins.get(id(datum), 0) - 1
+            if n <= 0:
+                self._pins.pop(id(datum), None)
+            else:
+                self._pins[id(datum)] = n
+
+    def _touch(self, datum) -> None:
+        with self._mem_lock:
+            if id(datum) in self._lru:
+                self._lru.move_to_end(id(datum))
+
+    def _account(self, datum, dc: DataCopy, nbytes: int) -> None:
+        key = id(datum)
+        with self._mem_lock:
+            self._lru[key] = (weakref.ref(dc), nbytes)
+            self._bytes_used += nbytes
+        weakref.finalize(dc, self._forget, key, nbytes)
+
+    def _forget(self, key: int, nbytes: int) -> None:
+        """Finalizer: a device copy died with its (temporary) datum —
+        drop its cache accounting.  Only removes the entry if it still
+        refers to the dead copy (the key may have been reused by a
+        re-staged copy of the same datum, or by a new datum at the same
+        address)."""
+        with self._mem_lock:
+            ent = self._lru.get(key)
+            if ent is not None and ent[0]() is None:
+                self._lru.pop(key)
+                self._bytes_used -= ent[1]
+
+    def _reserve(self, nbytes: int) -> None:
+        """Evict LRU unpinned copies until ``nbytes`` fit (reference:
+        parsec_gpu_data_reserve_device_space, device_cuda_module.c:864)."""
+        if self._capacity is None:
+            return
+        with self._mem_lock:
+            if self._bytes_used + nbytes <= self._capacity:
+                return
+            for key in list(self._lru.keys()):
+                if self._bytes_used + nbytes <= self._capacity:
+                    break
+                if self._pins.get(key, 0) > 0:
+                    continue
+                dcref, sz = self._lru.pop(key)
+                dc = dcref()
+                if dc is None:
+                    self._bytes_used -= sz
+                    continue
+                self._evict(dc.data, dc, sz)
+
+    def _evict(self, datum, dc: DataCopy, nbytes: int) -> None:
+        """Write back if authoritative, then drop (caller holds _mem_lock)."""
+        if dc.coherency in (Coherency.OWNED, Coherency.EXCLUSIVE) and \
+                dc.version >= datum.newest_version():
+            self._writeback_host(datum, dc)
+        datum.detach_copy(self.space)
+        dc.payload = None
+        dc.coherency = Coherency.INVALID
+        self._bytes_used -= nbytes
+        self.stats.evictions += 1
+
+    def _writeback_host(self, datum, dc: DataCopy) -> None:
+        host = datum.copy_on(0)
+        arr = np.asarray(dc.payload)
+        self.stats.bytes_out += arr.nbytes
+        if host is None:
+            host = datum.create_copy(0, payload=arr.copy())
+        else:
+            np.copyto(np.asarray(host.payload), arr)
+        host.version = dc.version
+        host.coherency = Coherency.SHARED
+        if dc.coherency == Coherency.EXCLUSIVE:
+            dc.coherency = Coherency.OWNED
+
+    def flush(self) -> None:
+        """Push every authoritative device copy home (reference:
+        parsec_dtd_data_flush_all / GPU w2r writeback tasks)."""
+        with self._mem_lock:
+            entries = [ref() for ref, _ in self._lru.values()]
+        for dc in entries:
+            if dc is None:
+                continue
+            datum = dc.data
+            with datum._lock:
+                if dc.payload is not None and \
+                        dc.coherency in (Coherency.OWNED, Coherency.EXCLUSIVE) \
+                        and dc.version >= datum.newest_version():
+                    self._writeback_host(datum, dc)
+
+    def fini(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._manager.join(timeout=5)
+        self._completer.join(timeout=5)
+        self.flush()
+        debug_verbose(5, "device %s: %s", self.name, self.stats.as_dict())

@@ -31,8 +31,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from parsec_tpu.core.task import (CTL as _CTL_FLOW, Dep, Flow, FromDesc,
-                                  FromTask, New, Null, TaskClass, ToDesc,
-                                  ToTask)
+                                  FromTask, HookReturn, New, Null, TaskClass,
+                                  ToDesc, ToTask, normalize_body_outputs)
 from parsec_tpu.core.taskpool import ParameterizedTaskpool
 from parsec_tpu.data.arena import Arena
 from parsec_tpu.data.collection import DataRef
@@ -161,6 +161,21 @@ class OUT:
                        dtt=dtt)
 
 
+def _bind_body_outputs(task, ret: Any, writable: List[str]) -> None:
+    """Store a functional body's return value(s) into the written flows'
+    copies.  Host copies backed by collection storage are updated in place
+    (np.copyto) so backing-array views stay linked."""
+    outs = normalize_body_outputs(ret, writable, what=str(task))
+    for name, value in outs.items():
+        copy = task.data.get(name)
+        if copy is None:
+            raise RuntimeError(f"{task}: flow {name!r} has no bound copy")
+        if isinstance(copy.payload, np.ndarray):
+            np.copyto(copy.payload, np.asarray(value))
+        else:
+            copy.payload = value
+
+
 # -- task-class builder ------------------------------------------------------
 
 class TaskBuilder:
@@ -198,9 +213,21 @@ class TaskBuilder:
 
     def body(self, fn: Callable, device: str = "cpu") -> "TaskBuilder":
         """Register an incarnation.  The function's named args are bound
-        from task params, flow payloads, and the magic names es/task."""
+        from task params, flow payloads, and the magic names es/task.
+
+        ``device="tpu"`` registers an XLA incarnation: ``fn`` must be a
+        pure jax function over flow payloads (see XlaKernel); at runtime
+        the task is handed to the best XLA device and completes
+        asynchronously (reference: BODY [type=CUDA] bodies and the GPU
+        hook of jdf2c.c:6556).  When no device is attached the incarnation
+        declines (HookReturn.NEXT) and the next body — typically a cpu
+        fallback declared after it — runs instead.
+        """
+        if device in ("tpu", "xla", "gpu"):
+            return self._device_body(fn, device)
         flow_names = {f.name for f in self._flows}
         names = [p.name for p in inspect.signature(fn).parameters.values()]
+        writable = [f.name for f in self._flows if f.access & ACCESS_WRITE]
 
         def hook(es, task):
             kwargs = {}
@@ -216,7 +243,36 @@ class TaskBuilder:
                     kwargs[n] = task.locals[n]
                 else:
                     kwargs[n] = self._ptg.globals_.get(n)
-            return fn(**kwargs)
+            ret = fn(**kwargs)
+            # Functional bodies return the new written-flow values (same
+            # convention as device kernels); in-place bodies return None.
+            # Only HookReturn instances pass through as lifecycle codes —
+            # a plain int/bool is a VALUE (silently eating it as a code
+            # would drop the write).
+            if ret is None or isinstance(ret, HookReturn):
+                return ret
+            if not writable:
+                return None   # nothing to write; ignore the return value
+            _bind_body_outputs(task, ret, writable)
+            return None
+
+        self._incarnations.append((device, hook))
+        return self
+
+    def _device_body(self, fn: Callable, device: str) -> "TaskBuilder":
+        from parsec_tpu.core.task import HookReturn
+        from parsec_tpu.devices.xla import XlaKernel
+        names = [p.name for p in inspect.signature(fn).parameters.values()]
+        flow_names = [f.name for f in self._flows]
+        writable = [f.name for f in self._flows if f.access & ACCESS_WRITE]
+        spec = XlaKernel(fn, names, flow_names, writable)
+
+        def hook(es, task):
+            reg = getattr(es.context, "device_registry", None)
+            dev = reg.best_device(task) if reg is not None else None
+            if dev is None:
+                return HookReturn.NEXT
+            return dev.submit(es, task, spec)
 
         self._incarnations.append((device, hook))
         return self

@@ -1,16 +1,24 @@
 """Test harness configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
-so multi-chip sharding paths compile and execute without TPU hardware
-(mirrors the reference's strategy of testing multi-node with mpiexec on one
-node, SURVEY.md §4).
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths
+compile and execute without TPU hardware (mirrors the reference's strategy
+of testing multi-node with mpiexec on one node, SURVEY.md §4).
+
+The environment may have already imported jax at interpreter startup and
+pointed it at real TPU hardware (platform "axon", registered by a
+sitecustomize hook) — env vars alone are captured before any test code
+runs, so the platform must be forced through jax.config as well.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

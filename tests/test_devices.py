@@ -1,0 +1,196 @@
+"""Device-layer tests: XLA offload path on the virtual CPU mesh.
+
+Mirrors the reference's GPU test strategy (reference: tests/dsl/ptg/cuda/
+stress.jdf throughput, get_best_device_check.jdf placement; SURVEY.md §4):
+device tasks run through the real stage-in / dispatch / async-complete
+pipeline, on jax CPU devices standing in for TPU chips.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.devices.device import DeviceRegistry, HostDevice
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+from parsec_tpu.utils.mca import params
+
+
+def make_ctx(**kw):
+    return Context(nb_cores=2, **kw)
+
+
+def test_registry_attach_and_spaces():
+    reg = DeviceRegistry()
+    assert reg.host.space == 0
+    from parsec_tpu.devices.xla import XlaDevice
+    import jax
+    d = reg.attach(XlaDevice(jax.devices()[0]))
+    assert d.space == 1
+    assert reg.get(1) is d
+    assert reg.accelerators == [d]
+    d.fini()
+
+
+def test_context_attaches_xla_devices():
+    with make_ctx() as ctx:
+        assert len(ctx.device_registry.accelerators) >= 1
+        for d in ctx.device_registry.accelerators:
+            assert d.kind in ("xla", "tpu")
+
+
+def _chain_ptg(A, nt, device):
+    """S(k): T = T@T' chain through a single tile, alternating devices."""
+    p = PTG("chain", NT=nt)
+    p.task("S", k=Range(0, nt - 1)) \
+        .affinity(lambda k, A=A: A(0, 0)) \
+        .flow("T", "RW",
+              IN(DATA(lambda A=A: A(0, 0)), when=lambda k: k == 0),
+              IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("S", "T", lambda k, NT=nt: dict(k=k + 1)),
+                  when=lambda k, NT=nt: k < NT - 1),
+              OUT(DATA(lambda A=A: A(0, 0)),
+                  when=lambda k, NT=nt: k == NT - 1)) \
+        .body(lambda T: T + 1.0, device=device)
+    return p.build()
+
+
+@pytest.mark.parametrize("device", ["tpu", "cpu"])
+def test_device_chain_matches_cpu(device):
+    A = TwoDimBlockCyclic(mb=8, nb=8, lm=8, ln=8)
+    tile = A.data_of(0, 0).copy_on(0).payload
+    tile[:] = 0.0
+    with make_ctx() as ctx:
+        ctx.add_taskpool(_chain_ptg(A, 10, device))
+        ctx.wait()
+    np.testing.assert_allclose(np.asarray(A.data_of(0, 0).copy_on(0).payload),
+                               np.full((8, 8), 10.0), rtol=1e-6)
+
+
+def test_device_gemm_tiles_correct():
+    """Tiled C += A@B on devices vs numpy."""
+    mt = nt = kt = 2
+    mb = 16
+    rng = np.random.default_rng(0)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=kt * mb,
+                          name="A")
+    B = TwoDimBlockCyclic(mb=mb, nb=mb, lm=kt * mb, ln=nt * mb,
+                          name="B")
+    C = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=nt * mb,
+                          name="C")
+    for M in (A, B, C):
+        for m, n in M.local_tiles():
+            M.data_of(m, n).copy_on(0).payload[:] = rng.standard_normal((mb, mb),
+                                                          ).astype(np.float32)
+    refA = A.to_array().copy()
+    refB = B.to_array().copy()
+    refC = C.to_array() + refA @ refB
+
+    p = PTG("gemm", MT=mt, NT=nt, KT=kt)
+    p.task("GEMM", m=Range(0, mt - 1), n=Range(0, nt - 1),
+           k=Range(0, kt - 1)) \
+        .affinity(lambda m, n, C=C: C(m, n)) \
+        .flow("Ai", "READ", IN(DATA(lambda m, k, A=A: A(m, k)))) \
+        .flow("Bi", "READ", IN(DATA(lambda k, n, B=B: B(k, n)))) \
+        .flow("Ci", "RW",
+              IN(DATA(lambda m, n, C=C: C(m, n)), when=lambda k: k == 0),
+              IN(TASK("GEMM", "Ci", lambda m, n, k: dict(m=m, n=n, k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("GEMM", "Ci",
+                       lambda m, n, k: dict(m=m, n=n, k=k + 1)),
+                  when=lambda k, KT=kt: k < KT - 1),
+              OUT(DATA(lambda m, n, C=C: C(m, n)),
+                  when=lambda k, KT=kt: k == KT - 1)) \
+        .body(lambda Ai, Bi, Ci: Ci + Ai @ Bi, device="tpu")
+    with make_ctx() as ctx:
+        ctx.add_taskpool(p.build())
+        ctx.wait()
+    np.testing.assert_allclose(C.to_array(), refC, rtol=1e-4, atol=1e-4)
+
+
+def test_device_fallback_to_cpu_body():
+    """tpu incarnation declines when no accelerator: cpu body runs."""
+    params.set("device_enabled", 0)
+    try:
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+        A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+        with make_ctx() as ctx:
+            assert ctx.device_registry.accelerators == []
+            p = PTG("fb", NT=1)
+            p.task("S", k=Range(0, 0)) \
+                .affinity(lambda k, A=A: A(0, 0)) \
+                .flow("T", "RW", IN(DATA(lambda A=A: A(0, 0))),
+                      OUT(DATA(lambda A=A: A(0, 0)))) \
+                .body(lambda T: T + 7.0, device="tpu") \
+                .body(lambda T: T + np.float32(3.0))
+            ctx.add_taskpool(p.build())
+            ctx.wait()
+        assert np.asarray(A.data_of(0, 0).copy_on(0).payload)[0, 0] == 3.0
+    finally:
+        params.unset("device_enabled")
+
+
+def test_lru_eviction_under_pressure():
+    """Tiny copy-cache capacity forces evictions yet stays correct."""
+    params.set("device_mem_mb", 1)     # 1 MiB cap
+    params.set("device_max", 1)
+    try:
+        nt = 24
+        mb = 128                        # 64 KiB per f32 tile; 24 > 1 MiB cap
+        A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=nt * mb, ln=mb)
+        for m, n in A.local_tiles():
+            A.data_of(m, n).copy_on(0).payload[:] = float(m)
+        with make_ctx() as ctx:
+            # three chained sweeps over all tiles: proper dep edges between
+            # revisits (racing on a tile without deps is UB, as in JDF)
+            p = PTG("sweep", NT=nt)
+            p.task("S", rep=Range(0, 2), m=Range(0, nt - 1)) \
+                .affinity(lambda m, A=A: A(m, 0)) \
+                .flow("T", "RW",
+                      IN(DATA(lambda m, A=A: A(m, 0)),
+                         when=lambda rep: rep == 0),
+                      IN(TASK("S", "T", lambda rep, m: dict(rep=rep - 1,
+                                                            m=m)),
+                         when=lambda rep: rep > 0),
+                      OUT(TASK("S", "T", lambda rep, m: dict(rep=rep + 1,
+                                                             m=m)),
+                          when=lambda rep: rep < 2),
+                      OUT(DATA(lambda m, A=A: A(m, 0)),
+                          when=lambda rep: rep == 2)) \
+                .body(lambda T: T + 1.0, device="tpu")
+            ctx.add_taskpool(p.build())
+            ctx.wait()
+            dev = ctx.device_registry.accelerators[0]
+            stats = dev.stats
+        for m, n in A.local_tiles():
+            np.testing.assert_allclose(
+                np.asarray(A.data_of(m, n).copy_on(0).payload),
+                float(m) + 3.0)
+        assert stats.evictions > 0
+        assert stats.executed_tasks == 3 * nt
+    finally:
+        params.unset("device_mem_mb")
+        params.unset("device_max")
+
+
+def test_best_device_load_balance():
+    """Without affinity hints, tasks spread across devices by load."""
+    with make_ctx() as ctx:
+        accs = ctx.device_registry.accelerators
+        if len(accs) < 2:
+            pytest.skip("needs >=2 jax devices")
+        nt = 24
+        A = TwoDimBlockCyclic(mb=8, nb=8, lm=nt * 8, ln=8)
+        for m, n in A.local_tiles():
+            A.data_of(m, n).copy_on(0).payload[:] = 1.0
+        p = PTG("spread", NT=nt)
+        p.task("S", m=Range(0, nt - 1)) \
+            .affinity(lambda m, A=A: A(m, 0)) \
+            .flow("T", "RW", IN(DATA(lambda m, A=A: A(m, 0))),
+                  OUT(DATA(lambda m, A=A: A(m, 0)))) \
+            .body(lambda T: T * 2.0, device="tpu")
+        ctx.add_taskpool(p.build())
+        ctx.wait()
+        used = sum(1 for d in accs if d.stats.executed_tasks > 0)
+        assert used >= 2
