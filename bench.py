@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Headline benchmark: tiled GEMM GFLOPS through the runtime.
+
+The metric of the reference's DTD GEMM perf harness (reference:
+tests/dsl/dtd/dtd_test_simple_gemm.c:659-666 — GFLOPS = 2*M*N*K / wall
+time over the full insert+wait cycle, i.e. the runtime's scheduling and
+staging overheads count against it, not just the matmul).
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+denominator is the north-star target from BASELINE.json — 55% of the
+chip's peak matmul throughput (bf16 peak for TPU platforms).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+# Rough peak matmul GFLOP/s per chip by platform (bf16 for TPU).
+_PEAKS = {
+    "axon": 197_000.0,   # TPU v5e (v5 lite)
+    "tpu": 197_000.0,
+    "cpu": 100.0,
+}
+
+
+def run_gemm_bench(mb: int, mt: int, nt: int, kt: int, reps: int = 3):
+    from parsec_tpu.apps.gemm import gemm_taskpool, total_flops
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    rng = np.random.default_rng(7)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=kt * mb, name="A")
+    B = TwoDimBlockCyclic(mb=mb, nb=mb, lm=kt * mb, ln=nt * mb, name="B")
+    C = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=nt * mb, name="C")
+    for M in (A, B, C):
+        for m, n in M.local_tiles():
+            M.data_of(m, n).copy_on(0).payload[:] = \
+                rng.standard_normal((mb, mb)).astype(np.float32)
+
+    flops = total_flops(mt * mb, nt * mb, kt * mb)
+    best = 0.0
+    with Context(nb_cores=4) as ctx:
+        # warmup: jit-compiles the tile kernel (first TPU compile 20-40s)
+        t0 = time.perf_counter()
+        ctx.add_taskpool(gemm_taskpool(A, B, C))
+        ctx.wait()
+        log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
+        for r in range(reps):
+            t0 = time.perf_counter()
+            ctx.add_taskpool(gemm_taskpool(A, B, C))
+            ctx.wait()
+            dt = time.perf_counter() - t0
+            gf = flops / dt / 1e9
+            best = max(best, gf)
+            log(f"rep {r}: {dt * 1e3:.1f} ms -> {gf:.1f} GFLOP/s")
+        for d in ctx.device_registry.accelerators:
+            if d.stats.executed_tasks:
+                log(f"{d.name}: {d.stats.as_dict()}")
+    return best
+
+
+def main():
+    import jax
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}, devices: {len(jax.devices())}")
+    on_tpu = platform in ("tpu", "axon")
+    # 64 GEMM tasks; big MXU-friendly tiles on TPU, small ones on CPU CI
+    mb = 2048 if on_tpu else 64
+    mt = nt = kt = 4
+    value = run_gemm_bench(mb, mt, nt, kt)
+    peak = _PEAKS.get(platform, 100.0)
+    target = 0.55 * peak
+    print(json.dumps({
+        "metric": "tiled_gemm_gflops",
+        "value": round(value, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(value / target, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Linear-algebra / mini-app drivers built on the runtime.
+
+The analog of the reference's tests/apps and of DPLASMA's tiled drivers
+(reference: tests/dsl/dtd/dtd_test_simple_gemm.c, tests/apps/stencil/,
+BASELINE.md north-star configs): each app builds a parameterized taskpool
+over tiled-matrix collections with TPU incarnations and CPU fallbacks.
+"""
+
+from parsec_tpu.apps.gemm import gemm_taskpool  # noqa: F401

@@ -1,0 +1,119 @@
+"""Tiled GEMM driver: C = alpha*A@B + beta*C as a parameterized task graph.
+
+The flagship throughput app, mirroring the reference's DTD GEMM perf
+harness (reference: tests/dsl/dtd/dtd_test_simple_gemm.c — GFLOPS =
+2*M*N*K/t, :659-666) but expressed as a PTG: one GEMM(m, n, k) task per
+(C tile, k panel), chained over k so each C tile flows through its own
+accumulation pipeline while independent (m, n) chains run concurrently
+across devices.  Owner-computes: the task runs where C(m, n) lives.
+
+TPU notes: tiles should be MXU-shaped (multiples of 128; 512-2048 sweet
+spot) and bf16 for peak; the kernel is a single fused jax matmul-add that
+XLA maps straight onto the systolic array, jitted once per tile shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from parsec_tpu.core.taskpool import ParameterizedTaskpool
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+
+
+#: kernel functions memoized per (alpha, precision) so repeated taskpool
+#: builds share one function object — and therefore one jit cache entry
+#: (XlaKernel._jit_cache) — across runs
+_kernels = {}
+
+
+def _tile_kernel(alpha: float, precision=None):
+    """Accumulation step of the k-chain: Ci += alpha * Ai@Bi.
+    (beta is applied once by the SCALE task class, not per step.)"""
+    key = (alpha, precision)
+    fn = _kernels.get(key)
+    if fn is None:
+        def fn(Ai, Bi, Ci):
+            import jax.numpy as jnp
+            acc = jnp.matmul(Ai, Bi, precision=precision)
+            return Ci + (acc if alpha == 1.0 else alpha * acc)
+        _kernels[key] = fn
+    return fn
+
+
+def gemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
+                  alpha: float = 1.0, beta: float = 1.0,
+                  device: str = "tpu",
+                  precision: Optional[str] = None) -> ParameterizedTaskpool:
+    """Build the C = alpha*A@B + beta*C taskpool over tiled collections.
+
+    ``precision``: jax matmul precision ("highest" forces fp32 accumulate
+    on TPU; None keeps the backend default, bf16 on TPU).
+    """
+    if A.nt != B.mt or A.mt != C.mt or B.nt != C.nt:
+        raise ValueError(
+            f"tile grids do not agree: A {A.mt}x{A.nt}, B {B.mt}x{B.nt}, "
+            f"C {C.mt}x{C.nt}")
+    mt, nt, kt = C.mt, C.nt, A.nt
+    mb, nb, kb = C.mb, C.nb, A.nb
+    flops_per_task = 2.0 * mb * nb * kb
+    use_device = device in ("tpu", "xla", "gpu")
+    kernel = _tile_kernel(alpha, precision)
+    prescale = beta != 1.0
+
+    def cpu_body(Ai, Bi, Ci):
+        return np.asarray(Ci) + alpha * np.matmul(np.asarray(Ai),
+                                                  np.asarray(Bi))
+
+    p = PTG("gemm", MT=mt, NT=nt, KT=kt)
+    if prescale:
+        # one-time beta scaling of each C tile, feeding the k=0 step
+        # (the reference harness folds beta the same way: the chain
+        # itself is pure accumulation)
+        sb = p.task("SCALE", m=Range(0, mt - 1), n=Range(0, nt - 1)) \
+            .affinity(lambda m, n, C=C: C(m, n)) \
+            .flow("Ci", "RW",
+                  IN(DATA(lambda m, n, C=C: C(m, n))),
+                  OUT(TASK("GEMM", "Ci", lambda m, n: dict(m=m, n=n, k=0))))
+        if use_device:
+            sb.body(_scale_kernel(beta), device=device)
+        sb.body(lambda Ci: beta * np.asarray(Ci))
+    tb = p.task("GEMM",
+                m=Range(0, mt - 1), n=Range(0, nt - 1), k=Range(0, kt - 1)) \
+        .affinity(lambda m, n, C=C: C(m, n)) \
+        .priority(lambda k, KT=kt: KT - k) \
+        .flow("Ai", "READ", IN(DATA(lambda m, k, A=A: A(m, k)))) \
+        .flow("Bi", "READ", IN(DATA(lambda k, n, B=B: B(k, n)))) \
+        .flow("Ci", "RW",
+              IN(TASK("SCALE", "Ci", lambda m, n: dict(m=m, n=n)),
+                 when=lambda k: k == 0) if prescale else
+              IN(DATA(lambda m, n, C=C: C(m, n)),
+                 when=lambda k: k == 0),
+              IN(TASK("GEMM", "Ci", lambda m, n, k: dict(m=m, n=n, k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("GEMM", "Ci", lambda m, n, k: dict(m=m, n=n, k=k + 1)),
+                  when=lambda k, KT=kt: k < KT - 1),
+              OUT(DATA(lambda m, n, C=C: C(m, n)),
+                  when=lambda k, KT=kt: k == KT - 1)) \
+        .property("flops", flops_per_task)
+    if use_device:
+        tb.body(kernel, device=device)
+    tb.body(cpu_body)
+    return p.build()
+
+
+def _scale_kernel(beta: float):
+    key = ("scale", beta)
+    fn = _kernels.get(key)
+    if fn is None:
+        def fn(Ci):
+            return beta * Ci
+        _kernels[key] = fn
+    return fn
+
+
+def total_flops(m: int, n: int, k: int) -> float:
+    """Useful FLOPs of C[m,n] = A[m,k]@B[k,n] (2*M*N*K)."""
+    return 2.0 * m * n * k

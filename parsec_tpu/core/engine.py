@@ -151,17 +151,19 @@ def stage_in_host(task: Task) -> None:
 
 
 def _writeback(task: Task, flow: Flow, copy: DataCopy, ref) -> None:
-    """Write a produced copy back into its collection datum
-    (``-> A(m, n)``) — the pushout path.  A host copy that already is the
-    datum's own was written in place; a device-resident copy of the datum
-    is pulled home (reference: GPU stage-out of pushout flows,
-    device_cuda_module.c:2197)."""
+    """Return a produced copy to its collection datum (``-> A(m, n)``).
+
+    A copy that already belongs to the datum needs NO data movement — in
+    particular a device-resident copy simply stays the authoritative
+    version (the reference keeps GPU copies resident until eviction or
+    flush, not eagerly D2H on every output dep); host readers pull it
+    lazily via Data.pull_to_host.  Only a copy of a *different* datum
+    (arena temporaries routed to the collection) is physically copied.
+    """
     datum = ref.resolve()
+    if copy.data is datum:
+        return  # in place (host) or device-resident (lazy pull-home)
     host = datum.copy_on(0)
-    if copy is host:
-        return
-    if copy.data is datum and copy.device == 0:
-        return  # body wrote the host tile in place
     if host is None:
         host = datum.create_copy(0, payload=np.asarray(copy.payload).copy())
     else:

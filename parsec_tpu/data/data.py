@@ -175,6 +175,34 @@ class Data:
             self._version_clock += 1
             c.version = self._version_clock
 
+    def pull_to_host(self) -> Optional[DataCopy]:
+        """Make the host copy current WITHOUT stealing ownership: the
+        newest device copy stays valid (EXCLUSIVE degrades to OWNED) so
+        device-resident data is readable on the host yet needs no re-stage
+        on its next device use.  This is the read path of collections
+        (to_array & friends); tasks use transfer_ownership instead."""
+        import numpy as np
+        with self._lock:
+            host = self._copies.get(0)
+            newest = self.newest_copy(prefer_device=0)
+            if newest is None or newest is host:
+                return host
+            if host is not None and host.coherency != Coherency.INVALID \
+                    and host.version >= newest.version:
+                return host   # already current: no D2H transfer
+            arr = np.asarray(newest.payload)
+            if host is None:
+                host = self.create_copy(0, payload=arr.copy(),
+                                        coherency=Coherency.SHARED,
+                                        version=newest.version)
+            else:
+                np.copyto(np.asarray(host.payload), arr)
+                host.version = newest.version
+                host.coherency = Coherency.SHARED
+            if newest.coherency == Coherency.EXCLUSIVE:
+                newest.coherency = Coherency.OWNED
+            return host
+
     def start_read(self, device: int) -> None:
         with self._lock:
             self._copies[device].readers += 1

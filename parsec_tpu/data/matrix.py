@@ -87,22 +87,17 @@ class TiledMatrix(DataCollection):
             return self._backing
         out = np.zeros((self.lm, self.ln), self.dtype)
         for (m, n), d in list(self._tiles.items()):
-            c = d.newest_copy(prefer_device=0)
+            c = d.pull_to_host()
             tm, tn = self.tile_shape(m, n)
             payload = np.asarray(c.payload)[:tm, :tn]
             out[m * self.mb:m * self.mb + tm, n * self.nb:n * self.nb + tn] = payload
         return out
 
     def _sync_backing(self) -> None:
-        """Write back tiles whose newest copy isn't the host view."""
+        """Pull tiles whose newest copy lives off-host; host payloads are
+        views into the backing array, so pull_to_host refreshes it."""
         for (m, n), d in list(self._tiles.items()):
-            c = d.newest_copy()
-            host = d.copy_on(0)
-            if c is not None and host is not None and c is not host:
-                tm, tn = self.tile_shape(m, n)
-                np.copyto(host.payload[:tm, :tn], np.asarray(c.payload)[:tm, :tn])
-                host.version = c.version
-                host.coherency = c.coherency
+            d.pull_to_host()
 
     def _make_tile(self, m: int, n: int) -> Data:
         tm, tn = self.tile_shape(m, n)
@@ -248,20 +243,10 @@ class VectorTwoDimCyclic(TiledMatrix):
             return self._backing
         out = np.zeros(self.lm, self.dtype)
         for (m, _n), d in list(self._tiles.items()):
-            c = d.newest_copy(prefer_device=0)
+            c = d.pull_to_host()
             tm = min(self.mb, self.lm - m * self.mb)
             out[m * self.mb:m * self.mb + tm] = np.asarray(c.payload)[:tm]
         return out
-
-    def _sync_backing(self) -> None:
-        for (m, _n), d in list(self._tiles.items()):
-            c = d.newest_copy()
-            host = d.copy_on(0)
-            if c is not None and host is not None and c is not host:
-                tm = min(self.mb, self.lm - m * self.mb)
-                np.copyto(host.payload[:tm], np.asarray(c.payload)[:tm])
-                host.version = c.version
-                host.coherency = c.coherency
 
     def _make_tile(self, m: int, n: int) -> Data:
         tm = min(self.mb, self.lm - m * self.mb)

@@ -11,22 +11,35 @@ from typing import Any
 
 import numpy as np
 
-from parsec_tpu.data.data import Data
+from parsec_tpu.data.data import ACCESS_RW, Data
 from parsec_tpu.data.matrix import TiledMatrix
 
 
 class SubtileMatrix(TiledMatrix):
-    """View one parent tile as an mb x nb tiled matrix (always rank-local)."""
+    """View one parent tile as an mb x nb tiled matrix (always rank-local).
+
+    Construction claims the parent for host-side read-write: the newest
+    copy is pulled home first (it may be device-resident) and other copies
+    are invalidated, so the inner taskpool's in-place writes through the
+    backing views cannot be shadowed by a stale device copy.  Call
+    ``commit()`` when the inner taskpool completes to version-bump the
+    parent (the recursive-completion hook does this).
+    """
 
     def __init__(self, parent_tile: Data, mb: int, nb: int, name: str = "sub"):
-        copy = parent_tile.newest_copy(prefer_device=0)
+        copy = parent_tile.pull_to_host()
         if copy is None or copy.payload is None:
-            raise ValueError("parent tile has no materialized host copy")
+            raise ValueError("parent tile has no materialized copy")
+        parent_tile.transfer_ownership(0, ACCESS_RW)
         a = np.asarray(copy.payload)
         super().__init__(mb, nb, a.shape[0], a.shape[1], dtype=a.dtype,
                          nodes=1, myrank=0, name=name)
         self.parent = parent_tile
         self.from_array(a)
+
+    def commit(self) -> None:
+        """Publish the inner writes: bump the parent's host version."""
+        self.parent.complete_write(0)
 
     def rank_of(self, m: int, n: int = 0) -> int:
         return 0

@@ -62,27 +62,40 @@ class XlaKernel:
     jdf2c.c:6556 GPU hook generation.)
     """
 
+    _jit_lock = threading.Lock()
+
     def __init__(self, fn, arg_names: Sequence[str],
                  flow_names: Sequence[str], writable_flows: Sequence[str]):
         self.fn = fn
         self.arg_names = list(arg_names)
         self.flow_names = set(flow_names)
         self.writable = list(writable_flows)   # flow declaration order
-        self._jits: Dict[bool, Any] = {}
-        self._lock = threading.Lock()
 
     def jitted(self, donate: bool):
-        with self._lock:
-            jf = self._jits.get(donate)
+        # The jit cache lives ON the kernel function object, so its
+        # lifetime is the function's: module-level kernels (apps memoize
+        # theirs, e.g. gemm._kernels) share traced executables across
+        # taskpool rebuilds, while per-build lambdas die with their pools
+        # instead of pinning entries in a global table forever.
+        static = tuple(i for i, n in enumerate(self.arg_names)
+                       if n not in self.flow_names)
+        dn = tuple(i for i, n in enumerate(self.arg_names)
+                   if n in self.flow_names and n in self.writable) \
+            if donate else ()
+        key = (static, dn)
+        with XlaKernel._jit_lock:
+            cache = getattr(self.fn, "__parsec_jit_cache__", None)
+            if cache is None:
+                cache = {}
+                try:
+                    self.fn.__parsec_jit_cache__ = cache
+                except AttributeError:   # unsettable callable: no sharing
+                    pass
+            jf = cache.get(key)
             if jf is None:
                 import jax
-                static = tuple(i for i, n in enumerate(self.arg_names)
-                               if n not in self.flow_names)
-                dn = tuple(i for i, n in enumerate(self.arg_names)
-                           if n in self.flow_names and n in self.writable) \
-                    if donate else ()
                 jf = jax.jit(self.fn, static_argnums=static, donate_argnums=dn)
-                self._jits[donate] = jf
+                cache[key] = jf
             return jf
 
     def bind_outputs(self, result: Any) -> Dict[str, Any]:
@@ -359,17 +372,13 @@ class XlaDevice(Device):
         self.stats.evictions += 1
 
     def _writeback_host(self, datum, dc: DataCopy) -> None:
+        """Pull the datum home (one locked, version-guarded path:
+        Data.pull_to_host), accounting the transfer."""
         host = datum.copy_on(0)
-        arr = np.asarray(dc.payload)
-        self.stats.bytes_out += arr.nbytes
-        if host is None:
-            host = datum.create_copy(0, payload=arr.copy())
-        else:
-            np.copyto(np.asarray(host.payload), arr)
-        host.version = dc.version
-        host.coherency = Coherency.SHARED
-        if dc.coherency == Coherency.EXCLUSIVE:
-            dc.coherency = Coherency.OWNED
+        if host is None or host.coherency == Coherency.INVALID or \
+                host.version < dc.version:
+            self.stats.bytes_out += getattr(dc.payload, "nbytes", 0)
+        datum.pull_to_host()
 
     def flush(self) -> None:
         """Push every authoritative device copy home (reference:

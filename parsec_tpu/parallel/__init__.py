@@ -1,0 +1,12 @@
+"""Mesh/SPMD parallel layer: dataflow schedules lowered to XLA collectives.
+
+The TPU-native realization of the reference's distributed machinery
+(reference: parsec/remote_dep.c dataflow bcast trees + parsec_comm_engine.h
+put/get seam — SURVEY.md §2.5/§5.8): where the reference moves tile
+payloads with funnelled MPI driven by a comm thread, a pod slice moves
+them with XLA collectives over ICI — all_gather for the bcast-tree fan-out,
+psum_scatter for reductions, ppermute rings for neighbor pipelines.
+"""
+
+from parsec_tpu.parallel.spmd import (halo_stencil_fn, make_mesh,  # noqa: F401
+                                      ring_reduce_gemm_fn, summa_gemm_fn)

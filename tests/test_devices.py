@@ -64,7 +64,7 @@ def test_device_chain_matches_cpu(device):
     with make_ctx() as ctx:
         ctx.add_taskpool(_chain_ptg(A, 10, device))
         ctx.wait()
-    np.testing.assert_allclose(np.asarray(A.data_of(0, 0).copy_on(0).payload),
+    np.testing.assert_allclose(np.asarray(A.data_of(0, 0).pull_to_host().payload),
                                np.full((8, 8), 10.0), rtol=1e-6)
 
 
@@ -126,7 +126,7 @@ def test_device_fallback_to_cpu_body():
                 .body(lambda T: T + np.float32(3.0))
             ctx.add_taskpool(p.build())
             ctx.wait()
-        assert np.asarray(A.data_of(0, 0).copy_on(0).payload)[0, 0] == 3.0
+        assert np.asarray(A.data_of(0, 0).pull_to_host().payload)[0, 0] == 3.0
     finally:
         params.unset("device_enabled")
 
@@ -165,7 +165,7 @@ def test_lru_eviction_under_pressure():
             stats = dev.stats
         for m, n in A.local_tiles():
             np.testing.assert_allclose(
-                np.asarray(A.data_of(m, n).copy_on(0).payload),
+                np.asarray(A.data_of(m, n).pull_to_host().payload),
                 float(m) + 3.0)
         assert stats.evictions > 0
         assert stats.executed_tasks == 3 * nt

@@ -1,0 +1,58 @@
+"""SPMD schedule tests on the virtual 8-device CPU mesh (SURVEY.md §5.8)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.parallel.spmd import (halo_stencil_fn, make_mesh,
+                                      ring_reduce_gemm_fn, summa_gemm_fn)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(3)
+
+
+def test_make_mesh_square_factorization():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.devices.shape == (2, 4)
+    assert mesh.axis_names == ("p", "q")
+
+
+def test_summa_gemm_matches_numpy(rng):
+    mesh = make_mesh()
+    p, q = mesh.devices.shape
+    a = rng.standard_normal((4 * p, 8 * p * q)).astype(np.float32)
+    b = rng.standard_normal((8 * p * q, 4 * q)).astype(np.float32)
+    c = np.asarray(summa_gemm_fn(mesh)(a, b))
+    np.testing.assert_allclose(c, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_reduce_gemm_matches_numpy(rng):
+    mesh = make_mesh(shape=(8,), axis_names=("p",))
+    a = rng.standard_normal((16, 32)).astype(np.float32)
+    b = rng.standard_normal((32, 24)).astype(np.float32)
+    c = np.asarray(ring_reduce_gemm_fn(mesh)(a, b))
+    np.testing.assert_allclose(c, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_halo_stencil_matches_serial(rng):
+    mesh = make_mesh(shape=(8,), axis_names=("p",))
+    x = rng.standard_normal(64).astype(np.float32)
+
+    def serial_step(u):
+        ext = np.concatenate([u[-1:], u, u[:1]])
+        return (ext[:-2] + ext[2:] + u) / 3.0
+
+    want = serial_step(serial_step(x))
+    got = np.asarray(halo_stencil_fn(mesh, steps=2)(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as g
+    import jax
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert np.asarray(out).shape == args[0].shape
+    g.dryrun_multichip(8)
