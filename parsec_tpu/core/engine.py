@@ -214,6 +214,12 @@ def release_deps(es, task: Task) -> List[Task]:
     if entry is not None:
         entry.on_retire = _make_retire(task)
         tc.repo.entry_addto_usage_limit(task.key, consumers)
+
+    # dynamically-discovered pools (DTD) resolve successors from their
+    # runtime dep graph rather than from flow expressions
+    dynamic = getattr(tp, "dynamic_release", None)
+    if dynamic is not None:
+        ready.extend(dynamic(es, task))
     return ready
 
 

@@ -315,7 +315,7 @@ class Task:
 
     __slots__ = ("task_class", "taskpool", "locals", "key", "priority",
                  "status", "data", "input_sources", "chore_mask", "seq",
-                 "device", "prof")
+                 "device", "prof", "dtd")
 
     def __init__(self, task_class: TaskClass, taskpool, locals_: Dict[str, int]):
         self.task_class = task_class
@@ -333,6 +333,7 @@ class Task:
         self.seq = next(_task_seq)
         self.device = None
         self.prof = None
+        self.dtd = None     # DTD dep-bookkeeping state, if dynamically inserted
 
     def __repr__(self):
         args = ",".join(f"{k}={v}" for k, v in self.locals.items())

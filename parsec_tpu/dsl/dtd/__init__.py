@@ -1,0 +1,8 @@
+"""DTD front-end: dynamic task discovery (insert_task).
+
+reference: parsec/interfaces/dtd/ — see insert.py in this package.
+"""
+
+from parsec_tpu.dsl.dtd.insert import (AFFINITY, DONT_TRACK, INOUT,  # noqa: F401
+                                       INPUT, OUTPUT, SCRATCH, VALUE,
+                                       DTDTaskpool, DTDTile)

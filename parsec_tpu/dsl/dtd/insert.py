@@ -1,0 +1,384 @@
+"""Dynamic task discovery: the insert_task programming model.
+
+Rebuild of the reference's DTD interface (reference:
+parsec/interfaces/dtd/insert_function.{c,h} — ``parsec_dtd_insert_task``
+varargs API :3488, task creation :3220, last-writer dependency inference
+``set_dependencies_for_function`` :2128, tile wrappers ``parsec_dtd_tile_of``
+:1285, window throttling :131-141/:604, and the RAW/WAR/WAW successor
+ordering of overlap_strategies.c:138): the application inserts tasks one by
+one; the runtime discovers the DAG from how tasks touch *tiles* — for each
+tile it tracks the last writer and the readers since, so
+
+    RAW  — a reader depends on the last writer,
+    WAR  — a writer depends on every reader since the last writer,
+    WAW  — a writer depends on the previous writer (transitively via
+           its readers when there are any).
+
+Tasks whose dependencies are already satisfied schedule immediately; the
+rest wake through the dynamic-release hook as predecessors complete.
+Insertion throttles on a sliding window (reference: dtd_window_size) so a
+fast producer cannot flood memory with pending tasks.
+
+TPU notes: ``device="tpu"`` insertions run through the XLA device module
+exactly like PTG device bodies (reference: parsec_dtd_gpu_task_submit →
+parsec_cuda_kernel_scheduler, insert_function.c:2359-2399); tiles stay
+device-resident between tasks and flush home on ``data_flush_all``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from parsec_tpu.core import scheduling
+from parsec_tpu.core.task import (Flow, HookReturn, Task, TaskClass,
+                                  normalize_body_outputs)
+from parsec_tpu.core.taskpool import Taskpool
+from parsec_tpu.data.collection import DataCollection, DataRef
+from parsec_tpu.data.data import (ACCESS_READ, ACCESS_RW, ACCESS_WRITE, Data,
+                                  new_data)
+from parsec_tpu.utils.mca import params
+
+params.register("dtd_window_size", 2048,
+                "max in-flight DTD tasks before insert_task throttles")
+params.register("dtd_threshold_size", 1024,
+                "resume insertion below this many in-flight tasks")
+
+
+# -- argument modes (reference: insert_function.h:60-78 flags) --------------
+
+class _Mode:
+    def __init__(self, name: str, access: int):
+        self.name = name
+        self.access = access
+
+    def __repr__(self):
+        return self.name
+
+
+INPUT = _Mode("INPUT", ACCESS_READ)
+OUTPUT = _Mode("OUTPUT", ACCESS_WRITE)
+INOUT = _Mode("INOUT", ACCESS_RW)
+VALUE = _Mode("VALUE", 0)        # pass-by-value scalar
+SCRATCH = _Mode("SCRATCH", 0)    # per-task temporary buffer
+AFFINITY = _Mode("AFFINITY", 0)  # placement hint marker (modifier)
+DONT_TRACK = _Mode("DONT_TRACK", 0)  # access data without dep tracking
+
+
+class DTDTile:
+    """Dep-tracking state of one datum (reference: parsec_dtd_tile_t —
+    last_user / last_writer tracking)."""
+
+    __slots__ = ("data", "last_writer", "readers")
+
+    def __init__(self, data: Data):
+        self.data = data
+        self.last_writer: Optional["_DTDState"] = None
+        self.readers: List["_DTDState"] = []
+
+
+class _DTDState:
+    """Runtime dep bookkeeping of one inserted task."""
+
+    __slots__ = ("task", "remaining", "successors", "done", "affinity")
+
+    def __init__(self, task: Task):
+        self.task = task
+        self.remaining = 0
+        self.successors: List["_DTDState"] = []
+        self.done = False
+        self.affinity = None
+
+
+_seq = itertools.count()
+
+
+class DTDTaskpool(Taskpool):
+    """Taskpool populated by ``insert_task`` calls
+    (reference: parsec_dtd_taskpool_new, insert_function.c:1412)."""
+
+    def __init__(self, name: str = "dtd"):
+        super().__init__(name=name)
+        self._dep_lock = threading.Lock()
+        self._tiles: Dict[Any, DTDTile] = {}
+        self._classes: Dict[Any, TaskClass] = {}
+        self._inflight = 0
+        self._window = threading.Condition(self._dep_lock)
+        self._finished = False
+        self.window_size = params.get("dtd_window_size", 2048)
+        self.threshold = params.get("dtd_threshold_size", 1024)
+
+    # -- lifecycle ---------------------------------------------------------
+    def attach(self, context, termdet) -> None:
+        super().attach(context, termdet)
+        # hold the pool open until wait(): counters transiting 0 between
+        # insertions must not terminate it (reference: DTD pools keep a
+        # runtime action until parsec_dtd_taskpool_wait)
+        termdet.taskpool_addto_runtime_actions(self, 1)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Drain: all inserted tasks complete
+        (reference: parsec_dtd_taskpool_wait, insert_function.c:691).
+        Raises the first task error instead of hanging on a failed DAG."""
+        if self.context is None:
+            raise RuntimeError("taskpool not attached to a context")
+        self.context.start()
+        if not self._finished:
+            self._finished = True
+            self.termdet.taskpool_addto_runtime_actions(self, -1)
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self.wait_local(0.1):
+            self._raise_context_error()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"{self} wait timed out")
+
+    def _raise_context_error(self) -> None:
+        errs = getattr(self.context, "_errors", None)
+        if errs:
+            exc, task = errs[0]
+            raise RuntimeError(f"task {task} failed") from exc
+
+    # -- tiles -------------------------------------------------------------
+    def tile_of(self, dc: DataCollection, *indices) -> DTDTile:
+        """Wrap a collection datum for dep tracking
+        (reference: parsec_dtd_tile_of)."""
+        key = (id(dc), dc.data_key(*indices))
+        with self._dep_lock:
+            t = self._tiles.get(key)
+            if t is None:
+                t = DTDTile(dc.data_of(*indices))
+                self._tiles[key] = t
+            return t
+
+    def tile_new(self, shape: Tuple[int, ...], dtype: Any = np.float32,
+                 key: Any = None) -> DTDTile:
+        """A fresh unowned tile (reference: parsec_dtd_tile_new)."""
+        datum = new_data(np.zeros(shape, dtype), key=key)
+        t = DTDTile(datum)
+        with self._dep_lock:
+            self._tiles[("new", id(datum))] = t
+        return t
+
+    def data_flush_all(self) -> None:
+        """Push every tracked tile home to its host copy
+        (reference: parsec_dtd_data_flush_all)."""
+        with self._dep_lock:
+            tiles = list(self._tiles.values())
+        for t in tiles:
+            t.data.pull_to_host()
+
+    # -- task classes ------------------------------------------------------
+    def _class_for(self, fn: Callable, modes: Tuple[_Mode, ...],
+                   device: str) -> TaskClass:
+        # Closure-free functions dedupe by code object, so the common
+        # "insert a fresh lambda per iteration" pattern reuses one class
+        # (and one jitted kernel) instead of registering one per insert.
+        if getattr(fn, "__closure__", True) is None:
+            key = (fn.__code__, fn.__defaults__, modes, device)
+        else:
+            key = (fn, modes, device)
+        tc = self._classes.get(key)
+        if tc is not None:
+            return tc
+        fn_names = [p.name for p in inspect.signature(fn).parameters.values()]
+        # AFFINITY args are markers, not function parameters: they do not
+        # consume a name from the signature
+        names: List[Optional[str]] = []
+        cursor = 0
+        for mode in modes:
+            if mode is AFFINITY:
+                names.append(None)
+            else:
+                names.append(fn_names[cursor] if cursor < len(fn_names)
+                             else f"arg{cursor}")
+                cursor += 1
+        flows = []
+        for i, mode in enumerate(modes):
+            if mode in (INPUT, OUTPUT, INOUT, DONT_TRACK, SCRATCH):
+                # SCRATCH/DONT_TRACK read-class: a scratch temp is not an
+                # output flow (it would join the body's return contract
+                # and get donated on device); in-place writes to it are
+                # fine, its datum is throwaway
+                access = mode.access if mode in (INPUT, OUTPUT, INOUT) \
+                    else ACCESS_READ
+                flows.append(Flow(names[i], access))
+        writable = [f.name for f in flows if f.access & ACCESS_WRITE]
+        bound = [n for n in names if n is not None]   # fn's actual args
+        incarnations = []
+        if device in ("tpu", "xla", "gpu"):
+            incarnations.append((device, self._device_hook(fn, bound, flows,
+                                                           writable)))
+        incarnations.append(("cpu", self._cpu_hook(fn, bound, writable)))
+        tc = TaskClass(fn.__name__ if hasattr(fn, "__name__") else "dtd_task",
+                       params=[("tid", None)], flows=flows,
+                       incarnations=incarnations)
+        tc.dtd_names = names   # cached: insert_task must not re-inspect
+        self.add_task_class_dynamic(tc)
+        self._classes[key] = tc
+        return tc
+
+    def add_task_class_dynamic(self, tc: TaskClass) -> None:
+        # DTD classes may share a name (same fn, different modes): key by id
+        tc.task_class_id = len(self.task_classes)
+        tc.taskpool = self
+        self.task_classes[f"{tc.name}#{tc.task_class_id}"] = tc
+
+    def _cpu_hook(self, fn: Callable, names: List[str],
+                  writable: List[str]):
+        def hook(es, task):
+            args = []
+            for i, n in enumerate(names):
+                if n in task.data:
+                    copy = task.data[n]
+                    args.append(None if copy is None else copy.payload)
+                elif n in task.locals:
+                    args.append(task.locals[n])
+            ret = fn(*args)
+            if ret is None or isinstance(ret, HookReturn):
+                return ret
+            if not writable:
+                return None
+            outs = normalize_body_outputs(ret, writable, what=str(task))
+            for fname, value in outs.items():
+                copy = task.data.get(fname)
+                if copy is None:
+                    continue
+                if isinstance(copy.payload, np.ndarray):
+                    np.copyto(copy.payload, np.asarray(value))
+                else:
+                    copy.payload = value
+            return None
+        return hook
+
+    def _device_hook(self, fn: Callable, names: List[str], flows, writable):
+        from parsec_tpu.devices.xla import XlaKernel
+        spec = XlaKernel(fn, names, [f.name for f in flows], writable)
+
+        def hook(es, task):
+            reg = getattr(es.context, "device_registry", None)
+            dev = reg.best_device(task) if reg is not None else None
+            if dev is None:
+                return HookReturn.NEXT
+            return dev.submit(es, task, spec)
+        return hook
+
+    # -- insertion ---------------------------------------------------------
+    def insert_task(self, fn: Callable, *args, priority: int = 0,
+                    device: str = "cpu") -> Task:
+        """Insert one task; each arg is ``(value_or_tile, MODE)``
+        (reference: parsec_dtd_insert_task, insert_function.c:3488).
+
+        Tiles may be DTDTile, DataRef (``A(m, n)``), or Data.  VALUE args
+        pass through; SCRATCH allocates a fresh buffer of the given shape.
+        """
+        if self.context is None:
+            raise RuntimeError(
+                "attach the DTD pool to a context before inserting")
+        modes = tuple(m for _, m in args)
+        tc = self._class_for(fn, modes, device)
+        names = tc.dtd_names
+
+        task = Task(tc, self, {"tid": next(_seq)})
+        task.priority = priority
+        state = _DTDState(task)
+        task.dtd = state
+
+        with self._window:
+            # hysteresis: once the window fills, block until drained below
+            # the threshold (reference: dtd_window_size/threshold,
+            # insert_function.h:131-141)
+            if self._inflight >= self.window_size:
+                while self._inflight >= self.threshold:
+                    self._raise_context_error()
+                    self._window.wait(0.1)
+
+        self.termdet.taskpool_addto_nb_tasks(self, 1)
+        tracked: List[Tuple[DTDTile, _Mode]] = []
+        for i, (value, mode) in enumerate(args):
+            name = names[i]
+            if mode is VALUE:
+                task.locals[name] = value
+            elif mode is AFFINITY:
+                state.affinity = value   # placement hint (rank / tile)
+            elif mode is SCRATCH:
+                shape = value if isinstance(value, tuple) else (int(value),)
+                datum = new_data(np.zeros(shape, np.float32))
+                task.data[name] = datum.copy_on(0)
+            elif mode in (INPUT, OUTPUT, INOUT, DONT_TRACK):
+                tile = self._as_tile(value)
+                task.data[name] = tile.data.copy_on(0)
+                if mode is not DONT_TRACK:
+                    tracked.append((tile, mode))
+            else:
+                raise TypeError(f"unsupported arg mode {mode!r}")
+
+        with self._dep_lock:
+            self._inflight += 1
+            for tile, mode in tracked:
+                self._track(state, tile, mode)
+            # read under the lock: once released, a completing predecessor
+            # may drive remaining to 0 and schedule the task itself —
+            # checking outside would double-schedule
+            ready_now = state.remaining == 0
+        if ready_now:
+            scheduling.schedule(self.context.streams[0], [task])
+        return task
+
+    def _as_tile(self, value) -> DTDTile:
+        if isinstance(value, DTDTile):
+            return value
+        if isinstance(value, DataRef):
+            return self.tile_of(value.dc, *value.indices)
+        if isinstance(value, Data):
+            key = ("data", id(value))
+            with self._dep_lock:
+                t = self._tiles.get(key)
+                if t is None:
+                    t = DTDTile(value)
+                    self._tiles[key] = t
+                return t
+        raise TypeError(f"cannot interpret {value!r} as a tile")
+
+    def _track(self, state: _DTDState, tile: DTDTile, mode: _Mode) -> None:
+        """Register RAW/WAR/WAW edges against the tile's history (caller
+        holds _dep_lock; reference: set_dependencies_for_function +
+        parsec_dtd_ordering_correctly)."""
+        def depend_on(pred: _DTDState):
+            if pred is state or pred.done:
+                return
+            pred.successors.append(state)
+            state.remaining += 1
+
+        if mode is INPUT:
+            if tile.last_writer is not None:
+                depend_on(tile.last_writer)        # RAW
+            tile.readers.append(state)
+        else:  # OUTPUT / INOUT: this task becomes the tile's writer
+            for r in tile.readers:                 # WAR
+                depend_on(r)
+            if tile.last_writer is not None:       # WAW (+ RAW for INOUT)
+                depend_on(tile.last_writer)
+            tile.last_writer = state
+            tile.readers = []
+
+    # -- dynamic release (called from engine.release_deps) ----------------
+    def dynamic_release(self, es, task: Task) -> List[Task]:
+        state = task.dtd
+        if not isinstance(state, _DTDState):
+            return []
+        ready: List[Task] = []
+        with self._window:
+            state.done = True
+            self._inflight -= 1
+            for succ in state.successors:
+                succ.remaining -= 1
+                if succ.remaining == 0:
+                    ready.append(succ.task)
+            if self._inflight < self.threshold:
+                self._window.notify_all()
+        return ready

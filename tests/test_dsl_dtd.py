@@ -1,0 +1,219 @@
+"""DTD front-end tests (reference: tests/dsl/dtd/ — task_insertion, war,
+simple_gemm patterns; SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.dsl.dtd import (DTDTaskpool, INOUT, INPUT, OUTPUT, SCRATCH,
+                                VALUE)
+from parsec_tpu.utils.mca import params
+
+
+def make_pool(ctx, name="dtd"):
+    tp = DTDTaskpool(name)
+    ctx.add_taskpool(tp)
+    ctx.start()
+    return tp
+
+
+def test_chain_of_increments():
+    """RAW chain through one tile (dtd_test_task_insertion pattern)."""
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_of(A, 0, 0)
+        for _ in range(25):
+            tp.insert_task(lambda T: T + 1.0, (t, INOUT))
+        tp.wait()
+    np.testing.assert_allclose(
+        np.asarray(A.data_of(0, 0).pull_to_host().payload), 25.0)
+
+
+def test_war_waw_hazards():
+    """Writers wait for readers; readers see the right version
+    (reference: dtd_test_war.c)."""
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4, name="A")
+    B = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4, name="B")
+    A.data_of(0, 0).copy_on(0).payload[:] = 5.0
+    B.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    seen = []
+    with Context(nb_cores=4) as ctx:
+        tp = make_pool(ctx)
+        ta = tp.tile_of(A, 0, 0)
+        tb = tp.tile_of(B, 0, 0)
+        # several readers of A's value 5 accumulate into distinct cells
+        for i in range(4):
+            def reader(src, dst, i=i):
+                seen.append(float(np.asarray(src)[0, 0]))
+                out = np.asarray(dst).copy()
+                out[0, i] = np.asarray(src)[0, 0]
+                return {"dst": out}
+            tp.insert_task(reader, (ta, INPUT), (tb, INOUT))
+        # then a writer overwrites A — must run after every reader
+        tp.insert_task(lambda T: np.full_like(np.asarray(T), 9.0),
+                       (ta, INOUT))
+        # a final reader sees the new value
+        def late(src, dst):
+            out = np.asarray(dst).copy()
+            out[3, 3] = np.asarray(src)[0, 0]
+            return {"dst": out}
+        tp.insert_task(late, (ta, INPUT), (tb, INOUT))
+        tp.wait()
+    assert seen == [5.0, 5.0, 5.0, 5.0]
+    b = np.asarray(B.data_of(0, 0).pull_to_host().payload)
+    np.testing.assert_allclose(b[0, :4], 5.0)
+    assert b[3, 3] == 9.0
+
+
+def test_value_and_scratch_args():
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 1.0
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_of(A, 0, 0)
+
+        def axpy(T, alpha, tmp):
+            tmp[:] = np.asarray(T) * alpha
+            return {"T": tmp}
+        tp.insert_task(axpy, (t, INOUT), (3.0, VALUE), ((4, 4), SCRATCH))
+        tp.wait()
+    np.testing.assert_allclose(
+        np.asarray(A.data_of(0, 0).pull_to_host().payload), 3.0)
+
+
+def test_windowing_throttles_and_completes():
+    params.set("dtd_window_size", 8)
+    params.set("dtd_threshold_size", 4)
+    try:
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+        A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+        with Context(nb_cores=2) as ctx:
+            tp = make_pool(ctx)
+            t = tp.tile_of(A, 0, 0)
+            for _ in range(200):
+                tp.insert_task(lambda T: T + 1.0, (t, INOUT))
+            tp.wait()
+        np.testing.assert_allclose(
+            np.asarray(A.data_of(0, 0).pull_to_host().payload), 200.0)
+    finally:
+        params.unset("dtd_window_size")
+        params.unset("dtd_threshold_size")
+
+
+def test_tile_new_and_flush():
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_new((8, 8))
+        tp.insert_task(lambda T: T + 2.5, (t, INOUT), device="tpu")
+        tp.wait()
+        tp.data_flush_all()
+        np.testing.assert_allclose(
+            np.asarray(t.data.copy_on(0).payload), 2.5)
+
+
+def test_dtd_gemm_device_matches_numpy():
+    """The reference's headline DTD test: tiled GEMM via insert_task on
+    devices (dtd_test_simple_gemm.c)."""
+    mt = nt = kt = 2
+    mb = 16
+    rng = np.random.default_rng(21)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=kt * mb, name="A")
+    B = TwoDimBlockCyclic(mb=mb, nb=mb, lm=kt * mb, ln=nt * mb, name="B")
+    C = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=nt * mb, name="C")
+    for M in (A, B, C):
+        for m, n in M.local_tiles():
+            M.data_of(m, n).copy_on(0).payload[:] = \
+                rng.standard_normal((mb, mb)).astype(np.float32)
+    want = C.to_array() + A.to_array() @ B.to_array()
+
+    def gemm(a, b, c):
+        return {"c": c + a @ b}
+
+    with Context(nb_cores=4) as ctx:
+        tp = make_pool(ctx)
+        for m in range(mt):
+            for n in range(nt):
+                for k in range(kt):
+                    tp.insert_task(gemm,
+                                   (A(m, k), INPUT), (B(k, n), INPUT),
+                                   (C(m, n), INOUT), device="tpu")
+        tp.wait()
+    np.testing.assert_allclose(C.to_array(), want, rtol=1e-3, atol=1e-3)
+
+
+def test_failed_task_raises_instead_of_hanging():
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+
+    def boom(T):
+        raise ValueError("kaboom")
+
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_of(A, 0, 0)
+        tp.insert_task(boom, (t, INOUT))
+        tp.insert_task(lambda T: T + 1.0, (t, INOUT))
+        with pytest.raises(RuntimeError):
+            tp.wait(timeout=10)
+
+
+def test_affinity_marker_accepted():
+    from parsec_tpu.dsl.dtd import AFFINITY
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 1.0
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_of(A, 0, 0)
+        task = tp.insert_task(lambda T: T + 1.0, (t, INOUT), (0, AFFINITY))
+        tp.wait()
+        assert task.dtd.affinity == 0
+    np.testing.assert_allclose(
+        np.asarray(A.data_of(0, 0).pull_to_host().payload), 2.0)
+
+
+def test_scratch_with_single_value_return():
+    """SCRATCH is not an output flow: one-value return binds to T only."""
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 2.0
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_of(A, 0, 0)
+        tp.insert_task(lambda T, tmp: np.asarray(T) * 2.0,
+                       (t, INOUT), ((4, 4), SCRATCH))
+        tp.wait()
+    np.testing.assert_allclose(
+        np.asarray(A.data_of(0, 0).pull_to_host().payload), 4.0)
+
+
+def test_closure_free_lambdas_share_task_class():
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_of(A, 0, 0)
+        for _ in range(20):
+            tp.insert_task(lambda T: T + 1.0, (t, INOUT))
+        tp.wait()
+        assert len(tp.task_classes) == 1
+    np.testing.assert_allclose(
+        np.asarray(A.data_of(0, 0).pull_to_host().payload), 20.0)
+
+
+def test_mixed_dtd_then_second_pool():
+    """Two DTD pools sequenced on one context."""
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    with Context(nb_cores=2) as ctx:
+        tp1 = make_pool(ctx, "p1")
+        t1 = tp1.tile_of(A, 0, 0)
+        tp1.insert_task(lambda T: T + 1.0, (t1, INOUT))
+        tp1.wait()
+        tp2 = make_pool(ctx, "p2")
+        t2 = tp2.tile_of(A, 0, 0)
+        tp2.insert_task(lambda T: T * 3.0, (t2, INOUT))
+        tp2.wait()
+    np.testing.assert_allclose(
+        np.asarray(A.data_of(0, 0).pull_to_host().payload), 3.0)
