@@ -67,7 +67,30 @@ def gemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
         return np.asarray(Ci) + alpha * np.matmul(np.asarray(Ai),
                                                   np.asarray(Bi))
 
+    distributed = C.nodes > 1
     p = PTG("gemm", MT=mt, NT=nt, KT=kt)
+    if distributed:
+        # Owner-computes reader tasks broadcast each A-row / B-column
+        # panel to the GEMM tasks that consume it — the dataflow bcast
+        # tree of the reference (remote_dep.c star/chain/binomial) and
+        # the PTG form of SUMMA's panel broadcasts.  Single-rank builds
+        # skip the indirection and read the collection directly.
+        p.task("RA", m=Range(0, mt - 1), k=Range(0, kt - 1)) \
+            .affinity(lambda m, k, A=A: A(m, k)) \
+            .flow("T", "READ",
+                  IN(DATA(lambda m, k, A=A: A(m, k))),
+                  OUT(TASK("GEMM", "Ai",
+                           lambda m, k, NT=nt: [dict(m=m, n=n, k=k)
+                                                for n in range(NT)]))) \
+            .body(lambda: None)
+        p.task("RB", k=Range(0, kt - 1), n=Range(0, nt - 1)) \
+            .affinity(lambda k, n, B=B: B(k, n)) \
+            .flow("T", "READ",
+                  IN(DATA(lambda k, n, B=B: B(k, n))),
+                  OUT(TASK("GEMM", "Bi",
+                           lambda k, n, MT=mt: [dict(m=m, n=n, k=k)
+                                                for m in range(MT)]))) \
+            .body(lambda: None)
     if prescale:
         # one-time beta scaling of each C tile, feeding the k=0 step
         # (the reference harness folds beta the same way: the chain
@@ -84,8 +107,14 @@ def gemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
                 m=Range(0, mt - 1), n=Range(0, nt - 1), k=Range(0, kt - 1)) \
         .affinity(lambda m, n, C=C: C(m, n)) \
         .priority(lambda k, KT=kt: KT - k) \
-        .flow("Ai", "READ", IN(DATA(lambda m, k, A=A: A(m, k)))) \
-        .flow("Bi", "READ", IN(DATA(lambda k, n, B=B: B(k, n)))) \
+        .flow("Ai", "READ",
+              IN(TASK("RA", "T", lambda m, k: dict(m=m, k=k)))
+              if distributed else
+              IN(DATA(lambda m, k, A=A: A(m, k)))) \
+        .flow("Bi", "READ",
+              IN(TASK("RB", "T", lambda k, n: dict(k=k, n=n)))
+              if distributed else
+              IN(DATA(lambda k, n, B=B: B(k, n)))) \
         .flow("Ci", "RW",
               IN(TASK("SCALE", "Ci", lambda m, n: dict(m=m, n=n)),
                  when=lambda k: k == 0) if prescale else

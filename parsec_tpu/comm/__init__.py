@@ -1,0 +1,21 @@
+"""Distributed communication layer.
+
+Rebuild of the reference's comm stack (reference: SURVEY.md §2.5/§5.8 —
+parsec_comm_engine.h transport-neutral vtable, parsec_mpi_funnelled.c MPI
+module, remote_dep.c dataflow protocol + bcast trees): an active-message
+comm-engine seam (engine.py, socket transport standing in for the
+reference's MPI and for DCN bootstrap on a pod), the remote-dependency
+activation protocol with eager/rendezvous payloads and star/chain/binomial
+broadcast propagation (remote_dep.py), Safra-token global quiescence (the
+counterpart of the fourcounter termdet), and an mpiexec-style multiprocess
+launcher for tests (launch.py).
+
+On a TPU pod slice the *payload* edges additionally lower to XLA
+collectives over ICI (parallel/spmd.py); this layer carries control
+messages and host-side data movement, exactly the split the reference
+makes between its AM layer and its one-sided put/get.
+"""
+
+from parsec_tpu.comm.engine import CommEngine, SocketCE  # noqa: F401
+from parsec_tpu.comm.remote_dep import RemoteDepEngine  # noqa: F401
+from parsec_tpu.comm.launch import run_distributed  # noqa: F401

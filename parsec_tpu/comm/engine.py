@@ -1,0 +1,292 @@
+"""Comm-engine: transport-neutral active messages + one-sided emulation.
+
+Rebuild of the reference's comm-engine seam (reference:
+parsec/parsec_comm_engine.h:161-183 ``parsec_comm_engine_t`` vtable — AM
+tag register/send, put/get with memory handles, progress, capabilities;
+the funnelled MPI module parsec_mpi_funnelled.c is its only in-tree
+implementation).  ``SocketCE`` implements the vtable over localhost TCP:
+one listener per rank (port base+rank), lazily-connected peer sockets,
+length-prefixed pickled frames, and one receiver thread per peer
+dispatching AM callbacks — the threading stands in for the reference's
+dedicated comm thread; sends are multi-threaded behind per-peer locks
+(capability CE_MT in the reference's terms).
+
+On a TPU pod the same vtable would sit on DCN (host network) for control
+while payloads ride ICI collectives; the socket module doubles as that
+bootstrap path and as the test transport (SURVEY.md §4: the reference
+tests multi-node with mpiexec on one node).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import debug_verbose, warning
+
+params.register("comm_port_base", 0,
+                "TCP port base for the socket comm engine (0 = from env "
+                "PARSEC_COMM_PORT_BASE or 23500)")
+
+# AM tag space (reference: parsec_comm_engine.h:29-38)
+TAG_ACTIVATE = 1
+TAG_GET_REQ = 2
+TAG_GET_REP = 3
+TAG_TERMDET = 4
+TAG_BARRIER = 5
+TAG_USER = 16     # first tag available to applications
+
+_LEN = struct.Struct("!IQ")   # (tag, payload length)
+
+
+class CommEngine:
+    """Vtable (reference: parsec_comm_engine_t)."""
+
+    def __init__(self, rank: int, nranks: int):
+        self.rank = rank
+        self.nranks = nranks
+        self._callbacks: Dict[int, Callable] = {}
+        #: messages for tags nobody registered yet — replayed on register
+        #: (the reference posts persistent recvs per tag at init; here a
+        #: peer may send before this rank finishes wiring its handlers)
+        self._undelivered: Dict[int, List] = {}
+        self._cb_lock = threading.Lock()
+        # message counters (engine-level stats; the remote-dep layer keeps
+        # its own application-message counters for termination detection)
+        self.sent_msgs = 0
+        self.recv_msgs = 0
+        #: set by the remote-dep layer: fatal handler errors fail the rank
+        #: fast instead of silently dropping the message
+        self.on_error: Optional[Callable[[Exception], None]] = None
+
+    def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
+        """cb(src_rank, payload) runs on the comm receive thread."""
+        with self._cb_lock:
+            self._callbacks[tag] = cb
+            backlog = self._undelivered.pop(tag, [])
+        for src, payload in backlog:
+            cb(src, payload)
+
+    def tag_unregister(self, tag: int) -> None:
+        with self._cb_lock:
+            self._callbacks.pop(tag, None)
+
+    def send_am(self, tag: int, dst: int, payload: Any) -> None:
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        raise NotImplementedError
+
+    def fini(self) -> None:
+        pass
+
+    def _dispatch(self, tag: int, src: int, payload: Any) -> None:
+        with self._cb_lock:
+            cb = self._callbacks.get(tag)
+            if cb is None:
+                self._undelivered.setdefault(tag, []).append((src, payload))
+                return
+        cb(src, payload)
+
+
+class SocketCE(CommEngine):
+    """TCP active-message engine (the mpi_funnelled analog)."""
+
+    def __init__(self, rank: int, nranks: int,
+                 port_base: Optional[int] = None):
+        super().__init__(rank, nranks)
+        if port_base is None:
+            port_base = int(params.get("comm_port_base", 0)) or \
+                int(os.environ.get("PARSEC_COMM_PORT_BASE", 23500))
+        self.port_base = port_base
+        self._peers: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._plock = threading.Lock()
+        self._stop = False
+        self._threads: List[threading.Thread] = []
+        self._bar_lock = threading.Lock()
+        self._bar_cond = threading.Condition(self._bar_lock)
+        self._bar_gen = 0
+        self._bar_arrived: Dict[int, int] = {}
+        self._bar_released: set = set()
+        self.tag_register(TAG_BARRIER, self._barrier_cb)
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", self.port_base + rank))
+        self._listener.listen(nranks)
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"ce-accept-{rank}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        # Deterministic connection direction: the HIGHER rank initiates to
+        # each lower rank, eagerly at init, so a pair can never cross-
+        # connect simultaneously and close each other's canonical socket.
+        for dst in range(rank):
+            self._connect(dst)
+
+    # -- connection management -------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # peer announces its rank first
+            hdr = self._recv_exact(conn, 4)
+            if hdr is None:
+                conn.close()
+                continue
+            src = struct.unpack("!I", hdr)[0]
+            with self._plock:
+                self._peers.setdefault(src, conn)
+                self._send_locks.setdefault(src, threading.Lock())
+            t = threading.Thread(target=self._recv_loop, args=(conn, src),
+                                 name=f"ce-recv-{self.rank}<-{src}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _connect(self, dst: int) -> socket.socket:
+        with self._plock:
+            s = self._peers.get(dst)
+            if s is not None:
+                return s
+        if dst > self.rank:
+            # the higher rank owns the initiation: wait for its inbound
+            deadline = time.monotonic() + 30
+            while True:
+                with self._plock:
+                    s = self._peers.get(dst)
+                if s is not None:
+                    return s
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rank {self.rank}: no connection from {dst}")
+                time.sleep(0.01)
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                s = socket.create_connection(
+                    ("127.0.0.1", self.port_base + dst), timeout=5)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(struct.pack("!I", self.rank))
+        with self._plock:
+            self._peers[dst] = s
+            self._send_locks.setdefault(dst, threading.Lock())
+        t = threading.Thread(target=self._recv_loop, args=(s, dst),
+                             name=f"ce-recv-{self.rank}<-{dst}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return s
+
+    # -- framing -----------------------------------------------------------
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _recv_loop(self, conn: socket.socket, src: int) -> None:
+        while not self._stop:
+            hdr = self._recv_exact(conn, _LEN.size)
+            if hdr is None:
+                return
+            tag, ln = _LEN.unpack(hdr)
+            data = self._recv_exact(conn, ln) if ln else b""
+            if data is None:
+                return
+            self.recv_msgs += 1
+            try:
+                payload = pickle.loads(data) if data else None
+                self._dispatch(tag, src, payload)
+            except Exception as exc:   # handler error must not kill recv,
+                warning("rank %d: AM handler tag=%d failed: %s",
+                        self.rank, tag, exc)
+                if self.on_error is not None:   # ...but must fail the rank
+                    self.on_error(exc)
+
+    def send_am(self, tag: int, dst: int, payload: Any = None) -> None:
+        if dst == self.rank:
+            # local delivery short-circuit (counts as a message so the
+            # termination balance stays symmetric)
+            self.sent_msgs += 1
+            self.recv_msgs += 1
+            self._dispatch(tag, self.rank, payload)
+            return
+        data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL) \
+            if payload is not None else b""
+        s = self._connect(dst)
+        with self._send_locks[dst]:
+            self.sent_msgs += 1
+            s.sendall(_LEN.pack(tag, len(data)) + data)
+
+    # -- collective: flat barrier, generation-numbered (gather-to-0 +
+    # release; reference: ce.sync) -----------------------------------------
+    def _barrier_cb(self, src: int, payload: Any) -> None:
+        kind, gen = payload
+        with self._bar_cond:
+            if kind == "arrive":
+                self._bar_arrived[gen] = self._bar_arrived.get(gen, 0) + 1
+            else:
+                self._bar_released.add(gen)
+            self._bar_cond.notify_all()
+
+    def barrier(self, timeout: float = 60.0) -> None:
+        self._bar_gen += 1
+        gen = self._bar_gen
+        if self.nranks == 1:
+            return
+        if self.rank == 0:
+            with self._bar_cond:
+                ok = self._bar_cond.wait_for(
+                    lambda: self._bar_arrived.get(gen, 0) == self.nranks - 1,
+                    timeout=timeout)
+                if not ok:
+                    raise TimeoutError("rank 0: barrier timeout")
+                del self._bar_arrived[gen]
+            for r in range(1, self.nranks):
+                self.send_am(TAG_BARRIER, r, ("release", gen))
+        else:
+            self.send_am(TAG_BARRIER, 0, ("arrive", gen))
+            with self._bar_cond:
+                ok = self._bar_cond.wait_for(
+                    lambda: gen in self._bar_released, timeout=timeout)
+                if not ok:
+                    raise TimeoutError(f"rank {self.rank}: barrier timeout")
+                self._bar_released.discard(gen)
+
+    def fini(self) -> None:
+        self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._plock:
+            for s in self._peers.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._peers.clear()
+        debug_verbose(5, "rank %d CE down: sent=%d recv=%d",
+                      self.rank, self.sent_msgs, self.recv_msgs)

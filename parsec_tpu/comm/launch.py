@@ -1,0 +1,106 @@
+"""Multiprocess SPMD launcher for distributed runs and tests.
+
+The mpiexec analog (reference: tests run distributed cases under
+``${MPI_TEST_CMD_LIST} <nranks>`` = mpiexec -n N on one node,
+CMakeLists.txt:921-952): spawns N python processes, wires each into a
+SocketCE + RemoteDepEngine + Context, runs ``fn(ctx, rank, nranks)``
+SPMD, and gathers per-rank results (or the first traceback).
+
+Children force jax onto CPU (set ``PARSEC_LAUNCH_PLATFORM`` to override)
+so distributed tests run anywhere, mirroring the reference's
+multi-process-on-one-node strategy.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import random
+import traceback
+from typing import Any, Callable, List, Optional
+
+
+def _worker(rank: int, nranks: int, port_base: int, nb_cores: int,
+            fn: Callable, args: tuple, outq) -> None:
+    os.environ.setdefault("PARSEC_COMM_PORT_BASE", str(port_base))
+    platform = os.environ.get("PARSEC_LAUNCH_PLATFORM", "cpu")
+    os.environ["JAX_PLATFORMS"] = platform
+    try:
+        try:
+            import jax
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass
+        from parsec_tpu.comm.engine import SocketCE
+        from parsec_tpu.comm.remote_dep import RemoteDepEngine
+        from parsec_tpu.core.context import Context
+
+        ce = SocketCE(rank, nranks, port_base)
+        ctx = Context(nb_cores=nb_cores, rank=rank, nranks=nranks)
+        rde = RemoteDepEngine(ce, ctx)
+        ce.barrier()   # every rank's handlers are wired before user code
+        try:
+            result = fn(ctx, rank, nranks, *args)
+            ce.barrier()
+            outq.put((rank, None, result))
+        finally:
+            ctx.fini()
+            rde.fini()
+    except Exception:
+        outq.put((rank, traceback.format_exc(), None))
+
+
+def run_distributed(fn: Callable, nranks: int, args: tuple = (),
+                    nb_cores: int = 2, timeout: float = 120.0,
+                    port_base: Optional[int] = None) -> List[Any]:
+    """Run ``fn(ctx, rank, nranks, *args)`` on ``nranks`` processes;
+    returns the per-rank results in rank order."""
+    if port_base is None:
+        port_base = random.randrange(20000, 60000 - nranks)
+    mpctx = mp.get_context("spawn")
+    outq = mpctx.Queue()
+    procs = [mpctx.Process(target=_worker,
+                           args=(r, nranks, port_base, nb_cores, fn, args,
+                                 outq),
+                           daemon=True)
+             for r in range(nranks)]
+    # Children must NOT initialize real accelerator plugins: a TPU tunnel
+    # admits one claimant, so N spawned ranks racing for it hang or crawl.
+    # Env is inherited at spawn — patch, start, restore.
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    os.environ["JAX_PLATFORMS"] = \
+        os.environ.get("PARSEC_LAUNCH_PLATFORM", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        for p in procs:
+            p.start()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    results: dict = {}
+    errors: List[str] = []
+    try:
+        for _ in range(nranks):
+            rank, err, res = outq.get(timeout=timeout)
+            if err is not None:
+                errors.append(f"rank {rank}:\n{err}")
+            else:
+                results[rank] = res
+    except Exception as exc:
+        for p in procs:
+            p.terminate()
+        raise TimeoutError(
+            f"distributed run incomplete ({len(results)}/{nranks} ranks): "
+            f"{errors or exc}")
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    if errors:
+        raise RuntimeError("distributed run failed:\n" + "\n".join(errors))
+    return [results[r] for r in range(nranks)]

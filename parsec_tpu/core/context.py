@@ -70,6 +70,10 @@ class Context:
         self._cond = threading.Condition(self._lock)
         self._active_taskpools = 0
         self._pending_start: List[Taskpool] = []
+        #: taskpool_id -> taskpool; kept after completion so late remote
+        #: messages (GET serving) still resolve (reference: taskpool
+        #: registry hash, parsec_internal.h)
+        self.taskpools: dict = {}
         self._errors: List[tuple] = []
         self._pins = {}
         self.comm = None               # comm engine (distributed layer)
@@ -127,6 +131,10 @@ class Context:
             self._active_taskpools += 1
             tp.attach(self, self._termdet)
             self._pending_start.append(tp)
+            self.taskpools[tp.taskpool_id] = tp
+        if self.comm is not None:
+            # activations may have raced this registration
+            self.comm.retry_delayed()
         if start:
             self.start()
 
@@ -142,6 +150,9 @@ class Context:
             if ready:
                 scheduling.schedule(self.streams[0], ready)
             tp.ready()
+            if self.comm is not None:
+                # activations delayed while this pool counted its tasks
+                self.comm.retry_delayed()
 
     def _taskpool_terminated(self, tp: Taskpool) -> None:
         with self._cond:
@@ -167,6 +178,11 @@ class Context:
             raise RuntimeError(f"task {task} failed") from exc
         if not ok:
             raise TimeoutError("parsec context wait timed out")
+        if self.comm is not None:
+            # distributed: local completion is not global completion —
+            # peers may still pull our data (reference: ranks keep
+            # progressing comm until termdet quiesces the whole run)
+            self.comm.wait_quiescence()
 
     def record_error(self, exc: Exception, task: Task) -> None:
         with self._cond:

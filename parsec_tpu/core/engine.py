@@ -220,6 +220,12 @@ def release_deps(es, task: Task) -> List[Task]:
     dynamic = getattr(tp, "dynamic_release", None)
     if dynamic is not None:
         ready.extend(dynamic(es, task))
+
+    # ship buffered remote activations as one message per flow down the
+    # bcast tree (reference: parsec_remote_dep_activate after
+    # iterate_successors filled the rank bitmask)
+    if tp.context is not None and tp.context.comm is not None:
+        tp.context.comm.flush_activations(es, task)
     return ready
 
 

@@ -1,0 +1,224 @@
+"""Distributed tests: multiprocess SPMD over the socket comm engine.
+
+Mirrors the reference's multi-process-on-one-node strategy (SURVEY.md §4:
+``mpiexec -n N`` on one node; dtd_test_ce.c drives the comm-engine vtable
+directly; Ex05_Broadcast exercises the activation fan-out; apps/pingpong
+measures the link).  Worker functions are module-level for spawn pickling.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.launch import run_distributed
+
+# -- comm engine direct (reference: dtd_test_ce.c) --------------------------
+
+def _ce_echo(ctx, rank, nranks):
+    import threading
+    from parsec_tpu.comm.engine import TAG_USER
+    got = []
+    evt = threading.Event()
+
+    def cb(src, payload):
+        got.append((src, payload))
+        evt.set()
+
+    ce = ctx.comm.ce
+    ce.tag_register(TAG_USER, cb)
+    ce.barrier()
+    ce.send_am(TAG_USER, (rank + 1) % nranks, {"hello": rank})
+    if not evt.wait(30):
+        raise TimeoutError("no AM received")
+    ce.barrier()
+    src, payload = got[0]
+    assert src == (rank - 1) % nranks
+    assert payload == {"hello": src}
+    return "ok"
+
+
+def test_ce_am_ring():
+    assert run_distributed(_ce_echo, 3) == ["ok"] * 3
+
+
+# -- PTG chain across ranks (reference: Ex03 chain over MPI) ----------------
+
+def _chain(ctx, rank, nranks):
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    NT = 8
+    V = VectorTwoDimCyclic(mb=4, lm=NT * 4, nodes=nranks, myrank=rank)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0.0
+
+    p = PTG("chain", NT=NT)
+    p.task("S", k=Range(0, NT - 1)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, V=V: V(k)), when=lambda k: k == 0),
+              IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("S", "T", lambda k, NT=NT: dict(k=k + 1)),
+                  when=lambda k, NT=NT: k < NT - 1),
+              OUT(DATA(lambda k, V=V: V(k)))) \
+        .body(lambda T: T + 1.0)
+    ctx.add_taskpool(p.build())
+    ctx.wait()
+    # tile k ends with value k+1 (chain accumulates one increment per hop)
+    out = {}
+    for m, _ in V.local_tiles():
+        out[m] = float(np.asarray(V.data_of(m).pull_to_host().payload)[0])
+    return out
+
+
+def test_ptg_chain_across_ranks():
+    results = run_distributed(_chain, 2)
+    merged = {}
+    for r in results:
+        merged.update(r)
+    assert merged == {k: float(k + 1) for k in range(8)}
+
+
+# -- broadcast fan-out (reference: Ex05_Broadcast + bcast topologies) -------
+
+def _bcast(ctx, rank, nranks, topo):
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    from parsec_tpu.utils.mca import params
+    params.set("comm_coll_bcast", topo)
+    ctx.comm.bcast = topo
+    NT = nranks * 2
+    # distinct source and sink collections: a sink must not alias the
+    # root's tile through two flows
+    V = VectorTwoDimCyclic(mb=4, lm=NT * 4, nodes=nranks, myrank=rank,
+                           name="V")
+    W = VectorTwoDimCyclic(mb=4, lm=NT * 4, nodes=nranks, myrank=rank,
+                           name="W")
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0.0
+    for m, _ in W.local_tiles():
+        W.data_of(m).copy_on(0).payload[:] = 0.0
+
+    p = PTG("bcast", NT=NT)
+    p.task("ROOT", z=Range(0, 0)) \
+        .affinity(lambda V=V: V(0)) \
+        .flow("T", "RW",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(TASK("SINK", "T",
+                       lambda NT=NT: [dict(i=i) for i in range(NT)]))) \
+        .body(lambda T: T + 42.0)
+    p.task("SINK", i=Range(0, NT - 1)) \
+        .affinity(lambda i, W=W: W(i)) \
+        .flow("T", "READ", IN(TASK("ROOT", "T", lambda: dict(z=0)))) \
+        .flow("O", "RW", IN(DATA(lambda i, W=W: W(i))),
+              OUT(DATA(lambda i, W=W: W(i)))) \
+        .body(lambda T, O: {"O": np.asarray(O) + np.asarray(T)})
+    ctx.add_taskpool(p.build())
+    ctx.wait()
+    vals = {}
+    for m, _ in W.local_tiles():
+        vals[m] = float(np.asarray(W.data_of(m).pull_to_host().payload)[0])
+    return vals
+
+
+@pytest.mark.parametrize("topo", ["star", "chain", "binomial"])
+def test_broadcast_topologies(topo):
+    results = run_distributed(_bcast, 3, args=(topo,))
+    merged = {}
+    for r in results:
+        merged.update(r)
+    assert merged == {i: 42.0 for i in range(6)}
+
+
+# -- rendezvous GET for large payloads --------------------------------------
+
+def _rendezvous(ctx, rank, nranks):
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    ctx.comm.eager = 16   # force the GET path for any real tile
+    NT = 4
+    V = VectorTwoDimCyclic(mb=256, lm=NT * 256, nodes=nranks, myrank=rank)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = float(m)
+
+    p = PTG("rdv", NT=NT)
+    p.task("S", k=Range(0, NT - 1)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, V=V: V(k)), when=lambda k: k == 0),
+              IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("S", "T", lambda k, NT=NT: dict(k=k + 1)),
+                  when=lambda k, NT=NT: k < NT - 1),
+              OUT(DATA(lambda k, V=V: V(k)))) \
+        .body(lambda T: T + 1.0)
+    ctx.add_taskpool(p.build())
+    ctx.wait()
+    out = {}
+    for m, _ in V.local_tiles():
+        out[m] = float(np.asarray(V.data_of(m).pull_to_host().payload)[0])
+    return out
+
+
+def test_rendezvous_get_path():
+    results = run_distributed(_rendezvous, 2)
+    merged = {}
+    for r in results:
+        merged.update(r)
+    # chain carries tile 0's value (0.0) forward, +1 per hop
+    assert merged == {k: float(k + 1) for k in range(4)}
+
+
+# -- distributed tiled GEMM (reference: the DPLASMA-style driver) -----------
+
+def _seed(name, m, n):
+    # deterministic across processes (str hash() is randomized per run)
+    return (ord(name[0]) * 10007 + m * 101 + n) % (2**31)
+
+
+def _dist_gemm(ctx, rank, nranks):
+    from parsec_tpu.apps.gemm import gemm_taskpool
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    mt = nt = kt = 4
+    mb = 8
+    P = 2
+    mk = dict(nodes=nranks, myrank=rank, P=P)
+
+    def fill(M):
+        for m, n in M.local_tiles():
+            rng = np.random.default_rng(_seed(M.name, m, n))
+            M.data_of(m, n).copy_on(0).payload[:] = \
+                rng.standard_normal((mb, mb)).astype(np.float32)
+
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=kt * mb, name="A",
+                          **mk)
+    B = TwoDimBlockCyclic(mb=mb, nb=mb, lm=kt * mb, ln=nt * mb, name="B",
+                          **mk)
+    C = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=nt * mb, name="C",
+                          **mk)
+    for M in (A, B, C):
+        fill(M)
+    ctx.add_taskpool(gemm_taskpool(A, B, C, device="cpu"))
+    ctx.wait()
+
+    # every rank can rebuild the GLOBAL inputs deterministically and
+    # check its local C tiles against the numpy answer
+    def full(name, rows, cols):
+        out = np.zeros((rows * mb, cols * mb), np.float32)
+        for m in range(rows):
+            for n in range(cols):
+                rng = np.random.default_rng(_seed(name, m, n))
+                out[m * mb:(m + 1) * mb, n * mb:(n + 1) * mb] = \
+                    rng.standard_normal((mb, mb)).astype(np.float32)
+        return out
+    want = full("C", mt, nt) + full("A", mt, kt) @ full("B", kt, nt)
+    for m, n in C.local_tiles():
+        got = np.asarray(C.data_of(m, n).pull_to_host().payload)
+        np.testing.assert_allclose(
+            got, want[m * mb:(m + 1) * mb, n * mb:(n + 1) * mb],
+            rtol=1e-3, atol=1e-3)
+    return len(C.local_tiles())
+
+
+def test_distributed_gemm_4ranks():
+    counts = run_distributed(_dist_gemm, 4, timeout=180)
+    assert sum(counts) == 16   # every C tile verified somewhere
