@@ -77,6 +77,7 @@ class Context:
         self._errors: List[tuple] = []
         self._pins = {}
         self.comm = None               # comm engine (distributed layer)
+        self.grapher = None            # DOT grapher (prof layer)
 
         # device layer (reference: parsec_mca_device_init, parsec.c:823)
         from parsec_tpu.devices import init_devices

@@ -180,6 +180,7 @@ def release_deps(es, task: Task) -> List[Task]:
     tp = task.taskpool
     tc = task.task_class
     myrank = tp.context.rank if tp.context else 0
+    grapher = tp.context.grapher if tp.context else None
     ready: List[Task] = []
     consumers = 0
     entry = None
@@ -194,6 +195,9 @@ def release_deps(es, task: Task) -> List[Task]:
             elif isinstance(end, ToTask):
                 succ_tc = tp.task_classes[end.task_class]
                 for succ_locals in end.instances(task.locals):
+                    if grapher is not None:
+                        grapher.edge(task, succ_tc.make_key(succ_locals),
+                                     flow.name)
                     if succ_tc.rank_of(succ_locals) != myrank:
                         tp.context.remote_dep_activate(
                             es, task, flow, dep, succ_tc, succ_locals, copy)

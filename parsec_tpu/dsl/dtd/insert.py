@@ -371,11 +371,14 @@ class DTDTaskpool(Taskpool):
         state = task.dtd
         if not isinstance(state, _DTDState):
             return []
+        grapher = self.context.grapher if self.context else None
         ready: List[Task] = []
         with self._window:
             state.done = True
             self._inflight -= 1
             for succ in state.successors:
+                if grapher is not None:
+                    grapher.edge(task, succ.task.key, "dtd")
                 succ.remaining -= 1
                 if succ.remaining == 0:
                     ready.append(succ.task)

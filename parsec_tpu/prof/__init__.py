@@ -1,0 +1,13 @@
+"""Observability: binary tracing, PINS instrumentation, DOT graphs, gauges.
+
+reference: SURVEY.md §2.11/§5.1 — parsec/profiling.c binary trace +
+dictionary, mca/pins/ callback framework, parsec_prof_grapher.c DOT
+output, papi_sde.c live gauges, tools/profiling readers.
+"""
+
+from parsec_tpu.prof.profiling import (Profile, profiling_init,  # noqa: F401
+                                       profiling_fini)
+from parsec_tpu.prof.pins import TaskProfilerPins, install_task_profiler  # noqa: F401
+from parsec_tpu.prof.grapher import DotGrapher  # noqa: F401
+from parsec_tpu.prof.gauges import Gauges, install_gauges  # noqa: F401
+from parsec_tpu.prof.reader import read_trace  # noqa: F401

@@ -1,0 +1,63 @@
+"""Live runtime gauges: the PAPI-SDE counterpart.
+
+Rebuild of the reference's software-defined-event exports (reference:
+parsec/papi_sde.{c,h} — live gauges MEM_ALLOC/MEM_USED/TASKS_ENABLED/
+TASKS_RETIRED/SCHEDULER_PENDING_TASKS readable by external consumers
+while the runtime runs).  Counters update through PINS events plus
+polling hooks; ``snapshot()`` is the external read.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class Gauges:
+    GAUGE_NAMES = ("tasks_enabled", "tasks_retired", "pending_tasks",
+                   "device_bytes_in", "device_bytes_out",
+                   "device_tasks", "device_evictions")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tasks_enabled = 0     # became ready (scheduled)
+        self.tasks_retired = 0     # completed
+        self.context = None
+
+    def install(self, context) -> None:
+        self.context = context
+        context.pins_register("select", self._select)
+        context.pins_register("complete_exec", self._complete)
+
+    def _select(self, es, event, task) -> None:
+        with self._lock:
+            self.tasks_enabled += 1
+
+    def _complete(self, es, event, task) -> None:
+        with self._lock:
+            self.tasks_retired += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        snap = {
+            "tasks_enabled": self.tasks_enabled,
+            "tasks_retired": self.tasks_retired,
+            "pending_tasks": max(0, self.tasks_enabled - self.tasks_retired),
+            "device_bytes_in": 0,
+            "device_bytes_out": 0,
+            "device_tasks": 0,
+            "device_evictions": 0,
+        }
+        ctx = self.context
+        if ctx is not None:
+            for d in ctx.device_registry.devices[1:]:
+                snap["device_bytes_in"] += d.stats.bytes_in
+                snap["device_bytes_out"] += d.stats.bytes_out
+                snap["device_tasks"] += d.stats.executed_tasks
+                snap["device_evictions"] += d.stats.evictions
+        return snap
+
+
+def install_gauges(context) -> Gauges:
+    g = Gauges()
+    g.install(context)
+    return g

@@ -1,0 +1,88 @@
+"""PINS: performance instrumentation hooks on the task lifecycle.
+
+Rebuild of the reference's PINS framework (reference: parsec/mca/pins/ —
+callback chains on task lifecycle events SELECT/EXEC/COMPLETE_EXEC/...
+(pins.h:22-50) invoked by PARSEC_PINS macros in scheduling.c; the
+``task_profiler`` module feeds the binary tracer).  The runtime already
+emits events through ``ExecutionStream.pins`` (core/context.py); modules
+here subscribe to them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from parsec_tpu.prof.profiling import EV_POINT, Profile
+
+#: lifecycle events emitted by the runtime (scheduling.py / context.py)
+PINS_EVENTS = ("select", "exec_begin", "exec_end", "exec_async",
+               "complete_exec")
+
+
+class TaskProfilerPins:
+    """Feed task execution intervals into the binary trace
+    (reference: mca/pins/task_profiler)."""
+
+    def __init__(self, profile: Profile):
+        self.profile = profile
+        self._event_ids: Dict[int, int] = {}   # task seq -> trace event id
+
+    def install(self, context) -> None:
+        context.pins_register("exec_begin", self._begin)
+        context.pins_register("exec_end", self._end)
+        context.pins_register("complete_exec", self._complete)
+
+    def uninstall(self, context) -> None:
+        context.pins_unregister("exec_begin", self._begin)
+        context.pins_unregister("exec_end", self._end)
+        context.pins_unregister("complete_exec", self._complete)
+
+    def _sb(self, es):
+        return self.profile.stream(es.th_id, f"worker-{es.th_id}")
+
+    def _begin(self, es, event, task) -> None:
+        eid = self.profile.next_event_id()
+        self._event_ids[task.seq] = eid
+        self.profile.trace_interval_start(
+            self._sb(es), task.task_class.name, task.taskpool.taskpool_id,
+            eid, object_id=hash(task.key),
+            info={"locals": dict(task.locals)})
+
+    def _end(self, es, event, task) -> None:
+        eid = self._event_ids.get(task.seq, 0)
+        self.profile.trace_interval_end(
+            self._sb(es), task.task_class.name, task.taskpool.taskpool_id,
+            eid, object_id=hash(task.key))
+
+    def _complete(self, es, event, task) -> None:
+        # device (ASYNC) tasks never ran exec_end on a worker stream:
+        # close their interval at completion
+        eid = self._event_ids.pop(task.seq, None)
+        if eid is None:
+            return
+        sb = self._sb(es)
+        for key, flags, tp, e, oid, ts, info in reversed(sb.events):
+            if e == eid and flags & 2:      # already closed by _end
+                return
+        self.profile.trace_interval_end(
+            sb, task.task_class.name, task.taskpool.taskpool_id, eid,
+            object_id=hash(task.key))
+
+
+def install_task_profiler(context, profile: Profile) -> TaskProfilerPins:
+    mod = TaskProfilerPins(profile)
+    mod.install(context)
+    return mod
+
+
+class StealCounterPins:
+    """Per-stream select counters (reference: mca/pins/print_steals)."""
+
+    def __init__(self):
+        self.selects: Dict[int, int] = {}
+
+    def install(self, context) -> None:
+        context.pins_register("select", self._select)
+
+    def _select(self, es, event, task) -> None:
+        self.selects[es.th_id] = self.selects.get(es.th_id, 0) + 1

@@ -1,0 +1,62 @@
+"""Trace reader: .ptt files -> pandas DataFrame.
+
+Rebuild of the reference's trace tooling (reference: tools/profiling/
+dbpreader.c + python/pbt2ptt.pyx + parsec_trace_tables.py — binary trace
+to pandas tables with one row per event, interval events paired into
+begin/end rows).  ``read_trace`` returns (meta, events_df) where the
+DataFrame has columns: stream, key, name, flags, taskpool_id, event_id,
+object_id, ts, info; ``intervals`` pairs START/END rows into one row per
+executed task with a duration.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Dict, Tuple
+
+from parsec_tpu.prof.profiling import EV_END, EV_START, MAGIC, _EV
+
+
+def read_trace(path: str):
+    import pandas as pd
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:8] != MAGIC:
+        raise ValueError(f"{path}: not a parsec_tpu trace")
+    off = 8
+    (mlen,) = struct.unpack_from("!Q", raw, off)
+    off += 8
+    meta = pickle.loads(raw[off:off + mlen])
+    off += mlen
+    key_names = {k: name for k, name, _attrs in meta["dictionary"]}
+    rows = []
+    for stream_id, name, nev in meta["streams"]:
+        events = []
+        for _ in range(nev):
+            events.append(_EV.unpack_from(raw, off))
+            off += _EV.size
+        (ilen,) = struct.unpack_from("!Q", raw, off)
+        off += 8
+        infos = pickle.loads(raw[off:off + ilen])
+        off += ilen
+        for i, (key, flags, tp, eid, oid, ts) in enumerate(events):
+            rows.append({
+                "stream": stream_id, "key": key,
+                "name": key_names.get(key, f"key{key}"),
+                "flags": flags, "taskpool_id": tp, "event_id": eid,
+                "object_id": oid, "ts": ts, "info": infos.get(i),
+            })
+    return meta, pd.DataFrame(rows)
+
+
+def intervals(events_df):
+    """Pair START/END events into one row per interval with duration."""
+    import pandas as pd
+    starts = events_df[(events_df["flags"] & EV_START) != 0]
+    ends = events_df[(events_df["flags"] & EV_END) != 0]
+    merged = starts.merge(
+        ends[["event_id", "ts"]], on="event_id",
+        suffixes=("_begin", "_end"))
+    merged["duration"] = merged["ts_end"] - merged["ts_begin"]
+    return merged

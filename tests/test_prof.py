@@ -1,0 +1,120 @@
+"""Observability tests (reference: tests/profiling/check-async.py /
+check-comms.py — run a traced pool, read the trace back, assert event
+sanity; SURVEY.md §2.11)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+from parsec_tpu.prof import (DotGrapher, install_gauges,
+                             install_task_profiler, profiling_init,
+                             read_trace)
+from parsec_tpu.prof.reader import intervals
+
+
+def _chain_pool(A, nt, device="cpu"):
+    p = PTG("chain", NT=nt)
+    p.task("S", k=Range(0, nt - 1)) \
+        .affinity(lambda k, A=A: A(0, 0)) \
+        .flow("T", "RW",
+              IN(DATA(lambda A=A: A(0, 0)), when=lambda k: k == 0),
+              IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("S", "T", lambda k, NT=nt: dict(k=k + 1)),
+                  when=lambda k, NT=nt: k < NT - 1),
+              OUT(DATA(lambda A=A: A(0, 0)),
+                  when=lambda k, NT=nt: k == NT - 1)) \
+        .body(lambda T: T + 1.0, device=device)
+    return p.build()
+
+
+@pytest.mark.parametrize("device", ["cpu", "tpu"])
+def test_trace_intervals_complete(tmp_path, device):
+    """Every executed task appears as one START/END pair with positive
+    duration — including ASYNC device tasks (the reference's check-async
+    property)."""
+    nt = 12
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    prof = profiling_init("test")
+    with Context(nb_cores=2) as ctx:
+        mod = install_task_profiler(ctx, prof)
+        ctx.add_taskpool(_chain_pool(A, nt, device))
+        ctx.wait()
+        mod.uninstall(ctx)
+    path = prof.dump(str(tmp_path / "trace.ptt"))
+    meta, df = read_trace(path)
+    assert meta["hr_id"] == "test"
+    ivs = intervals(df)
+    assert len(ivs) == nt                       # one interval per task
+    assert (ivs.duration > 0).all()
+    assert set(ivs["name"].unique()) == {"S"}
+    # info payloads carry the task parameters
+    ks = sorted(iv["locals"]["k"] for iv in ivs["info"])
+    assert ks == list(range(nt))
+
+
+def test_gauges_track_lifecycle():
+    nt = 9
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    with Context(nb_cores=2) as ctx:
+        g = install_gauges(ctx)
+        ctx.add_taskpool(_chain_pool(A, nt))
+        ctx.wait()
+        snap = g.snapshot()
+    assert snap["tasks_retired"] == nt
+    assert snap["pending_tasks"] == 0
+
+
+def test_dot_grapher_records_dag(tmp_path):
+    nt = 5
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    with Context(nb_cores=2) as ctx:
+        g = DotGrapher(rank=0)
+        g.install(ctx)
+        ctx.add_taskpool(_chain_pool(A, nt))
+        ctx.wait()
+    path = g.dump(str(tmp_path / "dag.dot"))
+    text = open(path).read()
+    assert text.startswith("digraph")
+    assert text.count("->") == nt - 1           # the chain's edges
+    assert text.count('label="S(') == nt        # one node per task
+    assert 'label="T"' in text                  # edges carry flow names
+
+
+def test_dot_grapher_covers_dtd_edges(tmp_path):
+    from parsec_tpu.dsl.dtd import DTDTaskpool, INOUT
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    with Context(nb_cores=2) as ctx:
+        g = DotGrapher()
+        g.install(ctx)
+        tp = DTDTaskpool("d")
+        ctx.add_taskpool(tp)
+        ctx.start()
+        t = tp.tile_of(A, 0, 0)
+        for _ in range(4):
+            tp.insert_task(lambda T: T + 1.0, (t, INOUT))
+        tp.wait()
+    text = open(g.dump(str(tmp_path / "dtd.dot"))).read()
+    assert text.count("->") == 3
+
+
+def test_trace_roundtrip_dictionary_and_streams(tmp_path):
+    prof = profiling_init("dicts")
+    prof.add_information("who", "tester")
+    sb = prof.stream(7, "custom")
+    ec = prof.add_event_class("MYEV", "u64:val")
+    sb.trace(ec.key, 4, 1, 1, 0, info={"val": 42})
+    path = prof.dump(str(tmp_path / "t.ptt"))
+    meta, df = read_trace(path)
+    assert meta["info"]["who"] == "tester"
+    assert (df["name"] == "MYEV").all()
+    assert df.iloc[0]["info"] == {"val": 42}
+    assert df.iloc[0]["stream"] == 7
