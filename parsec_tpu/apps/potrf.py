@@ -1,0 +1,187 @@
+"""Tiled Cholesky factorization (dpotrf, lower): the north-star driver.
+
+The DPLASMA-style dpotrf_L dataflow (reference: BASELINE.md/BASELINE.json
+name DPLASMA tiled Cholesky as the headline target; the JDF structure
+follows the classic four-kernel tiled algorithm the reference's PTG model
+was built for — README.rst:22-27 "compact problem-size-independent
+representation"):
+
+    POTRF(k)    : L[k,k]  = chol(A[k,k])
+    TRSM(m,k)   : A[m,k]  = A[m,k] @ L[k,k]^-T          (m > k)
+    SYRK(k,m)   : A[m,m] -= A[m,k] @ A[m,k]^T           (k < m)
+    GEMM(m,n,k) : A[m,n] -= A[m,k] @ A[n,k]^T           (m > n > k)
+
+Every flow is task-to-task except the first touch of each tile, so the
+same taskpool runs single-chip or distributed (TRSM panels broadcast down
+their block row/column through the comm layer's bcast trees).
+
+TPU notes: all four kernels are single fused XLA ops (cholesky,
+triangular solve, two matmuls) jitted once per tile shape; the priority
+schedule drives the critical path (POTRF > TRSM > SYRK > GEMM at equal
+k) exactly like DPLASMA's priority hints.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from parsec_tpu.core.taskpool import ParameterizedTaskpool
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+
+_kernels = {}
+
+
+def _k_potrf(precision):
+    fn = _kernels.get(("potrf", precision))
+    if fn is None:
+        def fn(T):
+            import jax.numpy as jnp
+            return jnp.linalg.cholesky(T)
+        _kernels[("potrf", precision)] = fn
+    return fn
+
+
+def _k_trsm(precision):
+    fn = _kernels.get(("trsm", precision))
+    if fn is None:
+        def fn(L, C):
+            import jax.scipy.linalg as jsl
+            # C <- C @ L^-T  ==  (L^-1 C^T)^T
+            return jsl.solve_triangular(L, C.T, lower=True).T
+        _kernels[("trsm", precision)] = fn
+    return fn
+
+
+def _k_syrk(precision):
+    fn = _kernels.get(("syrk", precision))
+    if fn is None:
+        def fn(T, R):
+            import jax.numpy as jnp
+            return T - jnp.matmul(R, R.T, precision=precision)
+        _kernels[("syrk", precision)] = fn
+    return fn
+
+
+def _k_gemm(precision):
+    fn = _kernels.get(("gemm", precision))
+    if fn is None:
+        def fn(C, L, R):
+            import jax.numpy as jnp
+            return C - jnp.matmul(L, R.T, precision=precision)
+        _kernels[("gemm", precision)] = fn
+    return fn
+
+
+def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
+                   precision: Optional[str] = None) -> ParameterizedTaskpool:
+    """Factor the lower triangle of A in place: A = L @ L^T."""
+    if A.mt != A.nt:
+        raise ValueError("potrf needs a square tile grid")
+    if A.lm % A.mb or A.ln % A.nb:
+        raise ValueError("potrf tiles must divide the matrix evenly")
+    NT = A.mt
+    mb = A.mb
+    use_device = device in ("tpu", "xla", "gpu")
+
+    def add_bodies(tb, kernel, cpu_fn):
+        if use_device:
+            tb.body(kernel, device=device)
+        tb.body(cpu_fn)
+        return tb
+
+    p = PTG("potrf", NT=NT)
+
+    tb = p.task("POTRF", k=Range(0, NT - 1)) \
+        .affinity(lambda k, A=A: A(k, k)) \
+        .priority(lambda k, NT=NT: 3 * NT - 3 * k + 3) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, A=A: A(k, k)), when=lambda k: k == 0),
+              IN(TASK("SYRK", "T", lambda k: dict(k=k - 1, m=k)),
+                 when=lambda k: k > 0),
+              OUT(TASK("TRSM", "L",
+                       lambda k, NT=NT: [dict(m=m, k=k)
+                                         for m in range(k + 1, NT)]),
+                  when=lambda k, NT=NT: k < NT - 1),
+              OUT(DATA(lambda k, A=A: A(k, k))))
+    add_bodies(tb, _k_potrf(precision),
+               lambda T: np.linalg.cholesky(np.asarray(T)))
+
+    tb = p.task("TRSM", k=Range(0, NT - 2),
+                m=Range(lambda k: k + 1, NT - 1)) \
+        .affinity(lambda m, k, A=A: A(m, k)) \
+        .priority(lambda k, NT=NT: 3 * NT - 3 * k + 2) \
+        .flow("L", "READ", IN(TASK("POTRF", "T", lambda k: dict(k=k)))) \
+        .flow("C", "RW",
+              IN(DATA(lambda m, k, A=A: A(m, k)), when=lambda k: k == 0),
+              IN(TASK("GEMM", "C", lambda m, k: dict(m=m, n=k, k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("SYRK", "R", lambda m, k: dict(k=k, m=m))),
+              OUT(TASK("GEMM", "L",
+                       lambda m, k: [dict(m=m, n=n, k=k)
+                                     for n in range(k + 1, m)]),
+                  when=lambda m, k: m > k + 1),
+              OUT(TASK("GEMM", "R",
+                       lambda m, k, NT=NT: [dict(m=m2, n=m, k=k)
+                                            for m2 in range(m + 1, NT)]),
+                  when=lambda m, NT=NT: m < NT - 1),
+              OUT(DATA(lambda m, k, A=A: A(m, k))))
+
+    def cpu_trsm(L, C):
+        import scipy.linalg as sl
+        return sl.solve_triangular(np.asarray(L), np.asarray(C).T,
+                                   lower=True).T
+    add_bodies(tb, _k_trsm(precision), cpu_trsm)
+
+    tb = p.task("SYRK", m=Range(1, NT - 1), k=Range(0, lambda m: m - 1)) \
+        .affinity(lambda m, A=A: A(m, m)) \
+        .priority(lambda k, NT=NT: 3 * NT - 3 * k + 1) \
+        .flow("T", "RW",
+              IN(DATA(lambda m, A=A: A(m, m)), when=lambda k: k == 0),
+              IN(TASK("SYRK", "T", lambda m, k: dict(m=m, k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("POTRF", "T", lambda m: dict(k=m)),
+                  when=lambda m, k: k == m - 1),
+              OUT(TASK("SYRK", "T", lambda m, k: dict(m=m, k=k + 1)),
+                  when=lambda m, k: k < m - 1)) \
+        .flow("R", "READ", IN(TASK("TRSM", "C", lambda m, k: dict(m=m,
+                                                                  k=k))))
+    add_bodies(tb, _k_syrk(precision),
+               lambda T, R: np.asarray(T) -
+               np.asarray(R) @ np.asarray(R).T)
+
+    tb = p.task("GEMM", n=Range(1, NT - 2),
+                m=Range(lambda n: n + 1, NT - 1),
+                k=Range(0, lambda n: n - 1)) \
+        .affinity(lambda m, n, A=A: A(m, n)) \
+        .priority(lambda k, NT=NT: 3 * NT - 3 * k) \
+        .flow("C", "RW",
+              IN(DATA(lambda m, n, A=A: A(m, n)), when=lambda k: k == 0),
+              IN(TASK("GEMM", "C", lambda m, n, k: dict(m=m, n=n, k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("TRSM", "C", lambda m, n: dict(m=m, k=n)),
+                  when=lambda n, k: k == n - 1),
+              OUT(TASK("GEMM", "C", lambda m, n, k: dict(m=m, n=n, k=k + 1)),
+                  when=lambda n, k: k < n - 1)) \
+        .flow("L", "READ", IN(TASK("TRSM", "C", lambda m, k: dict(m=m,
+                                                                  k=k)))) \
+        .flow("R", "READ", IN(TASK("TRSM", "C", lambda n, k: dict(m=n,
+                                                                  k=k))))
+    add_bodies(tb, _k_gemm(precision),
+               lambda C, L, R: np.asarray(C) -
+               np.asarray(L) @ np.asarray(R).T)
+
+    tp = p.build()
+    for name, tc in tp.task_classes.items():
+        tc.properties["flops"] = {"POTRF": mb ** 3 / 3.0,
+                                  "TRSM": mb ** 3,
+                                  "SYRK": mb ** 3,
+                                  "GEMM": 2.0 * mb ** 3}[name]
+    return tp
+
+
+def potrf_flops(n: int) -> float:
+    """Useful FLOPs of an n x n Cholesky (n^3/3)."""
+    return n ** 3 / 3.0

@@ -1,0 +1,215 @@
+"""Tiled QR factorization (dgeqrf): the irregular-DAG driver.
+
+The DPLASMA-style tiled QR (reference: BASELINE.json names "DPLASMA
+dgeqrf tiled QR (irregular DAG, pod-scale comm/compute overlap)" as a
+headline config).  Classic flat-tree tile algorithm:
+
+    GEQRT(k)    : QR of the diagonal tile; R stays in A[k,k], the
+                  orthogonal factor Q1 (mb x mb) travels on a dataflow
+                  edge.
+    UNMQR(k,n)  : A[k,n] = Q1^T @ A[k,n]                     (n > k)
+    TSQRT(m,k)  : QR of [R; A[m,k]] stacked — updates R in A[k,k] and
+                  zeroes A[m,k]; the stacked factor Q2 (2mb x mb)
+                  travels on an edge.                         (m > k)
+    TSMQR(m,n,k): applies Q2^T to the stacked [A[k,n]; A[m,n]] pair.
+                  (m > k, n > k)
+
+Unlike the storage-compact Householder form, the Q factors ride dataflow
+edges as explicit matrices (NEW-arena temporaries) — the natural choice
+when every kernel is an XLA op (jnp.linalg.qr + matmuls) and edges are
+cheap HBM-resident tiles.  R ends in the upper triangle; tiles below are
+zeroed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from parsec_tpu.core.taskpool import ParameterizedTaskpool
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.dsl.ptg.api import DATA, IN, NEW, OUT, PTG, Range, TASK
+
+_kernels = {}
+
+
+def _k(name, maker):
+    fn = _kernels.get(name)
+    if fn is None:
+        fn = maker()
+        _kernels[name] = fn
+    return fn
+
+
+def _mk_geqrt():
+    def fn(T, Q):
+        import jax.numpy as jnp
+        q, r = jnp.linalg.qr(T, mode="complete")
+        return {"T": r, "Q": q}
+    return fn
+
+
+def _mk_unmqr():
+    def fn(Q, C):
+        import jax.numpy as jnp
+        return {"C": jnp.matmul(Q.T, C)}
+    return fn
+
+
+def _mk_tsqrt():
+    def fn(T, B, Q):
+        import jax.numpy as jnp
+        mb = T.shape[0]
+        stacked = jnp.concatenate([T, B], axis=0)        # (2mb, mb)
+        q, r = jnp.linalg.qr(stacked, mode="complete")   # q: (2mb, 2mb)
+        return {"T": r[:mb, :], "B": jnp.zeros_like(B), "Q": q}
+    return fn
+
+
+def _mk_tsmqr():
+    def fn(Q, C1, C2):
+        import jax.numpy as jnp
+        mb = C1.shape[0]
+        stacked = jnp.concatenate([C1, C2], axis=0)
+        out = jnp.matmul(Q.T, stacked)
+        return {"C1": out[:mb, :], "C2": out[mb:, :]}
+    return fn
+
+
+def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
+    """Factor A in place: R in the upper triangle (Q is applied, not
+    stored).  Requires a square tile grid evenly dividing A."""
+    if A.mt != A.nt:
+        raise ValueError("qr driver needs a square tile grid")
+    if A.lm % A.mb or A.ln % A.nb:
+        raise ValueError("qr tiles must divide the matrix evenly")
+    NT = A.mt
+    mb = A.mb
+    use_device = device in ("tpu", "xla", "gpu")
+
+    def bodies(tb, kernel, cpu_fn):
+        if use_device:
+            tb.body(kernel, device=device)
+        tb.body(cpu_fn)
+        return tb
+
+    p = PTG("geqrf", NT=NT)
+    p.arena("q1", (mb, mb))
+    p.arena("q2", (2 * mb, 2 * mb))
+
+    # GEQRT(k): diagonal QR
+    tb = p.task("GEQRT", k=Range(0, NT - 1)) \
+        .affinity(lambda k, A=A: A(k, k)) \
+        .priority(lambda k, NT=NT: 4 * (NT - k) + 3) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, A=A: A(k, k)), when=lambda k: k == 0),
+              IN(TASK("TSMQR", "C2", lambda k: dict(m=k, n=k, k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("TSQRT", "T", lambda k, NT=NT: dict(m=k + 1, k=k)),
+                  when=lambda k, NT=NT: k < NT - 1),
+              OUT(DATA(lambda k, A=A: A(k, k)),
+                  when=lambda k, NT=NT: k == NT - 1)) \
+        .flow("Q", "RW",
+              IN(NEW("q1")),
+              OUT(TASK("UNMQR", "Q",
+                       lambda k, NT=NT: [dict(k=k, n=n)
+                                         for n in range(k + 1, NT)]),
+                  when=lambda k, NT=NT: k < NT - 1))
+
+    def cpu_geqrt(T, Q):
+        q, r = np.linalg.qr(np.asarray(T), mode="complete")
+        return {"T": r, "Q": q}
+    bodies(tb, _k("geqrt", _mk_geqrt), cpu_geqrt)
+
+    # UNMQR(k, n): apply Q1^T across the k-th block row
+    tb = p.task("UNMQR", k=Range(0, NT - 2), n=Range(lambda k: k + 1,
+                                                     NT - 1)) \
+        .affinity(lambda k, n, A=A: A(k, n)) \
+        .priority(lambda k, NT=NT: 4 * (NT - k) + 2) \
+        .flow("Q", "READ", IN(TASK("GEQRT", "Q", lambda k: dict(k=k)))) \
+        .flow("C", "RW",
+              IN(DATA(lambda k, n, A=A: A(k, n)), when=lambda k: k == 0),
+              IN(TASK("TSMQR", "C2", lambda k, n: dict(m=k, n=n, k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("TSMQR", "C1", lambda k, n: dict(m=k + 1, n=n, k=k))))
+
+    def cpu_unmqr(Q, C):
+        return {"C": np.asarray(Q).T @ np.asarray(C)}
+    bodies(tb, _k("unmqr", _mk_unmqr), cpu_unmqr)
+
+    # TSQRT(m, k): fold block-column tile m into R(k)
+    tb = p.task("TSQRT", k=Range(0, NT - 2), m=Range(lambda k: k + 1,
+                                                     NT - 1)) \
+        .affinity(lambda m, k, A=A: A(m, k)) \
+        .priority(lambda k, NT=NT: 4 * (NT - k) + 1) \
+        .flow("T", "RW",
+              IN(TASK("GEQRT", "T", lambda k: dict(k=k)),
+                 when=lambda m, k: m == k + 1),
+              IN(TASK("TSQRT", "T", lambda m, k: dict(m=m - 1, k=k)),
+                 when=lambda m, k: m > k + 1),
+              OUT(TASK("TSQRT", "T", lambda m, k: dict(m=m + 1, k=k)),
+                  when=lambda m, NT=NT: m < NT - 1),
+              OUT(DATA(lambda k, A=A: A(k, k)),
+                  when=lambda m, NT=NT: m == NT - 1)) \
+        .flow("B", "RW",
+              IN(DATA(lambda m, k, A=A: A(m, k)), when=lambda k: k == 0),
+              IN(TASK("TSMQR", "C2", lambda m, k: dict(m=m, n=k, k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(DATA(lambda m, k, A=A: A(m, k)))) \
+        .flow("Q", "RW",
+              IN(NEW("q2")),
+              OUT(TASK("TSMQR", "Q",
+                       lambda m, k, NT=NT: [dict(m=m, n=n, k=k)
+                                            for n in range(k + 1, NT)]),
+                  when=lambda k, NT=NT: k < NT - 1))
+
+    def cpu_tsqrt(T, B, Q):
+        mb_ = np.asarray(T).shape[0]
+        stacked = np.concatenate([np.asarray(T), np.asarray(B)], axis=0)
+        q, r = np.linalg.qr(stacked, mode="complete")
+        return {"T": r[:mb_, :], "B": np.zeros_like(np.asarray(B)),
+                "Q": q}
+    bodies(tb, _k("tsqrt", _mk_tsqrt), cpu_tsqrt)
+
+    # TSMQR(m, n, k): apply Q2^T to the [A(k,n); A(m,n)] pair
+    tb = p.task("TSMQR", k=Range(0, NT - 2),
+                m=Range(lambda k: k + 1, NT - 1),
+                n=Range(lambda k: k + 1, NT - 1)) \
+        .affinity(lambda m, n, A=A: A(m, n)) \
+        .priority(lambda k, NT=NT: 4 * (NT - k)) \
+        .flow("Q", "READ", IN(TASK("TSQRT", "Q", lambda m, k: dict(m=m,
+                                                                   k=k)))) \
+        .flow("C1", "RW",
+              IN(TASK("UNMQR", "C", lambda n, k: dict(k=k, n=n)),
+                 when=lambda m, k: m == k + 1),
+              IN(TASK("TSMQR", "C1", lambda m, n, k: dict(m=m - 1, n=n,
+                                                          k=k)),
+                 when=lambda m, k: m > k + 1),
+              OUT(TASK("TSMQR", "C1", lambda m, n, k: dict(m=m + 1, n=n,
+                                                           k=k)),
+                  when=lambda m, NT=NT: m < NT - 1),
+              OUT(DATA(lambda k, n, A=A: A(k, n)),
+                  when=lambda m, NT=NT: m == NT - 1)) \
+        .flow("C2", "RW",
+              IN(DATA(lambda m, n, A=A: A(m, n)), when=lambda k: k == 0),
+              IN(TASK("TSMQR", "C2", lambda m, n, k: dict(m=m, n=n,
+                                                          k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("GEQRT", "T", lambda m: dict(k=m)),
+                  when=lambda m, n, k: m == k + 1 and n == k + 1),
+              OUT(TASK("TSQRT", "B", lambda m, n, k: dict(m=m, k=k + 1)),
+                  when=lambda m, n, k: m > k + 1 and n == k + 1),
+              OUT(TASK("UNMQR", "C", lambda m, n, k: dict(k=k + 1, n=n)),
+                  when=lambda m, n, k: m == k + 1 and n > k + 1),
+              OUT(TASK("TSMQR", "C2", lambda m, n, k: dict(m=m, n=n,
+                                                           k=k + 1)),
+                  when=lambda m, n, k: m > k + 1 and n > k + 1))
+    def cpu_tsmqr(Q, C1, C2):
+        mb_ = np.asarray(C1).shape[0]
+        stacked = np.concatenate([np.asarray(C1), np.asarray(C2)], axis=0)
+        out = np.asarray(Q).T @ stacked
+        return {"C1": out[:mb_, :], "C2": out[mb_:, :]}
+    bodies(tb, _k("tsmqr", _mk_tsmqr), cpu_tsmqr)
+
+    return p.build()

@@ -1,0 +1,112 @@
+"""1D periodic stencil: iterative halo-exchange pipeline.
+
+Rebuild of the reference's stencil mini-app (reference:
+tests/apps/stencil/testing_stencil_1D.c + stencil_1D.jdf — a radius-R 1D
+stencil iterated T times, each tile exchanging halos with its neighbors
+every step; the wavefront pipeline is the canonical PTG pattern).  Here
+the exchange is whole-tile (periodic boundaries) and each S(t, i) task
+consumes its own tile plus both neighbors from step t-1 — the producer's
+copy fans out to one writer and two readers, exercising the engine's
+copy-on-write fan-out semantics.
+
+The same computation lowers to one shard_map program on a mesh
+(parallel/spmd.halo_stencil_fn) — the task graph is the irregular/
+multi-pool form, the SPMD schedule the regular one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from parsec_tpu.core.taskpool import ParameterizedTaskpool
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+
+_kernels = {}
+
+
+def _k_step():
+    fn = _kernels.get("step")
+    if fn is None:
+        def fn(L, C, R):
+            import jax.numpy as jnp
+            ext = jnp.concatenate([L[-1:], C, R[:1]])
+            return (ext[:-2] + ext[2:] + C) / 3.0
+        _kernels["step"] = fn
+    return fn
+
+
+def stencil_taskpool(V: TiledMatrix, steps: int,
+                     device: str = "tpu") -> ParameterizedTaskpool:
+    """Iterate the 3-point periodic mean stencil ``steps`` times over the
+    tile vector V (in place)."""
+    NT = V.mt
+    if NT < 2:
+        raise ValueError("stencil needs at least 2 tiles")
+
+    def cpu_step(L, C, R):
+        ext = np.concatenate([np.asarray(L)[-1:], np.asarray(C),
+                              np.asarray(R)[:1]])
+        return (ext[:-2] + ext[2:] + np.asarray(C)) / 3.0
+
+    p = PTG("stencil", NT=NT, T=steps)
+    # INIT(i) reads each tile once and broadcasts it to the three t=0
+    # consumers — reading AND writing a collection tile at the same
+    # wavefront without a dep edge would be a DAG race (and remote reads
+    # are not allowed anyway); the fan-out then rides the engine's
+    # copy-on-write semantics.
+    p.task("INIT", i=Range(0, NT - 1)) \
+        .affinity(lambda i, V=V: V(i)) \
+        .flow("X", "READ",
+              IN(DATA(lambda i, V=V: V(i))),
+              OUT(TASK("S", "C", lambda i: dict(t=0, i=i))),
+              OUT(TASK("S", "L", lambda i, NT=NT: dict(t=0,
+                                                       i=(i + 1) % NT))),
+              OUT(TASK("S", "R", lambda i, NT=NT: dict(t=0,
+                                                       i=(i - 1) % NT)))) \
+        .body(lambda: None)
+    tb = p.task("S", t=Range(0, steps - 1), i=Range(0, NT - 1)) \
+        .affinity(lambda i, V=V: V(i)) \
+        .priority(lambda t, T=steps: T - t) \
+        .flow("L", "READ",
+              IN(TASK("INIT", "X", lambda i, NT=NT: dict(i=(i - 1) % NT)),
+                 when=lambda t: t == 0),
+              IN(TASK("S", "C", lambda t, i, NT=NT: dict(t=t - 1,
+                                                         i=(i - 1) % NT)),
+                 when=lambda t: t > 0)) \
+        .flow("R", "READ",
+              IN(TASK("INIT", "X", lambda i, NT=NT: dict(i=(i + 1) % NT)),
+                 when=lambda t: t == 0),
+              IN(TASK("S", "C", lambda t, i, NT=NT: dict(t=t - 1,
+                                                         i=(i + 1) % NT)),
+                 when=lambda t: t > 0)) \
+        .flow("C", "RW",
+              IN(TASK("INIT", "X", lambda i: dict(i=i)),
+                 when=lambda t: t == 0),
+              IN(TASK("S", "C", lambda t, i: dict(t=t - 1, i=i)),
+                 when=lambda t: t > 0),
+              OUT(TASK("S", "C", lambda t, i: dict(t=t + 1, i=i)),
+                  when=lambda t, T=steps: t < T - 1),
+              OUT(TASK("S", "L", lambda t, i, NT=NT: dict(t=t + 1,
+                                                          i=(i + 1) % NT)),
+                  when=lambda t, T=steps: t < T - 1),
+              OUT(TASK("S", "R", lambda t, i, NT=NT: dict(t=t + 1,
+                                                          i=(i - 1) % NT)),
+                  when=lambda t, T=steps: t < T - 1),
+              OUT(DATA(lambda i, V=V: V(i)),
+                  when=lambda t, T=steps: t == T - 1))
+    if device in ("tpu", "xla", "gpu"):
+        tb.body(_k_step(), device=device)
+    tb.body(cpu_step)
+    return p.build()
+
+
+def stencil_reference(x: np.ndarray, steps: int) -> np.ndarray:
+    """Serial reference of the same periodic stencil."""
+    u = x.astype(np.float64)
+    for _ in range(steps):
+        ext = np.concatenate([u[-1:], u, u[:1]])
+        u = (ext[:-2] + ext[2:] + u) / 3.0
+    return u

@@ -18,8 +18,8 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Tuple
 
 from parsec_tpu.containers.hash_table import REMOVE
-from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency,
-                                  DataCopy)
+from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency, Data,
+                                  DataCopy, FLAG_COW)
 from parsec_tpu.core.task import (Dep, Flow, FromDesc, FromTask, New, Null,
                                   Task, TaskClass, ToDesc, ToTask)
 
@@ -132,6 +132,10 @@ def stage_in_host(task: Task) -> None:
             continue
         datum = copy.data
         with datum._lock:
+            if copy.flags & FLAG_COW:
+                # materialize the private buffer before the body writes
+                copy.payload = np.asarray(copy.payload).copy()
+                copy.flags &= ~FLAG_COW
             host = datum.copy_on(0)
             if host is None:
                 host = datum.create_copy(0)
@@ -187,6 +191,12 @@ def release_deps(es, task: Task) -> List[Task]:
 
     for flow in tc.flows:
         copy = task.data.get(flow.name)
+        # gather this flow's local deliveries first: a copy fanning out to
+        # several consumers must hand any WRITE-consumer a copy-on-write
+        # duplicate, or its in-place update races the other readers
+        # (reference: data-copy duplication for RW flows on shared copies)
+        local_deliveries: List[Tuple] = []
+        remote_count = 0
         for dep in flow.active_outputs(task.locals):
             end = dep.end
             if isinstance(end, ToDesc):
@@ -201,19 +211,26 @@ def release_deps(es, task: Task) -> List[Task]:
                     if succ_tc.rank_of(succ_locals) != myrank:
                         tp.context.remote_dep_activate(
                             es, task, flow, dep, succ_tc, succ_locals, copy)
+                        remote_count += 1
                         continue
-                    if entry is None and copy is not None:
-                        entry = tc.repo.lookup_entry_and_create(task.key)
-                    if copy is not None:
-                        entry.copies[flow.flow_index] = copy
-                        consumers += 1
-                    src = (tc, task.key) if copy is not None else None
-                    t = deliver_dep(tp, succ_tc, succ_locals,
-                                    end.flow, copy, src)
-                    if t is not None:
-                        ready.append(t)
+                    local_deliveries.append((succ_tc, succ_locals, end.flow))
             # Null outputs: data is discarded (arena copies will be
             # released by the repo retirement below, or were views)
+        total = len(local_deliveries) + remote_count
+        for succ_tc, succ_locals, dflow in local_deliveries:
+            dcopy = copy
+            if copy is not None and total > 1 and \
+                    succ_tc.flow(dflow).access & ACCESS_WRITE:
+                dcopy = _cow_copy(copy)
+            if entry is None and copy is not None:
+                entry = tc.repo.lookup_entry_and_create(task.key)
+            if copy is not None:
+                entry.copies[flow.flow_index] = copy
+                consumers += 1
+            src = (tc, task.key) if copy is not None else None
+            t = deliver_dep(tp, succ_tc, succ_locals, dflow, dcopy, src)
+            if t is not None:
+                ready.append(t)
 
     if entry is not None:
         entry.on_retire = _make_retire(task)
@@ -231,6 +248,21 @@ def release_deps(es, task: Task) -> List[Task]:
     if tp.context is not None and tp.context.comm is not None:
         tp.context.comm.flush_activations(es, task)
     return ready
+
+
+def _cow_copy(copy: DataCopy) -> DataCopy:
+    """A lazily-duplicating alias of ``copy``: shares the payload now, but
+    carries FLAG_COW so the execution site (stage_in_host, or the device
+    stage-in) materializes a private buffer before any write or donation."""
+    datum = Data(nb_elts=copy.data.nb_elts if copy.data is not None else 0)
+    # registered at host index regardless of where the shared payload
+    # lives: both stage_in_host and the device stage-in then see it as
+    # "the newest copy" and materialize a private buffer from it
+    c = DataCopy(datum, 0, payload=copy.payload,
+                 coherency=Coherency.EXCLUSIVE, version=1)
+    c.flags = FLAG_COW
+    datum.attach_copy(c)
+    return c
 
 
 def _make_retire(task: Task):

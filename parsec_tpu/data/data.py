@@ -29,6 +29,9 @@ ACCESS_READ = 0x1
 ACCESS_WRITE = 0x2
 ACCESS_RW = ACCESS_READ | ACCESS_WRITE
 
+# DataCopy.flags bits
+FLAG_COW = 0x1   # payload is shared with readers: duplicate before writing
+
 
 class Coherency(IntEnum):
     INVALID = 0
@@ -196,7 +199,13 @@ class Data:
                                         coherency=Coherency.SHARED,
                                         version=newest.version)
             else:
-                np.copyto(np.asarray(host.payload), arr)
+                dst = host.payload
+                if isinstance(dst, np.ndarray) and dst.flags.writeable:
+                    np.copyto(dst, arr)
+                else:
+                    # host slot holds a read-only/foreign payload (e.g. a
+                    # jax array bound by a functional body): replace it
+                    host.payload = arr.copy()
                 host.version = newest.version
                 host.coherency = Coherency.SHARED
             if newest.coherency == Coherency.EXCLUSIVE:

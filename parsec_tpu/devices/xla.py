@@ -258,13 +258,29 @@ class XlaDevice(Device):
             payload = src.payload if src is not None else copy.payload
             nbytes = getattr(payload, "nbytes", 0)
             self._reserve(nbytes)
-            dc.payload = jax.device_put(payload, self.jdev)
+            if self._on_this_device(payload):
+                # already resident (copy-on-write alias): device_put would
+                # be a no-op sharing the buffer, which donation/in-place
+                # update must not see — make a private HBM buffer
+                import jax.numpy as jnp
+                dc.payload = jnp.array(payload, copy=True)
+            else:
+                dc.payload = jax.device_put(payload, self.jdev)
             dc.version = src.version if src is not None else copy.version
             self.stats.bytes_in += nbytes
             if fresh:
                 self._account(datum, dc, nbytes)
         self._touch(datum)
         return dc
+
+    def _on_this_device(self, payload) -> bool:
+        devs = getattr(payload, "devices", None)
+        if devs is None:
+            return False
+        try:
+            return self.jdev in devs()
+        except TypeError:
+            return False
 
     # ------------------------------------------------------------------
     # completer: block on oldest in-flight outputs, rebind, complete

@@ -1,0 +1,90 @@
+"""Driver app tests: Cholesky, QR, stencil, pingpong, redistribute
+(reference: DPLASMA-style drivers named by BASELINE.json; tests/apps/)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import (TwoDimBlockCyclic, TwoDimTabular,
+                                    VectorTwoDimCyclic)
+
+
+def _spd(n, rng):
+    B = rng.standard_normal((n, n)).astype(np.float32)
+    return (B @ B.T + n * np.eye(n)).astype(np.float32)
+
+
+@pytest.mark.parametrize("device", ["tpu", "cpu"])
+@pytest.mark.parametrize("nt", [1, 2, 5])
+def test_potrf_matches_numpy(device, nt):
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    mb = 16
+    n = nt * mb
+    rng = np.random.default_rng(0)
+    spd = _spd(n, rng)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n).from_array(spd.copy())
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(potrf_taskpool(A, device=device))
+        ctx.wait()
+    L = np.tril(A.to_array())
+    err = np.abs(L @ L.T - spd).max() / np.abs(spd).max()
+    assert err < 1e-4
+
+
+@pytest.mark.parametrize("device", ["tpu", "cpu"])
+@pytest.mark.parametrize("nt", [1, 2, 4])
+def test_qr_matches_numpy(device, nt):
+    from parsec_tpu.apps.qr import qr_taskpool
+    mb = 8
+    n = nt * mb
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n).from_array(a.copy())
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(qr_taskpool(A, device=device))
+        ctx.wait()
+    out = A.to_array()
+    assert np.abs(np.tril(out, -1)).max() < 1e-4     # R is upper-triangular
+    R = np.triu(out)
+    ata = a.T @ a
+    assert np.abs(R.T @ R - ata).max() / np.abs(ata).max() < 1e-4
+
+
+@pytest.mark.parametrize("device", ["tpu", "cpu"])
+def test_stencil_matches_serial(device):
+    from parsec_tpu.apps.stencil import stencil_reference, stencil_taskpool
+    NT, mb, steps = 4, 8, 5
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal(NT * mb).astype(np.float32)
+    V = VectorTwoDimCyclic(mb=mb, lm=NT * mb).from_array(x.copy())
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(stencil_taskpool(V, steps, device=device))
+        ctx.wait()
+    want = stencil_reference(x, steps)
+    np.testing.assert_allclose(V.to_array(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_pingpong_single_process():
+    from parsec_tpu.apps.pingpong import run_pingpong
+    with Context(nb_cores=2) as ctx:
+        per_hop, mbps = run_pingpong(ctx, nbytes=1024, hops=50)
+    assert per_hop > 0 and mbps > 0
+
+
+def test_redistribute_between_distributions():
+    from parsec_tpu.apps.redistribute import redistribute_taskpool
+    mt = nt = 3
+    mb = 8
+    rng = np.random.default_rng(3)
+    S = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mt * mb, ln=nt * mb, name="S")
+    # target: tabular distribution with a scrambled (single-rank) table
+    table = [0] * (mt * nt)
+    T = TwoDimTabular(mb=mb, nb=mb, lm=mt * mb, ln=nt * mb, table=table,
+                      name="T")
+    for m, n in S.local_tiles():
+        S.data_of(m, n).copy_on(0).payload[:] = \
+            rng.standard_normal((mb, mb)).astype(np.float32)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(redistribute_taskpool(S, T))
+        ctx.wait()
+    np.testing.assert_allclose(T.to_array(), S.to_array(), rtol=1e-6)
