@@ -16,8 +16,31 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import random
+import socket
 import traceback
 from typing import Any, Callable, List, Optional
+
+
+def _probe_port_base(nranks: int, tries: int = 32) -> int:
+    """Pick a base port with every rank's port currently bindable: an
+    in-use port would make SocketCE.bind fail or cross-talk with an
+    unrelated listener (ADVICE r1 low)."""
+    for _ in range(tries):
+        base = random.randrange(20000, 60000 - nranks)
+        socks = []
+        try:
+            for r in range(nranks):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + r))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    return random.randrange(20000, 60000 - nranks)
 
 
 def _worker(rank: int, nranks: int, port_base: int, nb_cores: int,
@@ -56,7 +79,7 @@ def run_distributed(fn: Callable, nranks: int, args: tuple = (),
     """Run ``fn(ctx, rank, nranks, *args)`` on ``nranks`` processes;
     returns the per-rank results in rank order."""
     if port_base is None:
-        port_base = random.randrange(20000, 60000 - nranks)
+        port_base = _probe_port_base(nranks)
     mpctx = mp.get_context("spawn")
     outq = mpctx.Queue()
     procs = [mpctx.Process(target=_worker,

@@ -69,6 +69,8 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
         return None
     task = Task(succ_tc, taskpool, rec.locals)
     task.data.update(rec.inputs)
+    task.pinned_flows.update(k for k, v in rec.inputs.items()
+                             if v is not None)
     task.input_sources.update(rec.sources)
     return task
 
@@ -125,7 +127,13 @@ def stage_in_host(task: Task) -> None:
     (the host-side analog of the device module's stage-in; reference:
     generated data_lookup resolving CPU-side copies).  Pulls from a
     newer device-resident copy when one exists and rebinds the flow to
-    the host copy so in-place numpy mutation works."""
+    the host copy so in-place numpy mutation works.
+
+    A bound copy that is no longer attached to its datum is a
+    version-pinned snapshot: a same-wavefront ``-> DATA`` writeback
+    superseded it (see _writeback), and the consumer must read the
+    snapshot — not the datum's newer copy (reference: repo-pinned
+    versioned copies, datarepo.h:50-58)."""
     for flow in task.task_class.flows:
         copy = task.data.get(flow.name)
         if copy is None or copy.data is None:
@@ -136,16 +144,28 @@ def stage_in_host(task: Task) -> None:
                 # materialize the private buffer before the body writes
                 copy.payload = np.asarray(copy.payload).copy()
                 copy.flags &= ~FLAG_COW
+            if copy.is_pinned_snapshot(flow.name in task.pinned_flows):
+                # read the bound payload, never the datum's newer copy
+                if not isinstance(copy.payload, np.ndarray):
+                    copy.payload = np.asarray(copy.payload)
+                if flow.access & ACCESS_WRITE:
+                    # the snapshot payload may alias storage other pinned
+                    # readers hold (e.g. the old backing view): a writing
+                    # body must get a private buffer
+                    copy.payload = copy.payload.copy()
+                continue
             host = datum.copy_on(0)
             if host is None:
                 host = datum.create_copy(0)
             src = datum.transfer_ownership(0, flow.access)
             if src is not None:
                 arr = np.asarray(src.payload)
-                if host.payload is None:
+                if host.payload is None or \
+                        not isinstance(host.payload, np.ndarray) or \
+                        not host.payload.flags.writeable:
                     host.payload = arr.copy()
                 else:
-                    np.copyto(np.asarray(host.payload), arr)
+                    np.copyto(host.payload, arr)
                 host.version = src.version
             elif host.payload is None and copy.payload is not None \
                     and copy is not host:
@@ -162,18 +182,37 @@ def _writeback(task: Task, flow: Flow, copy: DataCopy, ref) -> None:
     version (the reference keeps GPU copies resident until eviction or
     flush, not eagerly D2H on every output dep); host readers pull it
     lazily via Data.pull_to_host.  Only a copy of a *different* datum
-    (arena temporaries routed to the collection) is physically copied.
+    (arena temporaries, COW duplicates) is physically written back.
+
+    The write-back NEVER mutates the existing host copy's storage: a
+    same-wavefront reader bound to that copy would observe the new value
+    mid-read (the stencil Gauss–Seidel contamination).  Instead the old
+    host copy is detached — surviving, version-pinned, for any consumer
+    already holding it — and a fresh copy with a private payload becomes
+    the datum's new authoritative version (reference: versioned
+    data-copies + repo refcount protocol, datarepo.h:50-58).
     """
     datum = ref.resolve()
-    if copy.data is datum:
-        return  # in place (host) or device-resident (lazy pull-home)
-    host = datum.copy_on(0)
-    if host is None:
-        host = datum.create_copy(0, payload=np.asarray(copy.payload).copy())
-    else:
-        np.copyto(np.asarray(host.payload), np.asarray(copy.payload))
-    datum.transfer_ownership(0, ACCESS_WRITE)
-    datum.complete_write(0)
+    if copy.data is datum and datum.copy_on(copy.device) is copy:
+        # attached: in place (host) or device-resident (lazy pull-home).
+        # A DETACHED copy of the same datum is a superseded snapshot a
+        # WRITE body mutated privately — its value must still land below
+        # or the update is silently lost.
+        return
+    arr = np.asarray(copy.payload).copy()
+    with datum._lock:
+        datum.detach_copy(0)   # readers keep their pinned snapshot
+        for c in datum.copies().values():
+            c.coherency = Coherency.INVALID
+        host = DataCopy(datum, 0, payload=arr,
+                        coherency=Coherency.EXCLUSIVE)
+        datum.attach_copy(host)
+        datum._version_clock += 1
+        host.version = datum._version_clock
+    # the user-visible backing array re-links at quiescence, when no
+    # pinned reader of the old view can still be in flight
+    if datum.collection is not None:
+        task.taskpool.dirty_data.add(datum)
 
 
 def release_deps(es, task: Task) -> List[Task]:
@@ -188,6 +227,11 @@ def release_deps(es, task: Task) -> List[Task]:
     ready: List[Task] = []
     consumers = 0
     entry = None
+    #: arena-backed copies whose only consumers are remote: nothing local
+    #: creates a repo entry for them, so they are returned to the freelist
+    #: once flush_activations has serialized the payload (ADVICE r1: the
+    #: QR NEW-temporary leak on distributed runs)
+    remote_only_arena: List[DataCopy] = []
 
     for flow in tc.flows:
         copy = task.data.get(flow.name)
@@ -217,6 +261,9 @@ def release_deps(es, task: Task) -> List[Task]:
             # Null outputs: data is discarded (arena copies will be
             # released by the repo retirement below, or were views)
         total = len(local_deliveries) + remote_count
+        if remote_count and not local_deliveries and copy is not None \
+                and copy.arena is not None:
+            remote_only_arena.append(copy)
         for succ_tc, succ_locals, dflow in local_deliveries:
             dcopy = copy
             if copy is not None and total > 1 and \
@@ -247,6 +294,12 @@ def release_deps(es, task: Task) -> List[Task]:
     # iterate_successors filled the rank bitmask)
     if tp.context is not None and tp.context.comm is not None:
         tp.context.comm.flush_activations(es, task)
+        # flush serialized every outgoing payload synchronously: arena
+        # temporaries with no local consumer can go home now
+        for copy in remote_only_arena:
+            if copy.data is not None:
+                copy.data.detach_copy(copy.device)
+            copy.arena.release_copy(copy)
     return ready
 
 

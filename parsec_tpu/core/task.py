@@ -314,8 +314,8 @@ class Task:
     """One task instance (reference: parsec_task_t)."""
 
     __slots__ = ("task_class", "taskpool", "locals", "key", "priority",
-                 "status", "data", "input_sources", "chore_mask", "seq",
-                 "device", "prof", "dtd")
+                 "status", "data", "input_sources", "pinned_flows",
+                 "chore_mask", "seq", "device", "prof", "dtd")
 
     def __init__(self, task_class: TaskClass, taskpool, locals_: Dict[str, int]):
         self.task_class = task_class
@@ -329,6 +329,10 @@ class Task:
         self.data: Dict[str, Optional[DataCopy]] = {}
         #: flow name -> (producer TaskClass, producer key) for repo release
         self.input_sources: Dict[str, Tuple[TaskClass, Tuple]] = {}
+        #: task-fed flows: their bound copy is a version-pinned input that
+        #: must never be superseded by a newer datum version at stage-in
+        #: (reference: repo-pinned copies, datarepo.h:50-58)
+        self.pinned_flows: set = set()
         self.chore_mask = 0xFFFF
         self.seq = next(_task_seq)
         self.device = None

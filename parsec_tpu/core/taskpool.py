@@ -53,6 +53,9 @@ class Taskpool:
         self.arenas: Dict[str, Arena] = {}
         #: dep-countdown records for not-yet-ready tasks
         self.deps_table = ConcurrentHashTable()
+        #: collection datums whose host copy a writeback replaced; their
+        #: user-visible backing re-links at termination (engine._writeback)
+        self.dirty_data: set = set()
         self._complete_cbs: List[Callable[["Taskpool"], None]] = []
         self._done_event = threading.Event()
         self.priority = 0
@@ -97,6 +100,10 @@ class Taskpool:
 
     def _terminated(self) -> None:
         self.state = TaskpoolState.DONE
+        for datum in self.dirty_data:
+            if datum.collection is not None:
+                datum.collection.refresh_backing(datum)
+        self.dirty_data.clear()
         cbs = list(self._complete_cbs)
         for cb in cbs:
             cb(self)

@@ -51,6 +51,10 @@ class DataCollection:
     def key_to_indices(self, key: Any) -> Tuple:
         raise NotImplementedError
 
+    def refresh_backing(self, datum: Data) -> None:
+        """Re-link a datum whose host copy was detached from user-visible
+        backing storage (no-op for collections without a backing array)."""
+
     # -- convenience ------------------------------------------------------
     def is_local(self, *indices) -> bool:
         return self.rank_of(*indices) == self.myrank

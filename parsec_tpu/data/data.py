@@ -62,6 +62,19 @@ class DataCopy:
         self.arena = None         # owning arena, if arena-allocated
         self.dtt = None           # datatype/layout tag (reshape engine)
 
+    def is_pinned_snapshot(self, pinned: bool) -> bool:
+        """True when this bound copy must be read as a version-pinned
+        snapshot rather than through the datum's coherency protocol:
+        either a writeback replacement detached it, or — for a task-fed
+        (pinned) input — a concurrent writeback invalidated it in place.
+        (A detached copy with payload None was merely evicted and should
+        re-stage from the datum's newest valid copy instead.)"""
+        if self.payload is None or self.data is None:
+            return False
+        attached = self.data.copy_on(self.device) is self
+        return (not attached) or \
+            (pinned and self.coherency == Coherency.INVALID)
+
     def __repr__(self):
         return (f"<DataCopy dev={self.device} v={self.version} "
                 f"{self.coherency.name} of {self.data}>")
@@ -188,28 +201,34 @@ class Data:
         with self._lock:
             host = self._copies.get(0)
             newest = self.newest_copy(prefer_device=0)
-            if newest is None or newest is host:
-                return host
-            if host is not None and host.coherency != Coherency.INVALID \
-                    and host.version >= newest.version:
-                return host   # already current: no D2H transfer
-            arr = np.asarray(newest.payload)
-            if host is None:
-                host = self.create_copy(0, payload=arr.copy(),
-                                        coherency=Coherency.SHARED,
-                                        version=newest.version)
+            if newest is None or newest is host or (
+                    host is not None and
+                    host.coherency != Coherency.INVALID and
+                    host.version >= newest.version):
+                pass   # already current: no D2H transfer
             else:
-                dst = host.payload
-                if isinstance(dst, np.ndarray) and dst.flags.writeable:
-                    np.copyto(dst, arr)
+                arr = np.asarray(newest.payload)
+                if host is None:
+                    host = self.create_copy(0, payload=arr.copy(),
+                                            coherency=Coherency.SHARED,
+                                            version=newest.version)
                 else:
-                    # host slot holds a read-only/foreign payload (e.g. a
-                    # jax array bound by a functional body): replace it
-                    host.payload = arr.copy()
-                host.version = newest.version
-                host.coherency = Coherency.SHARED
-            if newest.coherency == Coherency.EXCLUSIVE:
-                newest.coherency = Coherency.OWNED
+                    dst = host.payload
+                    if isinstance(dst, np.ndarray) and dst.flags.writeable:
+                        np.copyto(dst, arr)
+                    else:
+                        # host slot holds a read-only/foreign payload (e.g.
+                        # a jax array bound by a functional body): replace
+                        host.payload = arr.copy()
+                    host.version = newest.version
+                    host.coherency = Coherency.SHARED
+                if newest.coherency == Coherency.EXCLUSIVE:
+                    newest.coherency = Coherency.OWNED
+            # NOTE: no backing re-link here — pull_to_host runs mid-run
+            # (eviction write-back) while pinned snapshot readers may
+            # still hold the old backing view; re-linking happens only at
+            # quiescent points (taskpool termination, to_array, device
+            # flush at fini) via collection.refresh_backing.
             return host
 
     def start_read(self, device: int) -> None:

@@ -23,7 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from parsec_tpu.data.collection import DataCollection
-from parsec_tpu.data.data import Data, new_data
+from parsec_tpu.data.data import Coherency, Data, new_data
 
 
 class TiledMatrix(DataCollection):
@@ -93,19 +93,45 @@ class TiledMatrix(DataCollection):
             out[m * self.mb:m * self.mb + tm, n * self.nb:n * self.nb + tn] = payload
         return out
 
+    def _tile_view(self, m: int, n: int) -> np.ndarray:
+        tm, tn = self.tile_shape(m, n)
+        return self._backing[m * self.mb:m * self.mb + tm,
+                             n * self.nb:n * self.nb + tn]
+
     def _sync_backing(self) -> None:
-        """Pull tiles whose newest copy lives off-host; host payloads are
-        views into the backing array, so pull_to_host refreshes it."""
+        """Pull tiles whose newest copy lives off-host, then re-link
+        replaced host payloads into the backing array (to_array is a
+        quiescent point by contract)."""
         for (m, n), d in list(self._tiles.items()):
             d.pull_to_host()
+            self.refresh_backing(d)
+
+    def refresh_backing(self, datum: Data) -> None:
+        """Copy a replaced host payload back into its backing slice and
+        re-link the view (a ``-> DATA`` writeback replaces host copies
+        with private payloads — see engine._writeback — so same-wavefront
+        readers keep a pinned snapshot; once the pool quiesces the
+        backing array must reflect the final value again)."""
+        if self._backing is None:
+            return
+        _name, m, n = datum.key
+        with datum._lock:
+            host = datum.copy_on(0)
+            if host is None or host.payload is None or \
+                    host.coherency == Coherency.INVALID or \
+                    host.version < datum.newest_version():
+                return   # stale host: a later D2H pull refreshes instead
+            view = self._tile_view(m, n)
+            pay = np.asarray(host.payload)
+            if not np.shares_memory(view, pay):
+                np.copyto(view, pay.reshape(view.shape))
+                host.payload = view
 
     def _make_tile(self, m: int, n: int) -> Data:
-        tm, tn = self.tile_shape(m, n)
         if self._backing is not None:
-            payload = self._backing[m * self.mb:m * self.mb + tm,
-                                    n * self.nb:n * self.nb + tn]
+            payload = self._tile_view(m, n)
         else:
-            payload = np.zeros((tm, tn), self.dtype)
+            payload = np.zeros(self.tile_shape(m, n), self.dtype)
         return new_data(payload, key=(self.name, m, n), collection=self)
 
     def data_of(self, m: int, n: int = 0) -> Data:
@@ -249,9 +275,13 @@ class VectorTwoDimCyclic(TiledMatrix):
         return out
 
     def _make_tile(self, m: int, n: int) -> Data:
-        tm = min(self.mb, self.lm - m * self.mb)
         if self._backing is not None:
-            payload = self._backing[m * self.mb:m * self.mb + tm]
+            payload = self._tile_view(m, n)
         else:
+            tm = min(self.mb, self.lm - m * self.mb)
             payload = np.zeros(tm, self.dtype)
         return new_data(payload, key=(self.name, m, n), collection=self)
+
+    def _tile_view(self, m: int, n: int) -> np.ndarray:
+        tm = min(self.mb, self.lm - m * self.mb)
+        return self._backing[m * self.mb:m * self.mb + tm]

@@ -35,7 +35,7 @@ import numpy as np
 
 from parsec_tpu.core.task import HookReturn, Task
 from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency,
-                                  DataCopy)
+                                  DataCopy, FLAG_COW)
 from parsec_tpu.devices.device import Device
 from parsec_tpu.core.task import ToDesc
 from parsec_tpu.utils.mca import params
@@ -212,7 +212,8 @@ class XlaDevice(Device):
                 copy = task.data.get(flow.name)
                 if copy is None:
                     continue
-                dc = self._stage_in(copy, flow.access)
+                dc = self._stage_in(copy, flow.access,
+                                    pinned=flow.name in task.pinned_flows)
                 if dc is not copy and copy.device == 0 \
                         and copy.arena is not None:
                     # host arena temp fully superseded by the device copy:
@@ -234,6 +235,10 @@ class XlaDevice(Device):
         except Exception:
             for d in pinned:
                 self._unpin(d)
+            # arena copies already detached for deferred release would
+            # otherwise leak on the failure path (ADVICE r1 low)
+            for copy in release_after:
+                copy.arena.release_copy(copy)
             raise
         self.stats.executed_tasks += 1
         with self._cond:
@@ -244,11 +249,36 @@ class XlaDevice(Device):
                           release_after))
             self._cond.notify_all()
 
-    def _stage_in(self, copy: DataCopy, access: int) -> DataCopy:
+    def _stage_in(self, copy: DataCopy, access: int,
+                  pinned: bool = False) -> DataCopy:
         """Ensure a valid copy of ``copy``'s datum on this device
-        (reference: parsec_gpu_data_stage_in, device_cuda_module.c:1261)."""
+        (reference: parsec_gpu_data_stage_in, device_cuda_module.c:1261).
+
+        A bound copy that a writeback replacement detached — or, for a
+        task-fed (pinned) input, invalidated in place — is a
+        version-pinned snapshot; it stages into a private standalone
+        device copy without consulting the datum's coherency, which has
+        moved on.  (A detached copy with payload None was merely evicted
+        and re-stages from the datum's newest valid copy below.)"""
         import jax
         datum = copy.data
+        if (copy.flags & FLAG_COW) == 0 and copy.is_pinned_snapshot(pinned):
+            from parsec_tpu.data.data import Data
+            payload = copy.payload
+            nbytes = getattr(payload, "nbytes", 0)
+            self._reserve(nbytes)
+            if self._on_this_device(payload):
+                import jax.numpy as jnp
+                staged = jnp.array(payload, copy=True)
+            else:
+                staged = jax.device_put(np.asarray(payload), self.jdev)
+            snap = Data(nb_elts=datum.nb_elts)
+            dc = snap.create_copy(self.space, payload=staged,
+                                  coherency=Coherency.SHARED,
+                                  version=copy.version)
+            self.stats.bytes_in += nbytes
+            self._account(snap, dc, nbytes)
+            return dc
         dc = datum.copy_on(self.space)
         fresh = dc is None
         if fresh:
@@ -270,6 +300,18 @@ class XlaDevice(Device):
             self.stats.bytes_in += nbytes
             if fresh:
                 self._account(datum, dc, nbytes)
+        if copy.flags & FLAG_COW and copy is not dc:
+            # The COW alias's payload aliases the producer's buffer (for
+            # DATA-fed fan-outs: the collection's backing array).  The
+            # device copy above is private, so drop the alias from the
+            # datum NOW — otherwise flush()/_evict() later treats this
+            # datum's device copy as authoritative and pull_to_host
+            # np.copyto's an intermediate result through the alias into
+            # the shared storage (ADVICE r1 high: the stencil corruption).
+            datum.detach_copy(copy.device)
+            copy.payload = None
+            copy.coherency = Coherency.INVALID
+            copy.flags &= ~FLAG_COW
         self._touch(datum)
         return dc
 
@@ -398,7 +440,9 @@ class XlaDevice(Device):
 
     def flush(self) -> None:
         """Push every authoritative device copy home (reference:
-        parsec_dtd_data_flush_all / GPU w2r writeback tasks)."""
+        parsec_dtd_data_flush_all / GPU w2r writeback tasks).  Flush is a
+        quiescent point, so replaced host payloads re-link into their
+        collection's user-visible backing storage."""
         with self._mem_lock:
             entries = [ref() for ref, _ in self._lru.values()]
         for dc in entries:
@@ -410,6 +454,8 @@ class XlaDevice(Device):
                         dc.coherency in (Coherency.OWNED, Coherency.EXCLUSIVE) \
                         and dc.version >= datum.newest_version():
                     self._writeback_host(datum, dc)
+            if datum.collection is not None:
+                datum.collection.refresh_backing(datum)
 
     def fini(self) -> None:
         with self._cond:

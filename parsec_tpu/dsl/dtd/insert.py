@@ -297,7 +297,8 @@ class DTDTaskpool(Taskpool):
                     self._raise_context_error()
                     self._window.wait(0.1)
 
-        self.termdet.taskpool_addto_nb_tasks(self, 1)
+        # parse/validate args FIRST: raising after the nb_tasks increment
+        # would leave the count high forever and hang wait() (ADVICE r1)
         tracked: List[Tuple[DTDTile, _Mode]] = []
         for i, (value, mode) in enumerate(args):
             name = names[i]
@@ -317,6 +318,7 @@ class DTDTaskpool(Taskpool):
             else:
                 raise TypeError(f"unsupported arg mode {mode!r}")
 
+        self.termdet.taskpool_addto_nb_tasks(self, 1)
         with self._dep_lock:
             self._inflight += 1
             for tile, mode in tracked:
