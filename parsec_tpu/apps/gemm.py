@@ -37,7 +37,11 @@ def _tile_kernel(alpha: float, precision=None):
     if fn is None:
         def fn(Ai, Bi, Ci):
             import jax.numpy as jnp
-            acc = jnp.matmul(Ai, Bi, precision=precision)
+            # accumulate in C's dtype: bf16 A/B panels with an f32 C give
+            # MXU-native multiplies with f32 accumulation (the TPU-idiomatic
+            # mixed-precision GEMM)
+            acc = jnp.matmul(Ai, Bi, precision=precision,
+                             preferred_element_type=Ci.dtype)
             return Ci + (acc if alpha == 1.0 else alpha * acc)
         _kernels[key] = fn
     return fn
@@ -46,11 +50,18 @@ def _tile_kernel(alpha: float, precision=None):
 def gemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
                   alpha: float = 1.0, beta: float = 1.0,
                   device: str = "tpu",
-                  precision: Optional[str] = None) -> ParameterizedTaskpool:
+                  precision: Optional[str] = None,
+                  panel_bcast: Optional[bool] = None
+                  ) -> ParameterizedTaskpool:
     """Build the C = alpha*A@B + beta*C taskpool over tiled collections.
 
     ``precision``: jax matmul precision ("highest" forces fp32 accumulate
     on TPU; None keeps the backend default, bf16 on TPU).
+    ``panel_bcast``: route each A-row/B-column panel through a reader task
+    whose output fans out to every consumer — the dataflow broadcast form
+    that multi-rank runs need (remote bcast trees) and that multi-DEVICE
+    runs lower to one ICI collective per panel (comm/ici.prebroadcast).
+    Default: on when the collections are distributed.
     """
     if A.nt != B.mt or A.mt != C.mt or B.nt != C.nt:
         raise ValueError(
@@ -68,8 +79,10 @@ def gemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
                                                   np.asarray(Bi))
 
     distributed = C.nodes > 1
+    if panel_bcast is None:
+        panel_bcast = distributed
     p = PTG("gemm", MT=mt, NT=nt, KT=kt)
-    if distributed:
+    if panel_bcast:
         # Owner-computes reader tasks broadcast each A-row / B-column
         # panel to the GEMM tasks that consume it — the dataflow bcast
         # tree of the reference (remote_dep.c star/chain/binomial) and
@@ -109,11 +122,11 @@ def gemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
         .priority(lambda k, KT=kt: KT - k) \
         .flow("Ai", "READ",
               IN(TASK("RA", "T", lambda m, k: dict(m=m, k=k)))
-              if distributed else
+              if panel_bcast else
               IN(DATA(lambda m, k, A=A: A(m, k)))) \
         .flow("Bi", "READ",
               IN(TASK("RB", "T", lambda k, n: dict(k=k, n=n)))
-              if distributed else
+              if panel_bcast else
               IN(DATA(lambda k, n, B=B: B(k, n)))) \
         .flow("Ci", "RW",
               IN(TASK("SCALE", "Ci", lambda m, n: dict(m=m, n=n)),

@@ -1,0 +1,304 @@
+"""ICI transport: payload movement as XLA device collectives.
+
+The second comm-engine module (reference seam: the transport-neutral
+``parsec_comm_engine_t`` vtable, parsec/parsec_comm_engine.h:161-183, whose
+only in-tree implementation is funnelled MPI, parsec_mpi_funnelled.c).  On
+TPU the equivalent of registered-memory put/get between ranks is
+device-to-device movement over the ICI mesh — so this module lowers
+dataflow payload edges between the runtime's XLA devices to XLA
+collective programs, keeping control (activation bookkeeping) on the
+host:
+
+- ``put``      — one point-to-point tile edge (DMA d2h-free device copy;
+                 on a real slice this is an ICI transfer).
+- ``bcast``    — one producer tile replicated to many devices in a single
+                 XLA replication (the dataflow-broadcast primitive of
+                 remote_dep.c:334-357, ridden on the interconnect instead
+                 of N host round-trips).  The first customer is the GEMM
+                 panel broadcast (apps/gemm.py RA/RB): release_deps calls
+                 ``prebroadcast`` when one copy fans out to consumers on
+                 several devices.
+- ``permute``  — a batch of same-shaped tile edges executed as ONE
+                 ``lax.ppermute`` (CollectivePermute) program over the
+                 mesh — the per-wavefront batched schedule of SURVEY §5.8.
+                 Non-permutation batches are split into permutation
+                 rounds (each device sends/receives at most once per
+                 round, matching CollectivePermute semantics).
+
+Programs are shard_map computations over a 1D mesh of every attached XLA
+device, cached per (shape, dtype, permutation).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from parsec_tpu.data.data import Coherency, DataCopy
+from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import debug_verbose
+
+params.register("comm_ici_enabled", 1,
+                "lower multi-device payload edges to XLA collectives")
+params.register("comm_ici_bcast_min", 2,
+                "minimum distinct consumer devices to trigger a collective "
+                "panel broadcast")
+
+
+class IciStats:
+    __slots__ = ("puts", "put_bytes", "bcasts", "bcast_bytes",
+                 "permutes", "permute_edges", "permute_bytes")
+
+    def __init__(self):
+        self.puts = 0
+        self.put_bytes = 0
+        self.bcasts = 0
+        self.bcast_bytes = 0
+        self.permutes = 0
+        self.permute_edges = 0
+        self.permute_bytes = 0
+
+    def as_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class IciEngine:
+    """Collective payload transport over the local device mesh."""
+
+    #: comm-engine capability flags (reference: parsec_comm_engine.h
+    #: capabilities) — one-sided puts and collective broadcast, no
+    #: two-sided AM (control rides the host/TCP engine)
+    CAP_ONESIDED = True
+    CAP_COLLECTIVE = True
+
+    def __init__(self, registry):
+        from parsec_tpu.devices.xla import XlaDevice
+        self.registry = registry
+        self.xla_devices = [d for d in registry.devices
+                            if isinstance(d, XlaDevice) and d.enabled]
+        self._space_to_pos: Dict[int, int] = {
+            d.space: i for i, d in enumerate(self.xla_devices)}
+        self._jdev = {d.space: d.jdev for d in self.xla_devices}
+        self.stats = IciStats()
+        self._mesh = None
+        self._prog_cache: Dict[Tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    @property
+    def ndev(self) -> int:
+        return len(self.xla_devices)
+
+    def mesh(self):
+        """Lazy 1D mesh over every attached XLA device."""
+        if self._mesh is None:
+            from jax.sharding import Mesh
+            self._mesh = Mesh(
+                np.array([d.jdev for d in self.xla_devices]), ("d",))
+        return self._mesh
+
+    # ------------------------------------------------------------------
+    # point-to-point: the put of the CE vtable
+    # ------------------------------------------------------------------
+    def put(self, payload, dst_space: int):
+        """Move one tile to ``dst_space``'s device, device-to-device
+        (reference: CE put with registered memory,
+        parsec_mpi_funnelled.c:793)."""
+        import jax
+        out = jax.device_put(payload, self._jdev[dst_space])
+        self.stats.puts += 1
+        self.stats.put_bytes += getattr(payload, "nbytes", 0)
+        return out
+
+    # ------------------------------------------------------------------
+    # broadcast: one producer tile -> many devices, one XLA replication
+    # ------------------------------------------------------------------
+    def bcast(self, payload, dst_spaces: Sequence[int]) -> Dict[int, Any]:
+        """Replicate ``payload`` onto every device of the mesh in one XLA
+        data movement; return {space: on-device array} for the requested
+        targets (reference: the dataflow bcast trees, remote_dep.c:334-357
+        — here the tree is the interconnect's native replication)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        want = set(dst_spaces)
+        sharding = NamedSharding(self.mesh(), P())   # fully replicated
+        rep = jax.device_put(payload, sharding)
+        out: Dict[int, Any] = {}
+        by_jdev = {jd: sp for sp, jd in self._jdev.items()}
+        for shard in rep.addressable_shards:
+            sp = by_jdev.get(shard.device)
+            if sp in want:
+                out[sp] = shard.data
+        self.stats.bcasts += 1
+        self.stats.bcast_bytes += getattr(payload, "nbytes", 0) * len(out)
+        return out
+
+    # ------------------------------------------------------------------
+    # batched permute: one CollectivePermute program per wavefront round
+    # ------------------------------------------------------------------
+    def permute(self, edges: Iterable[Tuple[int, int, Any]]
+                ) -> Dict[Tuple[int, int], Any]:
+        """Execute a batch of (src_space, dst_space, payload) tile edges.
+        Same-shaped edges forming a partial permutation ride ONE
+        ``lax.ppermute`` launch; the batch is split into permutation
+        rounds and (shape, dtype) groups as needed.  Returns
+        {(src_space, dst_space): array-on-dst}."""
+        groups: Dict[Tuple, List[Tuple[int, int, Any]]] = {}
+        results: Dict[Tuple[int, int], Any] = {}
+        for s, d, payload in edges:
+            if s == d:
+                results[(s, d)] = payload
+                continue
+            arr_shape = tuple(getattr(payload, "shape", ()))
+            dt = str(getattr(payload, "dtype", "f4"))
+            groups.setdefault((arr_shape, dt), []).append((s, d, payload))
+        for (shape, dt), group in groups.items():
+            for round_edges in self._rounds(group):
+                results.update(self._permute_round(shape, round_edges))
+        return results
+
+    @staticmethod
+    def _rounds(group: List[Tuple[int, int, Any]]
+                ) -> List[List[Tuple[int, int, Any]]]:
+        """Split edges into rounds where each device sends at most once
+        and receives at most once (CollectivePermute is a partial
+        permutation)."""
+        rounds: List[List[Tuple[int, int, Any]]] = []
+        for edge in group:
+            for r in rounds:
+                if all(edge[0] != e[0] and edge[1] != e[1] for e in r):
+                    r.append(edge)
+                    break
+            else:
+                rounds.append([edge])
+        return rounds
+
+    def _permute_round(self, shape, round_edges):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh()
+        n = self.ndev
+        srcs: Dict[int, Any] = {}
+        perm: List[Tuple[int, int]] = []
+        for s, d, payload in round_edges:
+            perm.append((self._space_to_pos[s], self._space_to_pos[d]))
+            srcs[self._space_to_pos[s]] = payload
+        perm.sort()
+        dtype = None
+        for a in srcs.values():
+            dtype = a.dtype
+            break
+        shards = []
+        for i, dev in enumerate(self.xla_devices):
+            a = srcs.get(i)
+            if a is None:
+                a = jnp.zeros(shape, dtype)
+            a = jax.device_put(a, dev.jdev)
+            shards.append(jnp.reshape(a, (1,) + shape))
+        sharding = NamedSharding(mesh, P("d"))
+        x = jax.make_array_from_single_device_arrays(
+            (n,) + shape, sharding, shards)
+
+        key = ("perm", shape, str(dtype), tuple(perm))
+        with self._lock:
+            prog = self._prog_cache.get(key)
+            if prog is None:
+                from jax import shard_map
+
+                def body(t):
+                    return lax.ppermute(t, "d", perm)
+                prog = jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=P("d"), out_specs=P("d")))
+                self._prog_cache[key] = prog
+        y = prog(x)
+        pos_to_space = {v: k for k, v in self._space_to_pos.items()}
+        recv = {d_pos: s_pos for s_pos, d_pos in perm}
+        by_jdev = {jd: sp for sp, jd in self._jdev.items()}
+        out: Dict[Tuple[int, int], Any] = {}
+        for shard in y.addressable_shards:
+            sp = by_jdev.get(shard.device)
+            if sp is None:
+                continue
+            pos = self._space_to_pos[sp]
+            if pos not in recv:
+                continue
+            out[(pos_to_space[recv[pos]], sp)] = shard.data[0]
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize \
+            if shape else 0
+        self.stats.permutes += 1
+        self.stats.permute_edges += len(perm)
+        self.stats.permute_bytes += nbytes * len(perm)
+        return out
+
+    # ------------------------------------------------------------------
+    # runtime hook: collective panel broadcast on dataflow fan-out
+    # ------------------------------------------------------------------
+    def prebroadcast(self, copy: DataCopy, target_spaces: Sequence[int]
+                     ) -> int:
+        """Replicate a produced copy onto the consumer devices in one
+        collective, attaching SHARED device copies to its datum so each
+        consumer's stage-in finds the tile resident (zero further
+        movement).  Returns the number of devices the tile landed on."""
+        datum = copy.data
+        if datum is None or copy.payload is None:
+            return 0
+        spaces = sorted({s for s in target_spaces
+                         if s in self._jdev})
+        with datum._lock:
+            missing = [s for s in spaces
+                       if (c := datum.copy_on(s)) is None or
+                       c.coherency == Coherency.INVALID or
+                       c.version < copy.version]
+        if len(missing) < int(params.get("comm_ici_bcast_min", 2)):
+            return 0
+        replicas = self.bcast(copy.payload, missing)
+        attached = 0
+        with datum._lock:
+            for sp, arr in replicas.items():
+                existing = datum.copy_on(sp)
+                if existing is None:
+                    dc = DataCopy(datum, sp, payload=arr,
+                                  coherency=Coherency.SHARED,
+                                  version=copy.version)
+                    datum.attach_copy(dc)
+                    attached += 1
+                elif existing.coherency == Coherency.INVALID or \
+                        existing.version < copy.version:
+                    existing.payload = arr
+                    existing.coherency = Coherency.SHARED
+                    existing.version = copy.version
+                    attached += 1
+        debug_verbose(7, "ici prebroadcast: %d replicas of %s", attached,
+                      datum)
+        return attached
+
+    def consumer_spaces(self, taskpool, deliveries) -> List[int]:
+        """Best-effort device targets for a list of local deliveries:
+        each successor's affinity datum names its preferred/resident
+        accelerator (reference: parsec_get_best_device's data-affinity
+        rule, device.c:79-140)."""
+        spaces: List[int] = []
+        for succ_tc, succ_locals, _dflow in deliveries:
+            if succ_tc.affinity is None:
+                continue
+            try:
+                ref = succ_tc.affinity(succ_locals)
+                datum = ref.resolve()
+            except Exception:
+                continue
+            pref = datum.preferred_device
+            if pref is not None and pref in self._jdev:
+                spaces.append(pref)
+                continue
+            v = datum.newest_version()
+            for sp, c in datum.copies().items():
+                if sp in self._jdev and c.version == v \
+                        and c.coherency != Coherency.INVALID:
+                    spaces.append(sp)
+                    break
+        return spaces

@@ -111,7 +111,13 @@ class RemoteDepEngine:
                             copy) -> None:
         """Buffer one remote successor edge; flushed per task
         (reference: parsec_remote_dep_activate aggregating rank bits)."""
+        from parsec_tpu.data.reshape import as_dtt, needs_reshape
         dst = succ_tc.rank_of(succ_locals)
+        dtt = as_dtt(dep.dtt)
+        if dtt is not None and needs_reshape(copy, dtt):
+            # pre-send reshape: the converted payload is what travels
+            # (reference: parsec_reshape.c remote pre-send path)
+            copy = task.taskpool.reshape.get_copy(copy, dtt)
         with self._outbox_lock:
             self._outbox.setdefault(id(task), []).append(
                 (task, flow, copy, dst, succ_tc.name, dict(succ_locals),
@@ -124,9 +130,12 @@ class RemoteDepEngine:
             edges = self._outbox.pop(id(task), None)
         if not edges:
             return
-        byflow: Dict[str, dict] = {}
+        byflow: Dict[Tuple, dict] = {}
         for (_t, flow, copy, dst, tc_name, locs, dflow) in edges:
-            ent = byflow.setdefault(flow.name, {"copy": copy, "targets": {}})
+            # group by (flow, payload copy): pre-send reshapes may split
+            # one flow into several distinct payloads
+            ent = byflow.setdefault((flow.name, id(copy)),
+                                    {"copy": copy, "targets": {}})
             ent["targets"].setdefault(dst, []).append((tc_name, locs, dflow))
         tp = task.taskpool
         for fname, ent in byflow.items():
@@ -293,11 +302,22 @@ class RemoteDepEngine:
             datum = Data(nb_elts=array.nbytes)
             copy = datum.create_copy(0, payload=array,
                                      coherency=Coherency.SHARED, version=1)
+        from parsec_tpu.data.reshape import as_dtt, needs_reshape
         for tc_name, locs, dflow in deliveries:
             tc = tp.task_classes.get(tc_name)
             if tc is None:
                 raise RuntimeError(f"unknown task class {tc_name!r}")
-            t = deliver_dep(tp, tc, locs, dflow, copy, None)
+            dcopy = copy
+            if copy is not None:
+                # receiver-side datatype resolution: the consumer's IN
+                # dtt governs what it is handed (reference:
+                # remote_dep_get_datatypes, remote_dep_mpi.c:832)
+                fl = tc.flow(dflow)
+                dep = fl.active_input(locs) if fl is not None else None
+                dtt = as_dtt(dep.dtt) if dep is not None else None
+                if dtt is not None and needs_reshape(copy, dtt):
+                    dcopy = tp.reshape.get_copy(copy, dtt)
+            t = deliver_dep(tp, tc, locs, dflow, dcopy, None)
             if t is not None:
                 ready.append(t)
         if ready:

@@ -84,6 +84,15 @@ class Context:
         self.device_registry = init_devices(self)
         self.devices = self.device_registry.devices
 
+        # ICI transport: multi-device payload edges ride XLA collectives
+        # (reference: the second comm-engine module seam, SURVEY §5.8)
+        self.ici = None
+        if params.get("comm_ici_enabled", 1):
+            from parsec_tpu.comm.ici import IciEngine
+            ici = IciEngine(self.device_registry)
+            if ici.ndev >= 2:
+                self.ici = ici
+
         # termination detection factory (per-taskpool module instances share
         # this class; reference installs termdet per taskpool)
         _, td_cls = components.select("termdet",

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from parsec_tpu.containers.hash_table import REMOVE
 from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency, Data,
                                   DataCopy, FLAG_COW)
+from parsec_tpu.data.reshape import as_dtt, convert, needs_reshape
 from parsec_tpu.core.task import (Dep, Flow, FromDesc, FromTask, New, Null,
                                   Task, TaskClass, ToDesc, ToTask)
 
@@ -102,6 +103,11 @@ def prepare_input(es, task: Task) -> None:
             # device module's stage-in for accelerator ones — so a tile
             # resident on the device that will run the task moves zero
             # bytes (reference: the data_lookup / stage_in split).
+            dtt = as_dtt(dep.dtt)
+            if dtt is not None and needs_reshape(copy, dtt):
+                # converting read from the collection (reference:
+                # parsec_get_copy_reshape_from_desc)
+                copy = tp.reshape.get_copy(copy, dtt)
             task.data[flow.name] = copy
         elif isinstance(end, New):
             arena = tp.arenas.get(end.arena_name)
@@ -174,7 +180,8 @@ def stage_in_host(task: Task) -> None:
         task.data[flow.name] = host
 
 
-def _writeback(task: Task, flow: Flow, copy: DataCopy, ref) -> None:
+def _writeback(task: Task, flow: Flow, copy: DataCopy, ref,
+               dtt=None) -> None:
     """Return a produced copy to its collection datum (``-> A(m, n)``).
 
     A copy that already belongs to the datum needs NO data movement — in
@@ -193,14 +200,30 @@ def _writeback(task: Task, flow: Flow, copy: DataCopy, ref) -> None:
     data-copies + repo refcount protocol, datarepo.h:50-58).
     """
     datum = ref.resolve()
-    if copy.data is datum and datum.copy_on(copy.device) is copy:
-        # attached: in place (host) or device-resident (lazy pull-home).
-        # A DETACHED copy of the same datum is a superseded snapshot a
-        # WRITE body mutated privately — its value must still land below
-        # or the update is silently lost.
+    if copy.data is datum and datum.copy_on(copy.device) is copy \
+            and (dtt is None or not needs_reshape(copy, dtt)) \
+            and (dtt is None or dtt.inverse is None):
+        # attached and already in home type: in place (host) or
+        # device-resident (lazy pull-home).  A DETACHED copy of the same
+        # datum is a superseded snapshot a WRITE body mutated privately —
+        # its value must still land below or the update is silently lost;
+        # an edge-layout (dtt) copy must be converted home below.
         return
-    arr = np.asarray(copy.payload).copy()
+    if dtt is not None:
+        # reshape-on-writeback: undo the edge's layout transform
+        # (reference: the reverse reshape of parsec_reshape.c remote/
+        # local writeback paths)
+        arr = np.asarray(convert(copy.payload, dtt, inverse=True)).copy()
+    else:
+        arr = np.asarray(copy.payload).copy()
     with datum._lock:
+        old = datum.copy_on(0)
+        want = getattr(old.payload, "dtype", None) if old is not None \
+            else getattr(datum.collection, "dtype", None)
+        if want is not None and arr.dtype != want:
+            # the collection's dtype is authoritative at home (bf16
+            # compute edges land back in the f32 collection)
+            arr = arr.astype(want)
         datum.detach_copy(0)   # readers keep their pinned snapshot
         for c in datum.copies().values():
             c.coherency = Coherency.INVALID
@@ -245,7 +268,8 @@ def release_deps(es, task: Task) -> List[Task]:
             end = dep.end
             if isinstance(end, ToDesc):
                 if copy is not None:
-                    _writeback(task, flow, copy, end.ref_fn(task.locals))
+                    _writeback(task, flow, copy, end.ref_fn(task.locals),
+                               dtt=as_dtt(dep.dtt))
             elif isinstance(end, ToTask):
                 succ_tc = tp.task_classes[end.task_class]
                 for succ_locals in end.instances(task.locals):
@@ -257,18 +281,37 @@ def release_deps(es, task: Task) -> List[Task]:
                             es, task, flow, dep, succ_tc, succ_locals, copy)
                         remote_count += 1
                         continue
-                    local_deliveries.append((succ_tc, succ_locals, end.flow))
+                    local_deliveries.append(
+                        (succ_tc, succ_locals, end.flow, dep))
             # Null outputs: data is discarded (arena copies will be
             # released by the repo retirement below, or were views)
         total = len(local_deliveries) + remote_count
         if remote_count and not local_deliveries and copy is not None \
                 and copy.arena is not None:
             remote_only_arena.append(copy)
-        for succ_tc, succ_locals, dflow in local_deliveries:
+        if copy is not None and len(local_deliveries) > 1 \
+                and tp.context is not None and tp.context.ici is not None:
+            # panel fan-out: replicate the tile onto every consumer device
+            # in ONE collective instead of N separate stage-in transfers
+            # (reference: dataflow bcast trees, remote_dep.c:334-357;
+            # SURVEY §5.8 ICI lowering)
+            spaces = tp.context.ici.consumer_spaces(
+                tp, [d[:3] for d in local_deliveries])
+            if spaces:
+                tp.context.ici.prebroadcast(copy, spaces)
+        for succ_tc, succ_locals, dflow, odep in local_deliveries:
             dcopy = copy
-            if copy is not None and total > 1 and \
+            if copy is not None:
+                # edge datatype: the consumer's IN dtt wins, else the
+                # producer's OUT dtt (reference: receiver-side datatype
+                # lookup, remote_dep_get_datatypes)
+                edge_dtt = _edge_dtt(succ_tc, dflow, succ_locals) \
+                    or as_dtt(odep.dtt)
+                if edge_dtt is not None and needs_reshape(copy, edge_dtt):
+                    dcopy = tp.reshape.get_copy(copy, edge_dtt)
+            if dcopy is not None and total > 1 and \
                     succ_tc.flow(dflow).access & ACCESS_WRITE:
-                dcopy = _cow_copy(copy)
+                dcopy = _cow_copy(dcopy)
             if entry is None and copy is not None:
                 entry = tc.repo.lookup_entry_and_create(task.key)
             if copy is not None:
@@ -301,6 +344,15 @@ def release_deps(es, task: Task) -> List[Task]:
                 copy.data.detach_copy(copy.device)
             copy.arena.release_copy(copy)
     return ready
+
+
+def _edge_dtt(succ_tc: TaskClass, dflow: str, succ_locals: Dict[str, int]):
+    """The consumer-side dtt of a task-fed edge, if any."""
+    flow = succ_tc.flow(dflow)
+    if flow is None:
+        return None
+    dep = flow.active_input(succ_locals)
+    return as_dtt(dep.dtt) if dep is not None else None
 
 
 def _cow_copy(copy: DataCopy) -> DataCopy:

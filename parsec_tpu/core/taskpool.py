@@ -56,6 +56,10 @@ class Taskpool:
         #: collection datums whose host copy a writeback replaced; their
         #: user-visible backing re-links at termination (engine._writeback)
         self.dirty_data: set = set()
+        #: reshape promises: one shared conversion per (copy, dtt) edge
+        #: (reference: parsec_reshape.c promise table)
+        from parsec_tpu.data.reshape import ReshapeCache
+        self.reshape = ReshapeCache()
         self._complete_cbs: List[Callable[["Taskpool"], None]] = []
         self._done_event = threading.Event()
         self.priority = 0
@@ -104,6 +108,7 @@ class Taskpool:
             if datum.collection is not None:
                 datum.collection.refresh_backing(datum)
         self.dirty_data.clear()
+        self.reshape.clear()
         cbs = list(self._complete_cbs)
         for cb in cbs:
             cb(self)

@@ -151,6 +151,26 @@ class TiledMatrix(DataCollection):
                 if self.tile_exists(m, n)
                 and self.rank_of(m, n) == self.myrank]
 
+    def distribute_devices(self, context_or_spaces) -> "TiledMatrix":
+        """Pin local tiles block-cyclically over the process's accelerator
+        memory spaces (the intra-rank analog of rank_of: owner-computes
+        over the device mesh; reference: data-affinity device selection,
+        device.c:79-140).  Accepts a Context or an explicit list of
+        memory-space indices."""
+        spaces = context_or_spaces
+        if hasattr(spaces, "device_registry"):
+            spaces = [d.space
+                      for d in spaces.device_registry.accelerators]
+        spaces = list(spaces)
+        if not spaces:
+            return self
+        for (m, n) in [(m, n) for m in range(self.mt)
+                       for n in range(self.nt) if self.tile_exists(m, n)
+                       and self.rank_of(m, n) == self.myrank]:
+            self.data_of(m, n).preferred_device = \
+                spaces[(m * self.nt + n) % len(spaces)]
+        return self
+
 
 class Grid2DCyclic:
     """PxQ process grid with kp/kq repetition (reference: grid_2Dcyclic.c)."""

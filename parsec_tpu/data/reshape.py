@@ -1,0 +1,145 @@
+"""Reshape engine: datatype/layout conversion on dependency edges.
+
+Rebuild of the reference's reshape machinery (reference:
+parsec/parsec_reshape.c — ``parsec_local_reshape``,
+``parsec_get_copy_reshape_from_{desc,dep}`` parsec_internal.h:617-634,
+``parsec_set_up_reshape_promise`` :606): when a dependency edge carries a
+datatype tag (``dtt``) different from the produced copy's type, the
+consumer receives a converted copy materialized through a shared
+datacopy-future promise — one conversion feeds every consumer demanding
+the same dtt, and the converted copy is released when the last of them
+consumed it.
+
+On TPU a "datatype" is (dtype, layout transform): the canonical uses are
+precision staging (f32 collections with bf16 compute edges — the MXU-
+native mixed precision) and relayout (transpose/retile) on an edge.
+Conversions of device-resident payloads run as jitted XLA programs on the
+owning device (no host round-trip); host payloads convert in numpy.
+
+Edge semantics (mirroring the reference's reshape test matrix,
+tests/collections/reshape/):
+- IN(TASK(...), dtt=t)   — consumer-side reshape of a task-fed edge
+- IN(DATA(...), dtt=t)   — reshape on read from the collection
+- OUT(DATA(...), dtt=t)  — reshape on write-back home (the inverse
+                           transform, then cast to the collection dtype)
+- remote edges           — pre-send reshape: the converted payload is
+                           what travels (remote_dep.flush_activations)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from parsec_tpu.containers.futures import DataCopyFuture
+from parsec_tpu.data.data import Coherency, Data, DataCopy
+
+
+class Dtt:
+    """A datatype/layout tag for dependency edges
+    (reference: parsec_arena_datatype_t + MPI datatype on a dep,
+    parsec_internal.h:41-45)."""
+
+    __slots__ = ("name", "dtype", "transform", "inverse")
+
+    def __init__(self, dtype: Any = None,
+                 transform: Optional[Callable] = None,
+                 inverse: Optional[Callable] = None,
+                 name: Optional[str] = None):
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        self.transform = transform
+        self.inverse = inverse
+        self.name = name or (self.dtype.name if self.dtype is not None
+                             else f"dtt@{id(self):x}")
+
+    def key(self) -> Tuple:
+        return (self.name, str(self.dtype),
+                id(self.transform) if self.transform else 0)
+
+    def __repr__(self):
+        return f"<Dtt {self.name}>"
+
+
+def as_dtt(spec: Any) -> Optional["Dtt"]:
+    """Coerce a user-facing dtt spec: Dtt | dtype-like | None."""
+    if spec is None or isinstance(spec, Dtt):
+        return spec
+    return Dtt(dtype=spec)
+
+
+def _is_device_array(payload) -> bool:
+    return payload is not None and not isinstance(payload, np.ndarray) \
+        and hasattr(payload, "devices")
+
+
+def convert(payload, dtt: Dtt, inverse: bool = False):
+    """Apply a dtt to a payload.  Device arrays convert on-device (XLA
+    fuses the cast/relayout into one program); host arrays via numpy."""
+    fn = dtt.inverse if inverse else dtt.transform
+    if _is_device_array(payload):
+        import jax.numpy as jnp
+        arr = payload
+        if fn is not None:
+            arr = fn(arr)
+        if dtt.dtype is not None and not inverse:
+            arr = arr.astype(dtt.dtype)
+        return arr
+    arr = np.asarray(payload)
+    if fn is not None:
+        arr = np.asarray(fn(arr))
+    if dtt.dtype is not None and not inverse:
+        arr = arr.astype(dtt.dtype)
+    return arr
+
+
+def needs_reshape(copy: DataCopy, dtt: Optional[Dtt]) -> bool:
+    if dtt is None or copy is None or copy.payload is None:
+        return False
+    if dtt.transform is not None:
+        return True
+    if dtt.dtype is None:
+        return False
+    have = getattr(copy.payload, "dtype", None)
+    return have is None or np.dtype(have) != dtt.dtype
+
+
+class ReshapeCache:
+    """Per-taskpool table of reshape promises
+    (reference: the reshape repo keyed by (entry, dep datatype),
+    parsec_reshape.c).  One DataCopyFuture per (source copy, dtt): every
+    consumer demanding the same conversion shares one materialization.
+    """
+
+    def __init__(self):
+        self._futures: Dict[Tuple, DataCopyFuture] = {}
+        self._lock = threading.Lock()
+        self.conversions = 0   # completed materializations (stats/tests)
+
+    def get_copy(self, copy: DataCopy, dtt: Dtt) -> DataCopy:
+        """The converted counterpart of ``copy`` under ``dtt``."""
+        if not needs_reshape(copy, dtt):
+            return copy
+        key = (id(copy), copy.version, dtt.key())
+        with self._lock:
+            fut = self._futures.get(key)
+            if fut is None:
+                def trigger(_spec, copy=copy, dtt=dtt):
+                    self.conversions += 1
+                    arr = convert(copy.payload, dtt)
+                    datum = Data(nb_elts=getattr(arr, "nbytes", 0))
+                    device = copy.device if _is_device_array(arr) else 0
+                    dc = DataCopy(datum, device, payload=arr,
+                                  coherency=Coherency.SHARED,
+                                  version=copy.version)
+                    dc.dtt = dtt
+                    datum.attach_copy(dc)
+                    return dc
+                fut = DataCopyFuture(trigger)
+                self._futures[key] = fut
+        return fut.get_copy()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._futures.clear()

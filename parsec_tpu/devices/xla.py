@@ -41,7 +41,7 @@ from parsec_tpu.core.task import ToDesc
 from parsec_tpu.utils.mca import params
 from parsec_tpu.utils.output import debug_verbose
 
-params.register("device_inflight_depth", 4,
+params.register("device_inflight_depth", 8,
                 "max in-flight device tasks per XLA device")
 params.register("device_mem_mb", 0,
                 "device copy-cache capacity in MiB (0 = unlimited)")
@@ -70,8 +70,19 @@ class XlaKernel:
         self.arg_names = list(arg_names)
         self.flow_names = set(flow_names)
         self.writable = list(writable_flows)   # flow declaration order
+        #: per-instance fast path: donate-flag -> jitted callable, dodging
+        #: the lock + tuple rebuild on every launch (hot path)
+        self._fast: Dict[bool, Any] = {}
 
     def jitted(self, donate: bool):
+        jf = self._fast.get(donate)
+        if jf is not None:
+            return jf
+        jf = self._jitted_slow(donate)
+        self._fast[donate] = jf
+        return jf
+
+    def _jitted_slow(self, donate: bool):
         # The jit cache lives ON the kernel function object, so its
         # lifetime is the function's: module-level kernels (apps memoize
         # theirs, e.g. gemm._kernels) share traced executables across

@@ -170,10 +170,16 @@ def _bind_body_outputs(task, ret: Any, writable: List[str]) -> None:
         copy = task.data.get(name)
         if copy is None:
             raise RuntimeError(f"{task}: flow {name!r} has no bound copy")
-        if isinstance(copy.payload, np.ndarray):
-            np.copyto(copy.payload, np.asarray(value))
+        arr = np.asarray(value) if not hasattr(value, "devices") else value
+        if isinstance(copy.payload, np.ndarray) \
+                and isinstance(arr, np.ndarray) \
+                and arr.shape == copy.payload.shape \
+                and arr.dtype == copy.payload.dtype:
+            np.copyto(copy.payload, arr)
         else:
-            copy.payload = value
+            # shape/dtype change (a dtt edge layout, or a device array):
+            # rebind the payload; the writeback path converts home
+            copy.payload = arr
 
 
 # -- task-class builder ------------------------------------------------------

@@ -1,0 +1,190 @@
+"""Reshape engine tests: dtt-driven conversion on dependency edges
+(reference: parsec_reshape.c + the reshape test matrix
+tests/collections/reshape/ — these cover the local input-reshape from
+task-fed edges and from the descriptor, the shared-promise fan-out, and
+the reshape-on-writeback path).
+"""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import VectorTwoDimCyclic, TwoDimBlockCyclic
+from parsec_tpu.data.reshape import Dtt, ReshapeCache, convert, needs_reshape
+from parsec_tpu.data.data import Data, DataCopy, Coherency
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+
+bf16 = np.dtype(ml_dtypes.bfloat16)
+
+
+def test_needs_reshape_and_convert_unit():
+    d = Data(nb_elts=16)
+    c = d.create_copy(0, payload=np.ones((2, 2), np.float32),
+                      coherency=Coherency.SHARED, version=1)
+    assert not needs_reshape(c, None)
+    assert not needs_reshape(c, Dtt(dtype=np.float32))
+    assert needs_reshape(c, Dtt(dtype=bf16))
+    t = Dtt(transform=lambda a: a.T, inverse=lambda a: a.T, name="T")
+    assert needs_reshape(c, t)
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_array_equal(convert(a, t), a.T)
+    np.testing.assert_array_equal(convert(a, t, inverse=True), a.T)
+    assert convert(a, Dtt(dtype=bf16)).dtype == bf16
+
+
+def test_shared_promise_converts_once():
+    cache = ReshapeCache()
+    d = Data(nb_elts=16)
+    c = d.create_copy(0, payload=np.ones((2, 2), np.float32),
+                      coherency=Coherency.SHARED, version=1)
+    t = Dtt(dtype=bf16)
+    r1 = cache.get_copy(c, t)
+    r2 = cache.get_copy(c, t)
+    assert r1 is r2 and cache.conversions == 1
+    assert np.asarray(r1.payload).dtype == bf16
+
+
+def test_task_edge_reshape_f32_to_bf16():
+    """f32 collection, bf16 task-fed edges: consumers see bf16 payloads,
+    the writeback lands f32 at home (the mixed-precision staging edge)."""
+    NT, mb = 2, 4
+    base = np.arange(1.0, NT * mb + 1, dtype=np.float32)
+    V = VectorTwoDimCyclic(mb=mb, lm=NT * mb).from_array(base.copy())
+    seen = {}
+    p = PTG("mix", NT=NT)
+    p.task("P", i=Range(0, NT - 1)) \
+        .flow("X", "READ",
+              IN(DATA(lambda i, V=V: V(i))),
+              OUT(TASK("Q", "X", lambda i: dict(i=i)))) \
+        .body(lambda: None)
+
+    def q_body(X, i):
+        seen[i] = np.asarray(X).dtype
+        return (2.0 * np.asarray(X)).astype(np.float32)
+    p.task("Q", i=Range(0, NT - 1)) \
+        .flow("X", "RW",
+              IN(TASK("P", "X", lambda i: dict(i=i)), dtt=Dtt(dtype=bf16)),
+              OUT(DATA(lambda i, V=V: V(i)))) \
+        .body(q_body)
+    tp = p.build()
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert all(dt == bf16 for dt in seen.values()), seen
+    out = V.to_array()
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, 2.0 * base, rtol=1e-2)  # bf16 rounding
+    assert tp.reshape.conversions == NT
+
+
+def test_desc_read_reshape():
+    """IN(DATA(...), dtt=...): converting read straight from the
+    collection (reference: parsec_get_copy_reshape_from_desc)."""
+    NT, mb = 2, 4
+    base = np.arange(1.0, NT * mb + 1, dtype=np.float32)
+    V = VectorTwoDimCyclic(mb=mb, lm=NT * mb).from_array(base.copy())
+    seen = {}
+
+    def body(X, i):
+        seen[i] = np.asarray(X).dtype
+    p = PTG("dread", NT=NT)
+    p.task("R", i=Range(0, NT - 1)) \
+        .flow("X", "READ",
+              IN(DATA(lambda i, V=V: V(i)), dtt=Dtt(dtype=bf16))) \
+        .body(body)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=30)
+    assert all(dt == bf16 for dt in seen.values()), seen
+    # the collection itself was never converted
+    assert V.to_array().dtype == np.float32
+
+
+def test_writeback_inverse_transform():
+    """OUT(DATA(...), dtt with transform): the edge layout is undone on
+    the way home (reference: reverse reshape on writeback)."""
+    mb = 4
+    base = np.arange(12, dtype=np.float32).reshape(4, 3)
+    M = TwoDimBlockCyclic(mb=4, nb=3, lm=4, ln=3).from_array(base.copy())
+    tr = Dtt(transform=lambda a: a.T, inverse=lambda a: a.T, name="T")
+    p = PTG("tposed")
+    # P produces the tile in TRANSPOSED edge layout; the dtt's inverse
+    # restores home layout on writeback
+    p.task("P") \
+        .flow("X", "RW",
+              IN(DATA(lambda M=M: M(0, 0))),
+              OUT(DATA(lambda M=M: M(0, 0)), dtt=tr)) \
+        .body(lambda X: (2.0 * np.asarray(X)).T)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=30)
+    np.testing.assert_allclose(M.to_array(), 2.0 * base, rtol=1e-6)
+
+
+def _remote_reshape_worker(ctx, rank, nranks):
+    """Rank 0 produces an f32 tile; rank 1's consumer declares a bf16
+    edge — the payload is converted BEFORE it travels (pre-send
+    reshape)."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.data.reshape import Dtt as _Dtt
+    import ml_dtypes as _md
+    V = VectorTwoDimCyclic(mb=4, lm=8, nodes=nranks, myrank=rank)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = float(m + 1)
+    seen = {}
+    p = PTG("rres")
+    p.task("P") \
+        .affinity(lambda V=V: V(0)) \
+        .flow("X", "READ",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(TASK("Q", "X", lambda: dict()))) \
+        .body(lambda: None)
+
+    def q_body(X):
+        seen["dtype"] = np.asarray(X).dtype
+        seen["val"] = float(np.asarray(X)[0])
+    p.task("Q") \
+        .affinity(lambda V=V: V(1)) \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()),
+                 dtt=_Dtt(dtype=_md.bfloat16))) \
+        .body(q_body)
+    ctx.add_taskpool(p.build())
+    ctx.wait()
+    return seen
+
+
+def test_remote_presend_reshape():
+    from parsec_tpu.comm.launch import run_distributed
+    results = run_distributed(_remote_reshape_worker, 2)
+    recv = results[1]
+    assert recv["dtype"] == bf16 and recv["val"] == 1.0
+
+
+def test_fanout_shared_reshape_single_conversion():
+    """Two readers demanding the same dtt share ONE converted copy
+    (the datacopy-future promise semantics)."""
+    mb = 4
+    base = np.arange(1.0, mb + 1, dtype=np.float32)
+    V = VectorTwoDimCyclic(mb=mb, lm=mb).from_array(base.copy())
+    seen = []
+    p = PTG("share")
+    p.task("P") \
+        .flow("X", "READ",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(TASK("R1", "X", lambda: dict())),
+              OUT(TASK("R2", "X", lambda: dict()))) \
+        .body(lambda: None)
+    for rn in ("R1", "R2"):
+        p.task(rn) \
+            .flow("X", "READ",
+                  IN(TASK("P", "X", lambda: dict()), dtt=Dtt(dtype=bf16))) \
+            .body(lambda X: seen.append(np.asarray(X).dtype))
+    tp = p.build()
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert seen == [bf16, bf16]
+    assert tp.reshape.conversions == 1
