@@ -32,8 +32,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from parsec_tpu.comm.engine import (CommEngine, TAG_ACTIVATE, TAG_GET_REP,
-                                    TAG_GET_REQ, TAG_TERMDET)
+from parsec_tpu.comm.engine import (CommEngine, TAG_ACTIVATE, TAG_DTD,
+                                    TAG_GET_REP, TAG_GET_REQ, TAG_TERMDET)
 from parsec_tpu.core import scheduling
 from parsec_tpu.core.engine import deliver_dep
 from parsec_tpu.utils.mca import params
@@ -98,8 +98,11 @@ class RemoteDepEngine:
         ce.tag_register(TAG_GET_REQ, self._get_req_cb)
         ce.tag_register(TAG_GET_REP, self._get_rep_cb)
         ce.tag_register(TAG_TERMDET, self._termdet_cb)
+        ce.tag_register(TAG_DTD, self._dtd_cb)
         #: pending GET completions: handle -> (tp_id, deliveries)
         self._pending_gets: Dict[Tuple[int, int], dict] = {}
+        #: DTD messages that raced their pool's registration on this rank
+        self._dtd_backlog: Dict[int, List] = {}
 
     def _on_handler_error(self, exc: Exception) -> None:
         self.context.record_error(exc, None)
@@ -280,6 +283,38 @@ class RemoteDepEngine:
             with self._hlock:
                 self._handles.pop(h, None)
 
+    # ------------------------------------------------------------------
+    # distributed DTD traffic (reference: the DTD two-sided protocol —
+    # remote deps tracked by (tile, rank) with delayed release,
+    # remote_dep_mpi.c:519, insert_function.c:3014-3163)
+    # ------------------------------------------------------------------
+    def dtd_send(self, dst: int, msg: dict) -> None:
+        """Counted application send for the DTD layer (Safra-visible)."""
+        self._send_app(TAG_DTD, dst, msg)
+
+    def _dtd_cb(self, src: int, msg: dict) -> None:
+        self._on_app_recv()
+        tp = self.context.taskpools.get(msg["tp"])
+        incoming = getattr(tp, "_dtd_incoming", None)
+        if incoming is not None:
+            incoming(src, msg)
+            return
+        with self._dlock:   # pool not registered here yet: backlog
+            self._dtd_backlog.setdefault(msg["tp"], []).append((src, msg))
+        # re-check: the pool may have registered — and drained an empty
+        # backlog — between the lookup above and the append (the drain
+        # pops under _dlock, so a second drain cannot double-deliver)
+        tp = self.context.taskpools.get(msg["tp"])
+        if getattr(tp, "_dtd_incoming", None) is not None:
+            self.dtd_drain_backlog(tp)
+
+    def dtd_drain_backlog(self, tp) -> None:
+        """Deliver DTD messages that arrived before ``tp`` registered."""
+        with self._dlock:
+            backlog = self._dtd_backlog.pop(tp.taskpool_id, [])
+        for src, msg in backlog:
+            tp._dtd_incoming(src, msg)
+
     def _get_rep_cb(self, src: int, msg: dict) -> None:
         self._on_app_recv()
         key = (msg["root"], msg["handle"])
@@ -332,7 +367,7 @@ class RemoteDepEngine:
         balance alone does not capture."""
         ctx = self.context
         with self._dlock:
-            if self._delayed:
+            if self._delayed or self._dtd_backlog:
                 return False
         if self._pending_gets:
             return False

@@ -85,10 +85,13 @@ class Context:
         self.devices = self.device_registry.devices
 
         # ICI transport: multi-device payload edges ride XLA collectives
-        # (reference: the second comm-engine module seam, SURVEY §5.8)
+        # (reference: the second comm-engine module seam, SURVEY §5.8).
+        # Import first: it registers comm_ici_enabled, so an env override
+        # (PARSEC_MCA_COMM_ICI_ENABLED=0) coerces to int instead of
+        # arriving as a truthy raw string.
+        from parsec_tpu.comm.ici import IciEngine
         self.ici = None
-        if params.get("comm_ici_enabled", 1):
-            from parsec_tpu.comm.ici import IciEngine
+        if int(params.get("comm_ici_enabled", 1)):
             ici = IciEngine(self.device_registry)
             if ici.ndev >= 2:
                 self.ici = ici
@@ -139,9 +142,12 @@ class Context:
         """reference: parsec_context_add_taskpool (scheduling.c:678)."""
         with self._lock:
             self._active_taskpools += 1
+            # register BEFORE attach: attach may drain comm backlogs whose
+            # re-delivery path looks the pool up in this table — a message
+            # arriving in between must find it
+            self.taskpools[tp.taskpool_id] = tp
             tp.attach(self, self._termdet)
             self._pending_start.append(tp)
-            self.taskpools[tp.taskpool_id] = tp
         if self.comm is not None:
             # activations may have raced this registration
             self.comm.retry_delayed()
@@ -188,6 +194,17 @@ class Context:
             raise RuntimeError(f"task {task} failed") from exc
         if not ok:
             raise TimeoutError("parsec context wait timed out")
+        # drain accelerator pipelines: deps are released eagerly on
+        # dispatch (devices/xla.py completer), so pool termination means
+        # "all work dispatched" — quiescence means "all work done", and
+        # late device-side failures surface here
+        for d in self.device_registry.accelerators:
+            dsync = getattr(d, "sync", None)
+            if dsync is not None:
+                dsync(timeout=timeout)
+        if self._errors:
+            exc, task = self._errors[0]
+            raise RuntimeError(f"task {task} failed") from exc
         if self.comm is not None:
             # distributed: local completion is not global completion —
             # peers may still pull our data (reference: ranks keep

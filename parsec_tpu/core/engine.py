@@ -23,6 +23,7 @@ from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency, Data,
 from parsec_tpu.data.reshape import as_dtt, convert, needs_reshape
 from parsec_tpu.core.task import (Dep, Flow, FromDesc, FromTask, New, Null,
                                   Task, TaskClass, ToDesc, ToTask)
+from parsec_tpu.utils.output import warning
 
 import numpy as np
 
@@ -200,14 +201,19 @@ def _writeback(task: Task, flow: Flow, copy: DataCopy, ref,
     data-copies + repo refcount protocol, datarepo.h:50-58).
     """
     datum = ref.resolve()
+    home_dtype = getattr(datum.collection, "dtype", None)
     if copy.data is datum and datum.copy_on(copy.device) is copy \
             and (dtt is None or not needs_reshape(copy, dtt)) \
-            and (dtt is None or dtt.inverse is None):
+            and (dtt is None or dtt.inverse is None) \
+            and (home_dtype is None or
+                 getattr(copy.payload, "dtype", home_dtype) == home_dtype):
         # attached and already in home type: in place (host) or
         # device-resident (lazy pull-home).  A DETACHED copy of the same
         # datum is a superseded snapshot a WRITE body mutated privately —
         # its value must still land below or the update is silently lost;
-        # an edge-layout (dtt) copy must be converted home below.
+        # an edge-layout (dtt) copy — or a body that rebound the attached
+        # copy to the EDGE dtype (dtype-only OUT dtt) — must be converted
+        # home below or the collection silently keeps the stale value.
         return
     if dtt is not None:
         # reshape-on-writeback: undo the edge's layout transform
@@ -218,8 +224,12 @@ def _writeback(task: Task, flow: Flow, copy: DataCopy, ref,
         arr = np.asarray(copy.payload).copy()
     with datum._lock:
         old = datum.copy_on(0)
-        want = getattr(old.payload, "dtype", None) if old is not None \
-            else getattr(datum.collection, "dtype", None)
+        # the collection's dtype is authoritative at home; the old host
+        # copy's dtype is only a fallback — the body may have rebound
+        # that copy to the EDGE dtype already (dtype-only OUT dtt)
+        want = home_dtype if home_dtype is not None else \
+            (getattr(old.payload, "dtype", None) if old is not None
+             else None)
         if want is not None and arr.dtype != want:
             # the collection's dtype is authoritative at home (bf16
             # compute edges land back in the f32 collection)
@@ -286,6 +296,13 @@ def release_deps(es, task: Task) -> List[Task]:
             # Null outputs: data is discarded (arena copies will be
             # released by the repo retirement below, or were views)
         total = len(local_deliveries) + remote_count
+        if copy is None and total > 0 and flow.access != 0:
+            # a data (non-CTL) flow handing None downstream: legal — the
+            # successor's input binds NULL — but almost always a graph
+            # bug, so flag it like the reference does (ptgpp
+            # forward_{READ,RW}_NULL golden behavior)
+            warning("A NULL is forwarded from %s flow %s to %d "
+                    "successor(s)", task, flow.name, total)
         if remote_count and not local_deliveries and copy is not None \
                 and copy.arena is not None:
             remote_only_arena.append(copy)

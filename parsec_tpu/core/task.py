@@ -230,9 +230,13 @@ class TaskClass:
                  body: Optional[Callable] = None,
                  incarnations: Sequence[Tuple[str, Callable]] = (),
                  priority: Optional[Callable[[Dict[str, int]], int]] = None,
-                 properties: Optional[Dict[str, Any]] = None):
+                 properties: Optional[Dict[str, Any]] = None,
+                 key_fn: Optional[Callable[[Dict[str, int]], Any]] = None):
         self.name = name
         self.params = list(params)
+        #: user-defined key function (reference: the [make_key_fn = ...]
+        #: task-class property, tests/dsl/ptg/user-defined-functions/udf.jdf)
+        self.key_fn = key_fn
         self.affinity = affinity
         self.flows = list(flows)
         for i, f in enumerate(self.flows):
@@ -253,6 +257,8 @@ class TaskClass:
 
     # -- key machinery (reference: make_key / task_snprintf) --------------
     def make_key(self, locals_: Dict[str, int]) -> Tuple:
+        if self.key_fn is not None:
+            return (self.name, self.key_fn(locals_))
         return (self.name,) + tuple(locals_[p] for p, _ in self.params)
 
     def key_to_locals(self, key: Tuple) -> Dict[str, int]:

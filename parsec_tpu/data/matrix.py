@@ -272,6 +272,10 @@ class VectorTwoDimCyclic(TiledMatrix):
     def rank_of(self, m: int, n: int = 0) -> int:
         return m % self.nodes
 
+    def tile_shape(self, m: int, n: int = 0) -> Tuple[int, ...]:
+        """Vector payloads are 1D."""
+        return (min(self.mb, self.lm - m * self.mb),)
+
     def from_array(self, a: np.ndarray) -> "VectorTwoDimCyclic":
         if a.shape != (self.lm,):
             raise ValueError(f"expected ({self.lm},), got {a.shape}")

@@ -43,6 +43,11 @@ from parsec_tpu.utils.output import debug_verbose
 
 params.register("device_inflight_depth", 8,
                 "max in-flight device tasks per XLA device")
+params.register("device_runahead", 256,
+                "max eagerly-completed tasks with unmaterialized outputs "
+                "before the completer blocks (memory safety valve; each "
+                "blocking wait costs a full RPC round trip on tunneled "
+                "TPUs, so keep this well above the DAG's width)")
 params.register("device_mem_mb", 0,
                 "device copy-cache capacity in MiB (0 = unlimited)")
 params.register("device_donate", 1,
@@ -146,6 +151,8 @@ class XlaDevice(Device):
                         and self.platform in ("tpu", "axon", "gpu", "cuda",
                                               "rocm"))
         self._depth = max(1, int(params.get("device_inflight_depth", 4)))
+        self._runahead = max(self._depth,
+                             int(params.get("device_runahead", 256)))
         cap_mb = int(params.get("device_mem_mb", 0))
         self._capacity = cap_mb * (1 << 20) if cap_mb > 0 else None
         self._bytes_used = 0
@@ -159,6 +166,13 @@ class XlaDevice(Device):
 
         self._pending: deque = deque()
         self._inflight: deque = deque()
+        #: eagerly-completed tasks whose outputs are not yet materialized
+        #: on device; finalized (pins/load/arena released) as they become
+        #: ready, oldest-first
+        self._retire: deque = deque()
+        self._launching = 0
+        self._completing = 0
+        self._finalizing = 0
         self._cond = threading.Condition()
         self._stop = False
         self.es = None   # device execution stream, set on first submit
@@ -198,6 +212,7 @@ class XlaDevice(Device):
                 if self._stop and not self._pending:
                     return
                 task, spec, load = self._pending.popleft()
+                self._launching += 1
             try:
                 self._launch(task, spec, load)
             except Exception as exc:   # stage-in/compile failure
@@ -206,6 +221,10 @@ class XlaDevice(Device):
                 self.load_sub(load)
                 self.es.context.record_error(exc, task)
                 scheduling.complete_execution(self.es, task, failed=True)
+            finally:
+                with self._cond:
+                    self._launching -= 1
+                    self._cond.notify_all()
 
     def _launch(self, task: Task, spec: XlaKernel, load: float) -> None:
         tc = task.task_class
@@ -283,6 +302,10 @@ class XlaDevice(Device):
                 staged = jnp.array(payload, copy=True)
             else:
                 staged = jax.device_put(np.asarray(payload), self.jdev)
+                if copy.arena is not None:
+                    # eager completion can retire (and recycle) the arena
+                    # host buffer before this async H2D drains: wait it out
+                    staged.block_until_ready()
             snap = Data(nb_elts=datum.nb_elts)
             dc = snap.create_copy(self.space, payload=staged,
                                   coherency=Coherency.SHARED,
@@ -307,6 +330,11 @@ class XlaDevice(Device):
                 dc.payload = jnp.array(payload, copy=True)
             else:
                 dc.payload = jax.device_put(payload, self.jdev)
+                if (src.arena if src is not None else copy.arena) \
+                        is not None:
+                    # see the snapshot path above: don't let an eager
+                    # retirement recycle the arena buffer mid-H2D
+                    dc.payload.block_until_ready()
             dc.version = src.version if src is not None else copy.version
             self.stats.bytes_in += nbytes
             if fresh:
@@ -336,8 +364,16 @@ class XlaDevice(Device):
             return False
 
     # ------------------------------------------------------------------
-    # completer: block on oldest in-flight outputs, rebind, complete
-    # (reference: parsec_cuda_kernel_pop/epilog + progress_stream events)
+    # completer: EAGER completion on dispatch order (reference:
+    # parsec_cuda_kernel_pop/epilog + progress_stream events — but where
+    # the CUDA module must poll events before releasing deps, XLA
+    # dispatch returns asynchronous arrays that successors may consume
+    # directly: the dependency is enforced by dataflow ON DEVICE, so
+    # deps are released immediately and the Python side runs ahead,
+    # keeping the device pipeline full).  Pins, arena buffers and load
+    # accounting are held until the outputs actually materialize
+    # (_finalize), with a bounded run-ahead window of unmaterialized
+    # tasks providing backpressure.
     # ------------------------------------------------------------------
     def _completer_loop(self):
         from parsec_tpu.core import scheduling
@@ -346,14 +382,14 @@ class XlaDevice(Device):
                 while not self._inflight and not self._stop:
                     self._cond.wait(0.1)
                 if not self._inflight:
-                    if self._stop:
-                        return
-                    continue
+                    break       # _stop and drained
                 inf = self._inflight.popleft()
+                # _completing keeps the task visible to sync() between the
+                # queue pop and the retire append: complete_execution below
+                # is what wakes Context.wait, which may race into sync()
+                self._completing += 1
                 self._cond.notify_all()
             try:
-                import jax
-                jax.block_until_ready(list(inf.outputs.values()))
                 for fname, arr in inf.outputs.items():
                     dc = inf.task.data.get(fname)
                     if dc is not None:
@@ -362,13 +398,118 @@ class XlaDevice(Device):
             except Exception as exc:
                 self.stats.faults += 1
                 inf.es.context.record_error(exc, inf.task)
-                scheduling.complete_execution(inf.es, inf.task, failed=True)
+            with self._cond:
+                self._retire.append(inf)
+                self._completing -= 1
+                self._cond.notify_all()
+            try:
+                self._drain_retired(max_unfinalized=self._runahead)
+            except Exception as exc:   # the completer thread must survive
+                self.stats.faults += 1
+                inf.es.context.record_error(exc, inf.task)
+        self._drain_retired(max_unfinalized=0)
+
+    def _drain_retired(self, max_unfinalized: int) -> None:
+        """Finalize retired tasks whose outputs are ready; when more than
+        ``max_unfinalized`` are still pending, block on the oldest (the
+        run-ahead memory valve).  The device queue is in-order, so ONE
+        readiness probe of the newest entry covers the whole list —
+        readiness probes and blocking waits are full RPC round trips on
+        tunneled TPUs, so both are rationed."""
+        while True:
+            block = False
+            with self._cond:
+                if not self._retire:
+                    return
+                if self._outputs_ready(self._retire[-1]):
+                    batch = list(self._retire)
+                    self._retire.clear()
+                elif len(self._retire) > max_unfinalized:
+                    batch = [self._retire.popleft()]
+                    block = True
+                else:
+                    return
+                # popped entries stay visible to sync() until their
+                # finalization lands (late errors must beat wait())
+                self._finalizing += len(batch)
+                self._cond.notify_all()
+            try:
+                for inf in batch:
+                    self._finalize(inf, block=block)
             finally:
-                self.load_sub(inf.load)
-                for d in inf.pinned:
-                    self._unpin(d)
-                for copy in inf.release_after:
-                    copy.arena.release_copy(copy)
+                with self._cond:
+                    self._finalizing -= len(batch)
+                    self._cond.notify_all()
+
+    @staticmethod
+    def _outputs_ready(inf: _Inflight) -> bool:
+        for a in inf.outputs.values():
+            r = getattr(a, "is_ready", None)
+            if r is None:
+                continue
+            try:
+                if not r():
+                    return False
+            except Exception as exc:
+                if "deleted" in str(exc).lower():
+                    # a successor kernel donated this buffer away — it
+                    # was consumed, ordering is the device's problem now
+                    continue
+                # any OTHER probe failure must NOT report "ready": that
+                # would finalize without blocking and swallow the error
+                return False
+        return True
+
+    def _finalize(self, inf: _Inflight, block: bool) -> None:
+        try:
+            if block:
+                import jax
+                for a in inf.outputs.values():
+                    try:
+                        jax.block_until_ready(a)
+                    except Exception as exc:
+                        if "deleted" in str(exc).lower():
+                            continue   # donated away — see _outputs_ready
+                        raise
+        except Exception as exc:
+            # deps were already released at dispatch; a late device-side
+            # failure surfaces as a context error (sync()/wait raise)
+            self.stats.faults += 1
+            inf.es.context.record_error(exc, inf.task)
+        finally:
+            self.load_sub(inf.load)
+            for d in inf.pinned:
+                self._unpin(d)
+            for copy in inf.release_after:
+                copy.arena.release_copy(copy)
+
+    def sync(self, timeout: Optional[float] = None) -> None:
+        """Drain the device: block until every dispatched kernel has
+        materialized its outputs (the stream-synchronize at pool
+        quiescence; reference: the GPU manager drains its exec and
+        stage-out streams before epilog).  ``timeout`` bounds the wait
+        for the dispatch queues; the final materialization block is
+        unbounded, like a stream synchronize."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: (not self._pending and self._launching == 0
+                         and self._completing == 0
+                         and self._finalizing == 0
+                         and not self._inflight) or self._stop,
+                timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"device {self.name}: sync timed out")
+            entries = list(self._retire)
+            self._retire.clear()
+        if not entries:
+            return
+        # newest-first: the device queue is in-order, so one blocking
+        # wait on the LAST dispatched outputs covers the earlier ones —
+        # each avoided block or probe is a full RPC round trip on
+        # tunneled TPUs
+        self._finalize(entries[-1], block=True)
+        for inf in entries[:-1]:
+            self._finalize(inf, block=False)
 
     # ------------------------------------------------------------------
     # device memory cache management (reference: gpu_mem_lru / zone_malloc)

@@ -39,9 +39,29 @@ from parsec_tpu.core.task import (Flow, HookReturn, Task, TaskClass,
                                   normalize_body_outputs)
 from parsec_tpu.core.taskpool import Taskpool
 from parsec_tpu.data.collection import DataCollection, DataRef
-from parsec_tpu.data.data import (ACCESS_READ, ACCESS_RW, ACCESS_WRITE, Data,
-                                  new_data)
+from parsec_tpu.data.data import (ACCESS_READ, ACCESS_RW, ACCESS_WRITE,
+                                  Coherency, Data, new_data)
 from parsec_tpu.utils.mca import params
+
+
+def _apply_payload(datum: Data, arr: np.ndarray) -> None:
+    """Land a network payload as the datum's new authoritative host value
+    (in place when possible, so collection backing views stay linked)."""
+    with datum._lock:
+        host = datum.copy_on(0)
+        if host is None:
+            host = datum.create_copy(0, payload=np.array(arr, copy=True))
+        elif isinstance(host.payload, np.ndarray) and \
+                host.payload.shape == arr.shape:
+            np.copyto(host.payload, arr)
+        else:
+            host.payload = np.array(arr, copy=True)
+        for c in datum.copies().values():
+            if c is not host:
+                c.coherency = Coherency.INVALID
+        datum._version_clock += 1
+        host.version = datum._version_clock
+        host.coherency = Coherency.EXCLUSIVE
 
 params.register("dtd_window_size", 2048,
                 "max in-flight DTD tasks before insert_task throttles")
@@ -71,27 +91,52 @@ DONT_TRACK = _Mode("DONT_TRACK", 0)  # access data without dep tracking
 
 class DTDTile:
     """Dep-tracking state of one datum (reference: parsec_dtd_tile_t —
-    last_user / last_writer tracking)."""
+    last_user / last_writer tracking; ``version`` counts writers in the
+    insertion stream, identically on every rank; ``wire_key`` names the
+    tile on the wire)."""
 
-    __slots__ = ("data", "last_writer", "readers")
+    __slots__ = ("data", "last_writer", "readers", "home_rank", "version",
+                 "wire_key", "v0_sent")
 
-    def __init__(self, data: Data):
+    def __init__(self, data: Data, home_rank: int = 0, wire_key: Any = None):
         self.data = data
         self.last_writer: Optional["_DTDState"] = None
         self.readers: List["_DTDState"] = []
+        self.home_rank = home_rank
+        self.version = 0
+        self.wire_key = wire_key
+        #: ranks already sent the pristine (version-0) home payload
+        self.v0_sent: set = set()
 
 
 class _DTDState:
-    """Runtime dep bookkeeping of one inserted task."""
+    """Runtime dep bookkeeping of one inserted task.
 
-    __slots__ = ("task", "remaining", "successors", "done", "affinity")
+    ``is_recv`` marks a *delivery surrogate*: the local stand-in for one
+    (tile, version) produced by a task on another rank (reference: remote
+    writers tracked as fake tasks, insert_function.c:3014-3163).  A
+    surrogate joins the dep graph like a writer, but is only counted and
+    scheduled once a local consumer *needs* that version; its body applies
+    the network payload to the tile datum."""
 
-    def __init__(self, task: Task):
+    __slots__ = ("task", "remaining", "successors", "done", "affinity",
+                 "rank", "is_recv", "needed", "tile", "version", "payload",
+                 "remote_sends")
+
+    def __init__(self, task: Optional[Task], rank: int = 0):
         self.task = task
         self.remaining = 0
         self.successors: List["_DTDState"] = []
         self.done = False
         self.affinity = None
+        self.rank = rank
+        self.is_recv = False
+        self.needed = False
+        self.tile: Optional[DTDTile] = None
+        self.version = 0
+        self.payload: Optional[np.ndarray] = None
+        #: (dst_rank, tile, version) payloads to ship at completion
+        self.remote_sends: set = set()
 
 
 _seq = itertools.count()
@@ -105,12 +150,26 @@ class DTDTaskpool(Taskpool):
         super().__init__(name=name)
         self._dep_lock = threading.Lock()
         self._tiles: Dict[Any, DTDTile] = {}
+        self._tiles_by_wire: Dict[Any, DTDTile] = {}
+        self._dc_ids: Dict[int, int] = {}
         self._classes: Dict[Any, TaskClass] = {}
         self._inflight = 0
         self._window = threading.Condition(self._dep_lock)
         self._finished = False
         self.window_size = params.get("dtd_window_size", 2048)
         self.threshold = params.get("dtd_threshold_size", 1024)
+        # distributed state (single-rank pools never touch it)
+        self.myrank = 0
+        self.nranks = 1
+        self._new_seq = itertools.count()
+        #: (wire_key, version) -> surrogate awaiting that payload
+        self._expected: Dict[Any, _DTDState] = {}
+        #: early-arrived payloads nobody expects yet
+        self._received: Dict[Any, np.ndarray] = {}
+        #: inbound tile-flush payloads queued until the local pool drains
+        self._flush_queue: List[Tuple[Any, np.ndarray]] = []
+        self._drained = False
+        self._recv_tc: Optional[TaskClass] = None
 
     # -- lifecycle ---------------------------------------------------------
     def attach(self, context, termdet) -> None:
@@ -119,6 +178,10 @@ class DTDTaskpool(Taskpool):
         # insertions must not terminate it (reference: DTD pools keep a
         # runtime action until parsec_dtd_taskpool_wait)
         termdet.taskpool_addto_runtime_actions(self, 1)
+        self.myrank = context.rank
+        self.nranks = context.nranks
+        if self.nranks > 1 and context.comm is not None:
+            context.comm.dtd_drain_backlog(self)
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Drain: all inserted tasks complete
@@ -136,6 +199,30 @@ class DTDTaskpool(Taskpool):
             self._raise_context_error()
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"{self} wait timed out")
+        if self.nranks > 1 and self.context.comm is not None:
+            self._flush_home()
+
+    def _flush_home(self) -> None:
+        """Send each tile whose final writer ran here back to its owner
+        rank, and apply queued inbound flushes (the distributed epilogue
+        of parsec_dtd_data_flush_all: every tile's home datum holds the
+        final value once all ranks pass Context.wait quiescence)."""
+        outgoing: List[DTDTile] = []
+        with self._dep_lock:
+            self._drained = True
+            queued, self._flush_queue = self._flush_queue, []
+            for tile in self._tiles.values():
+                lw = tile.last_writer
+                if lw is not None and not lw.is_recv \
+                        and tile.home_rank != self.myrank:
+                    outgoing.append(tile)
+        for wire, arr in queued:
+            tile = self._tiles_by_wire.get(wire)
+            if tile is not None:
+                _apply_payload(tile.data, arr)
+        for tile in outgoing:
+            self.context.comm.dtd_send(
+                tile.home_rank, self._wire_msg("flush", tile, tile.version))
 
     def _raise_context_error(self) -> None:
         errs = getattr(self.context, "_errors", None)
@@ -146,22 +233,50 @@ class DTDTaskpool(Taskpool):
     # -- tiles -------------------------------------------------------------
     def tile_of(self, dc: DataCollection, *indices) -> DTDTile:
         """Wrap a collection datum for dep tracking
-        (reference: parsec_dtd_tile_of)."""
+        (reference: parsec_dtd_tile_of).  Non-local tiles (owned by
+        another rank) get a *shadow* datum: a local buffer of the tile's
+        shape that receives forwarded versions and hosts locally-placed
+        writes until the flush home."""
         key = (id(dc), dc.data_key(*indices))
+        home = dc.rank_of(*indices)
         with self._dep_lock:
             t = self._tiles.get(key)
             if t is None:
-                t = DTDTile(dc.data_of(*indices))
+                # wire-stable collection id: first-use order is identical
+                # on every rank (SPMD insertion), and distinct collections
+                # sharing a name= must not collide on the wire
+                dcid = self._dc_ids.get(id(dc))
+                if dcid is None:
+                    dcid = self._dc_ids[id(dc)] = len(self._dc_ids)
+                wire = ("c", dcid, dc.data_key(*indices))
+                if home == self.myrank:
+                    datum = dc.data_of(*indices)
+                else:
+                    if not hasattr(dc, "tile_shape"):
+                        raise TypeError(
+                            f"{type(dc).__name__} lacks tile_shape(): "
+                            "distributed DTD needs it to shape the "
+                            "shadow buffer of a remote-owned tile")
+                    shape = dc.tile_shape(*indices)
+                    datum = new_data(
+                        np.zeros(shape, getattr(dc, "dtype", np.float32)),
+                        key=("shadow",) + wire)
+                t = DTDTile(datum, home_rank=home, wire_key=wire)
                 self._tiles[key] = t
+                self._tiles_by_wire[wire] = t
             return t
 
     def tile_new(self, shape: Tuple[int, ...], dtype: Any = np.float32,
-                 key: Any = None) -> DTDTile:
-        """A fresh unowned tile (reference: parsec_dtd_tile_new)."""
+                 key: Any = None, home_rank: int = 0) -> DTDTile:
+        """A fresh unowned tile (reference: parsec_dtd_tile_new).
+        Distributed pools must call this identically on every rank (SPMD
+        insertion); ``home_rank`` owns the final flushed value."""
         datum = new_data(np.zeros(shape, dtype), key=key)
-        t = DTDTile(datum)
+        wire = ("n", next(self._new_seq))
+        t = DTDTile(datum, home_rank=home_rank, wire_key=wire)
         with self._dep_lock:
             self._tiles[("new", id(datum))] = t
+            self._tiles_by_wire[wire] = t
         return t
 
     def data_flush_all(self) -> None:
@@ -261,7 +376,23 @@ class DTDTaskpool(Taskpool):
 
         def hook(es, task):
             reg = getattr(es.context, "device_registry", None)
-            dev = reg.best_device(task) if reg is not None else None
+            if reg is None:
+                return HookReturn.NEXT
+            dev = None
+            # an AFFINITY tile with a pinned device drives placement
+            # (reference: data-affinity first, parsec_get_best_device)
+            aff = getattr(task.dtd, "affinity", None) \
+                if task.dtd is not None else None
+            if aff is not None and not isinstance(aff, (int, np.integer)):
+                try:
+                    pref = self._as_tile(aff).data.preferred_device
+                except TypeError:
+                    pref = None
+                if pref is not None and 1 <= pref < len(reg.devices) \
+                        and reg.devices[pref].enabled:
+                    dev = reg.devices[pref]
+            if dev is None:
+                dev = reg.best_device(task)
             if dev is None:
                 return HookReturn.NEXT
             return dev.submit(es, task, spec)
@@ -269,23 +400,36 @@ class DTDTaskpool(Taskpool):
 
     # -- insertion ---------------------------------------------------------
     def insert_task(self, fn: Callable, *args, priority: int = 0,
-                    device: str = "cpu") -> Task:
+                    device: str = "cpu") -> Optional[Task]:
         """Insert one task; each arg is ``(value_or_tile, MODE)``
         (reference: parsec_dtd_insert_task, insert_function.c:3488).
 
         Tiles may be DTDTile, DataRef (``A(m, n)``), or Data.  VALUE args
         pass through; SCRATCH allocates a fresh buffer of the given shape.
+
+        Distributed pools insert SPMD: every rank calls insert_task with
+        the same stream of tasks; each task executes on ONE rank — the
+        AFFINITY arg's rank (an int, or a tile whose owner rank is used),
+        else the owner of its first written tile (owner computes).  Other
+        ranks track the task as a remote writer/reader only (reference:
+        insert_function.c:3014-3163 fake remote tasks).  Insertion must
+        come from a single thread per rank (the reference's main-thread
+        model).  Returns None for tasks placed on other ranks.
         """
         if self.context is None:
             raise RuntimeError(
                 "attach the DTD pool to a context before inserting")
+        rank = self._task_rank(args) if self.nranks > 1 else self.myrank
+        if rank != self.myrank:
+            self._insert_remote(args, rank)
+            return None
         modes = tuple(m for _, m in args)
         tc = self._class_for(fn, modes, device)
         names = tc.dtd_names
 
         task = Task(tc, self, {"tid": next(_seq)})
         task.priority = priority
-        state = _DTDState(task)
+        state = _DTDState(task, rank=self.myrank)
         task.dtd = state
 
         with self._window:
@@ -319,17 +463,174 @@ class DTDTaskpool(Taskpool):
                 raise TypeError(f"unsupported arg mode {mode!r}")
 
         self.termdet.taskpool_addto_nb_tasks(self, 1)
+        to_schedule: List[Task] = []
         with self._dep_lock:
             self._inflight += 1
             for tile, mode in tracked:
-                self._track(state, tile, mode)
+                self._track(state, tile, mode, to_schedule)
             # read under the lock: once released, a completing predecessor
             # may drive remaining to 0 and schedule the task itself —
             # checking outside would double-schedule
-            ready_now = state.remaining == 0
-        if ready_now:
-            scheduling.schedule(self.context.streams[0], [task])
+            if state.remaining == 0:
+                to_schedule.append(task)
+        if to_schedule:
+            scheduling.schedule(self.context.streams[0], to_schedule)
         return task
+
+    # -- distributed placement & remote tracking ---------------------------
+    def _task_rank(self, args) -> int:
+        """Execution rank of a task: AFFINITY wins (int rank or tile
+        owner), else the owner of the first written tile, else the first
+        read tile, else 0 — identical on every rank by construction."""
+        first = None
+        for value, mode in args:
+            if mode is AFFINITY:
+                if isinstance(value, (int, np.integer)):
+                    return int(value)
+                return self._as_tile(value).home_rank
+        for value, mode in args:
+            if mode in (OUTPUT, INOUT):
+                return self._as_tile(value).home_rank
+            if first is None and mode is INPUT:
+                first = self._as_tile(value)
+        return first.home_rank if first is not None else 0
+
+    def _insert_remote(self, args, rank: int) -> None:
+        """Track a task that executes on another rank: its reads of
+        locally-produced versions trigger payload sends; its writes insert
+        delivery surrogates so later local consumers chain correctly."""
+        reads: List[DTDTile] = []
+        writes: List[DTDTile] = []
+        for value, mode in args:
+            if mode in (INPUT, OUTPUT, INOUT):
+                tile = self._as_tile(value)
+                if mode in (INPUT, INOUT):
+                    reads.append(tile)
+                if mode in (OUTPUT, INOUT):
+                    writes.append(tile)
+        sends: List[Tuple[int, DTDTile, int]] = []
+        with self._dep_lock:
+            for tile in reads:
+                lw = tile.last_writer
+                if lw is None:
+                    # pristine home value: the owner forwards version 0
+                    if tile.home_rank == self.myrank \
+                            and rank != self.myrank \
+                            and rank not in tile.v0_sent:
+                        tile.v0_sent.add(rank)
+                        sends.append((rank, tile, 0))
+                elif not lw.is_recv and lw.rank == self.myrank:
+                    key = (rank, tile, tile.version)
+                    if key not in lw.remote_sends:
+                        # recorded either way so N readers on one rank
+                        # cost ONE payload on the wire
+                        lw.remote_sends.add(key)
+                        if lw.done:
+                            sends.append(key)
+                # lw on a third rank: that rank serves the payload
+            for tile in writes:
+                self._surrogate_write(tile)
+        for dst, tile, ver in sends:
+            self._send_payload(dst, tile, ver)
+
+    def _surrogate_write(self, tile: DTDTile) -> None:
+        """Advance the tile's version past a remote write, leaving a
+        delivery surrogate as last writer (caller holds _dep_lock)."""
+        tile.version += 1
+        d = _DTDState(None, rank=self.myrank)
+        d.is_recv = True
+        d.tile = tile
+        d.version = tile.version
+        for r in tile.readers:       # WAR: local readers finish first
+            self._edge(r, d)
+        lw = tile.last_writer        # WAW: order in-place datum writes
+        if lw is not None and (not lw.is_recv or lw.needed):
+            self._edge(lw, d)
+        tile.last_writer = d
+        tile.readers = []
+
+    @staticmethod
+    def _edge(pred: "_DTDState", succ: "_DTDState") -> None:
+        if pred is succ or pred.done:
+            return
+        pred.successors.append(succ)
+        succ.remaining += 1
+
+    def _mark_needed(self, d: "_DTDState",
+                     to_schedule: List[Task]) -> None:
+        """First local consumer of a surrogate's version: make it a real
+        (counted, schedulable) task expecting the network payload (caller
+        holds _dep_lock)."""
+        if d.needed or d.done:
+            return
+        d.needed = True
+        task = Task(self._recv_class(), self, {"tid": next(_seq)})
+        task.dtd = d
+        d.task = task
+        key = (d.tile.wire_key, d.version)
+        arr = self._received.pop(key, None)
+        if arr is not None:
+            d.payload = arr
+        else:
+            d.remaining += 1         # the payload arrival is a dependency
+            self._expected[key] = d
+        self.termdet.taskpool_addto_nb_tasks(self, 1)
+        self._inflight += 1
+        if d.remaining == 0:
+            to_schedule.append(task)
+
+    def _recv_class(self) -> TaskClass:
+        if self._recv_tc is None:
+            def _recv_hook(es, task):
+                st = task.dtd
+                if st.payload is not None:
+                    _apply_payload(st.tile.data, st.payload)
+                    st.payload = None
+                return None
+            tc = TaskClass("_dtd_recv", params=[("tid", None)], flows=[],
+                           incarnations=[("cpu", _recv_hook)])
+            self.add_task_class_dynamic(tc)
+            self._recv_tc = tc
+        return self._recv_tc
+
+    def _wire_msg(self, kind: str, tile: DTDTile, ver: int) -> dict:
+        """Encode a tile payload message (pulls the tile home first)."""
+        copy = tile.data.pull_to_host()
+        arr = np.asarray(copy.payload)
+        return {"tp": self.taskpool_id, "kind": kind,
+                "tile": tile.wire_key, "ver": ver, "buf": arr.tobytes(),
+                "dtype": arr.dtype.str, "shape": arr.shape}
+
+    def _send_payload(self, dst: int, tile: DTDTile, ver: int) -> None:
+        self.context.comm.dtd_send(dst, self._wire_msg("data", tile, ver))
+
+    def _dtd_incoming(self, src: int, msg: dict) -> None:
+        """Comm-thread entry for DTD payload/flush messages."""
+        arr = np.frombuffer(msg["buf"], dtype=np.dtype(msg["dtype"])) \
+            .reshape(msg["shape"]).copy()
+        wire = tuple(msg["tile"])
+        if msg["kind"] == "data":
+            key = (wire, msg["ver"])
+            to_schedule: List[Task] = []
+            with self._dep_lock:
+                d = self._expected.pop(key, None)
+                if d is None:
+                    self._received[key] = arr
+                else:
+                    d.payload = arr
+                    d.remaining -= 1
+                    if d.remaining == 0:
+                        to_schedule.append(d.task)
+            if to_schedule:
+                scheduling.schedule(self.context.streams[0], to_schedule)
+        elif msg["kind"] == "flush":
+            with self._dep_lock:
+                if not self._drained:
+                    self._flush_queue.append((wire, arr))
+                    return
+                tile = self._tiles_by_wire.get(wire)
+            if tile is not None:
+                _apply_payload(tile.data, arr)
 
     def _as_tile(self, value) -> DTDTile:
         if isinstance(value, DTDTile):
@@ -341,30 +642,49 @@ class DTDTaskpool(Taskpool):
             with self._dep_lock:
                 t = self._tiles.get(key)
                 if t is None:
-                    t = DTDTile(value)
+                    # raw Data has no owner rank: local-only tile
+                    t = DTDTile(value, home_rank=self.myrank,
+                                wire_key=("d", id(value)))
                     self._tiles[key] = t
                 return t
         raise TypeError(f"cannot interpret {value!r} as a tile")
 
-    def _track(self, state: _DTDState, tile: DTDTile, mode: _Mode) -> None:
+    def _track(self, state: _DTDState, tile: DTDTile, mode: _Mode,
+               to_schedule: List[Task]) -> None:
         """Register RAW/WAR/WAW edges against the tile's history (caller
         holds _dep_lock; reference: set_dependencies_for_function +
-        parsec_dtd_ordering_correctly)."""
-        def depend_on(pred: _DTDState):
-            if pred is state or pred.done:
-                return
-            pred.successors.append(state)
-            state.remaining += 1
-
+        parsec_dtd_ordering_correctly).  Versions produced on other ranks
+        appear as delivery surrogates; consuming one marks it needed."""
+        me = self.myrank
+        lw = tile.last_writer
         if mode is INPUT:
-            if tile.last_writer is not None:
-                depend_on(tile.last_writer)        # RAW
+            if lw is None and tile.home_rank != me and self.nranks > 1:
+                # pristine remote-home value: pull version 0
+                d = _DTDState(None, rank=me)
+                d.is_recv, d.tile, d.version = True, tile, 0
+                tile.last_writer = lw = d
+            if lw is not None:
+                if lw.is_recv and not lw.done:
+                    self._mark_needed(lw, to_schedule)
+                self._edge(lw, state)              # RAW
             tile.readers.append(state)
         else:  # OUTPUT / INOUT: this task becomes the tile's writer
             for r in tile.readers:                 # WAR
-                depend_on(r)
-            if tile.last_writer is not None:       # WAW (+ RAW for INOUT)
-                depend_on(tile.last_writer)
+                self._edge(r, state)
+            if lw is None and mode is INOUT and tile.home_rank != me \
+                    and self.nranks > 1:
+                d = _DTDState(None, rank=me)
+                d.is_recv, d.tile, d.version = True, tile, 0
+                tile.last_writer = lw = d
+            if lw is not None:                     # WAW (+ RAW for INOUT)
+                if lw.is_recv:
+                    if mode is INOUT and not lw.done:
+                        self._mark_needed(lw, to_schedule)
+                    if lw.needed:   # unneeded surrogates never run: no
+                        self._edge(lw, state)      # in-place write to order
+                else:
+                    self._edge(lw, state)
+            tile.version += 1
             tile.last_writer = state
             tile.readers = []
 
@@ -375,15 +695,29 @@ class DTDTaskpool(Taskpool):
             return []
         grapher = self.context.grapher if self.context else None
         ready: List[Task] = []
+        outgoing: List[Tuple[int, dict]] = []
         with self._window:
             state.done = True
             self._inflight -= 1
+            sends = sorted(state.remote_sends, key=lambda e: (e[0], e[2]))
+        # Encode outside the pool lock — a 64MB D2H pull under _dep_lock
+        # would stall the insertion and comm threads — but BEFORE the
+        # successor decrements below: the next writer of these tiles is a
+        # successor and cannot run until then, so the datum is stable
+        # (reference: delayed dep release + per-peer sends,
+        # remote_dep_mpi.c:519).
+        for dst, tile, ver in sends:
+            outgoing.append((dst, self._wire_msg("data", tile, ver)))
+        with self._window:
             for succ in state.successors:
-                if grapher is not None:
+                if grapher is not None and succ.task is not None:
                     grapher.edge(task, succ.task.key, "dtd")
                 succ.remaining -= 1
-                if succ.remaining == 0:
+                if succ.remaining == 0 and succ.task is not None \
+                        and (not succ.is_recv or succ.needed):
                     ready.append(succ.task)
             if self._inflight < self.threshold:
                 self._window.notify_all()
+        for dst, msg in outgoing:
+            self.context.comm.dtd_send(dst, msg)
         return ready

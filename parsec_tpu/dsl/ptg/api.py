@@ -135,9 +135,14 @@ def _to_core_end(e: Union[_End, Callable], is_input: bool):
         return FromDesc(e.ref) if is_input else ToDesc(e.ref)
     if isinstance(e, NEW):
         if not is_input:
-            raise ValueError("NEW is only valid on inputs")
+            # reference diagnostic (ptgpp output_NEW*.jdf golden cases)
+            raise ValueError("Automatic data allocation with NEW only "
+                             "supported in IN dependencies.")
         return New(e.arena)
     if isinstance(e, NULL_END) or e is NULL_END:
+        if not is_input:
+            # reference diagnostic (ptgpp output_NULL*.jdf golden cases)
+            raise ValueError("NULL data only supported in IN dependencies.")
         return Null()
     if callable(e):   # bare lambda returning a DataRef == DATA shorthand
         return _to_core_end(DATA(e), is_input)
@@ -198,6 +203,7 @@ class TaskBuilder:
                 raise TypeError(f"param {pname}: expected Range or callable")
         self._affinity = None
         self._priority = None
+        self._key_fn = None
         self._flows: List[Flow] = []
         self._incarnations: List = []
         self._properties: Dict[str, Any] = {}
@@ -209,6 +215,14 @@ class TaskBuilder:
 
     def priority(self, fn: Callable) -> "TaskBuilder":
         self._priority = _named(fn)
+        return self
+
+    def make_key(self, fn: Callable) -> "TaskBuilder":
+        """User-defined task key (reference: the ``[make_key_fn = ...]``
+        task-class property, user-defined-functions/udf.jdf:46): ``fn``
+        maps the task's named parameters to any hashable key, replacing
+        the default parameter tuple in dep tracking and the repo."""
+        self._key_fn = _named(fn)
         return self
 
     def flow(self, name: str, mode: str, *deps: Union[IN, OUT]) -> "TaskBuilder":
@@ -247,8 +261,9 @@ class TaskBuilder:
                     kwargs[n] = None if copy is None else copy.payload
                 elif n in task.locals:
                     kwargs[n] = task.locals[n]
-                else:
-                    kwargs[n] = self._ptg.globals_.get(n)
+                elif n in self._ptg.globals_:
+                    kwargs[n] = self._ptg.globals_[n]
+                # else: the parameter's own default (capture idiom) applies
             ret = fn(**kwargs)
             # Functional bodies return the new written-flow values (same
             # convention as device kernels); in-place bodies return None.
@@ -291,7 +306,8 @@ class TaskBuilder:
         return TaskClass(
             self.name, params=self._params, affinity=self._affinity,
             flows=self._flows, incarnations=self._incarnations,
-            priority=self._priority, properties=self._properties)
+            priority=self._priority, properties=self._properties,
+            key_fn=self._key_fn)
 
 
 class PTG:

@@ -188,3 +188,30 @@ def test_fanout_shared_reshape_single_conversion():
         ctx.wait(timeout=30)
     assert seen == [bf16, bf16]
     assert tp.reshape.conversions == 1
+
+
+def test_out_dtt_dtype_only_lands_in_collection():
+    """A dtype-only OUT dtt: the body's bf16 result must be cast home to
+    the f32 collection — regression for the early-return that left the
+    collection holding the stale pre-task value (reference: the remote/
+    local writeback reshape paths of parsec_reshape.c)."""
+    import ml_dtypes
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG
+
+    V = VectorTwoDimCyclic(mb=4, lm=4)
+    V.data_of(0).copy_on(0).payload[:] = 2.0
+    p = PTG("outdtt")
+    p.task("T") \
+        .affinity(lambda V=V: V(0)) \
+        .flow("X", "RW",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(DATA(lambda V=V: V(0)),
+                  dtt=Dtt(dtype=ml_dtypes.bfloat16))) \
+        .body(lambda X: (np.asarray(X) * 3.0).astype(ml_dtypes.bfloat16))
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=60)
+    got = np.asarray(V.data_of(0).pull_to_host().payload)
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, 6.0)
