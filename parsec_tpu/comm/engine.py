@@ -41,6 +41,7 @@ TAG_GET_REP = 3
 TAG_TERMDET = 4
 TAG_BARRIER = 5
 TAG_DTD = 6       # distributed DTD data/flush traffic
+TAG_BATCH = 7     # aggregated same-destination messages [(tag, payload)...]
 TAG_USER = 16     # first tag available to applications
 
 _LEN = struct.Struct("!IQ")   # (tag, payload length)

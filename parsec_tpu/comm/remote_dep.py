@@ -27,13 +27,15 @@ anywhere, and TERMINATE is broadcast.
 from __future__ import annotations
 
 import itertools
+import queue as queue_mod
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from parsec_tpu.comm.engine import (CommEngine, TAG_ACTIVATE, TAG_DTD,
-                                    TAG_GET_REP, TAG_GET_REQ, TAG_TERMDET)
+from parsec_tpu.comm.engine import (CommEngine, TAG_ACTIVATE, TAG_BATCH,
+                                    TAG_DTD, TAG_GET_REP, TAG_GET_REQ,
+                                    TAG_TERMDET)
 from parsec_tpu.core import scheduling
 from parsec_tpu.core.engine import deliver_dep
 from parsec_tpu.utils.mca import params
@@ -94,15 +96,97 @@ class RemoteDepEngine:
         self._app_recv = 0
         self._retry_pending = False
         ce.on_error = self._on_handler_error
-        ce.tag_register(TAG_ACTIVATE, self._activate_cb)
-        ce.tag_register(TAG_GET_REQ, self._get_req_cb)
-        ce.tag_register(TAG_GET_REP, self._get_rep_cb)
+        # Funnelled progress: socket recv threads only ENQUEUE; one
+        # dedicated comm-progress thread runs the dep-engine work and
+        # drains sends with per-peer aggregation (reference: the comm
+        # thread + dep_cmd_queue, remote_dep_mpi.c:461-503 — a slow dep
+        # resolution must not head-of-line-block a peer's recv socket,
+        # and GET replies must not serialize payloads on the request
+        # path).
+        self._cmdq: "queue_mod.Queue" = queue_mod.Queue()
+        self._stop = False
+        ce.tag_register(TAG_ACTIVATE, self._enq_cb("activate"))
+        ce.tag_register(TAG_GET_REQ, self._enq_cb("get_req"))
+        ce.tag_register(TAG_GET_REP, self._enq_cb("get_rep"))
         ce.tag_register(TAG_TERMDET, self._termdet_cb)
-        ce.tag_register(TAG_DTD, self._dtd_cb)
+        ce.tag_register(TAG_DTD, self._enq_cb("dtd"))
+        ce.tag_register(TAG_BATCH, self._batch_cb)
         #: pending GET completions: handle -> (tp_id, deliveries)
         self._pending_gets: Dict[Tuple[int, int], dict] = {}
         #: DTD messages that raced their pool's registration on this rank
         self._dtd_backlog: Dict[int, List] = {}
+        self._recv_handlers = {
+            "activate": self._activate_cb,
+            "get_req": self._get_req_cb,
+            "get_rep": self._get_rep_cb,
+            "dtd": self._dtd_cb,
+        }
+        self._progress = threading.Thread(
+            target=self._progress_loop, name=f"parsec-comm-{self.rank}",
+            daemon=True)
+        self._progress.start()
+
+    # ------------------------------------------------------------------
+    # funnelled comm progress (reference: remote_dep_dequeue_main)
+    # ------------------------------------------------------------------
+    def _enq_cb(self, kind: str):
+        def cb(src: int, msg: Any) -> None:
+            self._cmdq.put(("recv", kind, src, msg))
+        return cb
+
+    def _batch_cb(self, src: int, msgs: List) -> None:
+        """Unpack an aggregated frame into individual commands."""
+        for tag, payload in msgs:
+            self.ce.recv_msgs += 1   # each inner message counts
+            self.ce._dispatch(tag, src, payload)
+
+    def memcpy_shift(self, dst_copy, src_copy) -> None:
+        """Thread-shift a local payload copy onto the comm-progress
+        thread (reference: parsec_remote_dep_memcpy's short-circuit,
+        remote_dep_mpi.c:557 — local reshape copies ride the comm thread
+        so workers never block on memcpy)."""
+        self._cmdq.put(("memcpy", dst_copy, src_copy))
+
+    def _progress_loop(self) -> None:
+        while not self._stop:
+            try:
+                cmd = self._cmdq.get(timeout=0.05)
+            except queue_mod.Empty:
+                continue
+            batch = [cmd]
+            while True:
+                try:
+                    batch.append(self._cmdq.get_nowait())
+                except queue_mod.Empty:
+                    break
+            #: per-destination send aggregation: consecutive outbound
+            #: messages to one peer ride ONE wire frame
+            sends: Dict[int, List[Tuple[int, Any]]] = {}
+            for cmd in batch:
+                try:
+                    if cmd[0] == "send":
+                        _, tag, dst, payload = cmd
+                        sends.setdefault(dst, []).append((tag, payload))
+                    elif cmd[0] == "recv":
+                        _, kind, src, msg = cmd
+                        self._recv_handlers[kind](src, msg)
+                    elif cmd[0] == "memcpy":
+                        _, dst_copy, src_copy = cmd
+                        np.copyto(np.asarray(dst_copy.payload),
+                                  np.asarray(src_copy.payload))
+                except Exception as exc:
+                    self._on_handler_error(exc)
+            for dst, msgs in sends.items():
+                try:
+                    if len(msgs) == 1:
+                        self.ce.send_am(msgs[0][0], dst, msgs[0][1])
+                    else:
+                        self.ce.send_am(TAG_BATCH, dst, msgs)
+                        # the BATCH frame carried len(msgs) app messages
+                        # in one send; the counters already accounted
+                        # each at enqueue time
+                except Exception as exc:
+                    self._on_handler_error(exc)
 
     def _on_handler_error(self, exc: Exception) -> None:
         self.context.record_error(exc, None)
@@ -196,11 +280,15 @@ class RemoteDepEngine:
             self._send_app(TAG_ACTIVATE, child, msg)
 
     def _send_app(self, tag: int, dst: int, payload) -> None:
-        """Application-message send: counted and blackening (Safra)."""
+        """Application-message send: counted and blackening (Safra),
+        funnelled through the comm-progress thread which aggregates
+        per-peer (reference: remote_dep_dequeue_send, the payload was
+        already serialized by the caller so worker threads never block
+        on the socket)."""
         with self._term_lock:
             self._color_black = True
             self._app_sent += 1
-        self.ce.send_am(tag, dst, payload)
+        self._cmdq.put(("send", tag, dst, payload))
 
     # ------------------------------------------------------------------
     # receiver side
@@ -369,7 +457,7 @@ class RemoteDepEngine:
         with self._dlock:
             if self._delayed or self._dtd_backlog:
                 return False
-        if self._pending_gets:
+        if self._pending_gets or not self._cmdq.empty():
             return False
         with ctx._lock:
             return ctx._active_taskpools == 0
@@ -443,4 +531,6 @@ class RemoteDepEngine:
         self._terminated.clear()
 
     def fini(self) -> None:
+        self._stop = True
+        self._progress.join(timeout=5)
         self.ce.fini()

@@ -107,11 +107,30 @@ class Context:
             scheduler or (params.get("sched", "") or None))
         self.scheduler.install(self)
 
-        self.streams = [ExecutionStream(self, i) for i in range(self.nb_cores)]
+        # VP map: streams -> virtual processes (+ optional core binding)
+        # (reference: vpmap_init_* + thread binding, parsec.c:543-583,:861)
+        from parsec_tpu.core.vpmap import VPMap
+        self.vpmap = VPMap.from_mca(self.nb_cores)
+        self.streams = [ExecutionStream(self, i,
+                                        vp_id=self.vpmap.vp_of(i))
+                        for i in range(self.nb_cores)]
         for es in self.streams:
             self.scheduler.flow_init(es)
+        bind = bool(int(params.get("runtime_bind_threads", 0)))
+
+        def run_worker(es):
+            if bind:
+                import os as _os
+                from parsec_tpu.core.vpmap import bind_current_thread
+                core = self.vpmap.core_of(es.th_id)
+                if core is None:   # flat/parameter maps carry no cores:
+                    # synthesize the documented round-robin placement
+                    core = es.th_id % (_os.cpu_count() or 1)
+                bind_current_thread(core)
+            scheduling.worker_loop(es)
+
         self._threads = [
-            threading.Thread(target=scheduling.worker_loop, args=(es,),
+            threading.Thread(target=run_worker, args=(es,),
                              name=f"parsec-worker-{es.th_id}", daemon=True)
             for es in self.streams]
         for t in self._threads:

@@ -23,6 +23,7 @@ from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency, Data,
 from parsec_tpu.data.reshape import as_dtt, convert, needs_reshape
 from parsec_tpu.core.task import (Dep, Flow, FromDesc, FromTask, New, Null,
                                   Task, TaskClass, ToDesc, ToTask)
+from parsec_tpu.utils.mempool import MemoryPool
 from parsec_tpu.utils.output import warning
 
 import numpy as np
@@ -42,6 +43,24 @@ class PendingRecord:
         self.locals = locals_
 
 
+def _rec_reset(rec: PendingRecord) -> None:
+    # drop references only: the Task constructed at readiness ALIASES
+    # rec.locals and copied the inputs/sources entries — clearing these
+    # slots must not clear the dicts themselves
+    rec.expected = 0
+    rec.arrivals = 0
+    rec.inputs = {}
+    rec.sources = {}
+    rec.locals = None
+
+
+#: hot-path record pool (reference: the task/dep mempools of
+#: parsec/mempool.c — one countdown record is allocated per not-yet-ready
+#: task instance and freed the moment the task becomes ready)
+_rec_pool = MemoryPool(factory=lambda: PendingRecord(0, None),
+                       reset=_rec_reset)
+
+
 def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
                 flow_name: str, copy: Optional[DataCopy],
                 source: Optional[Tuple[TaskClass, Tuple]]) -> Optional[Task]:
@@ -51,8 +70,9 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
 
     def fn(rec):
         if rec is None:
-            rec = PendingRecord(succ_tc.nb_task_inputs(succ_locals),
-                                dict(succ_locals))
+            rec = _rec_pool.alloc()
+            rec.expected = succ_tc.nb_task_inputs(succ_locals)
+            rec.locals = dict(succ_locals)
         rec.arrivals += 1
         if copy is not None and rec.inputs.get(flow_name) is not None:
             # JDF forbids data gathers: a data flow has exactly one source
@@ -74,6 +94,7 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
     task.pinned_flows.update(k for k, v in rec.inputs.items()
                              if v is not None)
     task.input_sources.update(rec.sources)
+    _rec_pool.release(rec)
     return task
 
 

@@ -1,0 +1,102 @@
+"""Virtual-process map and thread placement.
+
+Rebuild of the reference's vpmap + hwloc binding pair (reference:
+parsec/vpmap.{c,h} — #VPs, threads per VP, core affinities, initialized
+from flat/parameters/hardware — and parsec_hwloc.c/bindthread.c thread->
+core binding).  A virtual process (VP) groups execution streams that
+share a scheduler domain (per-VP queues in llp/ap, NUMA islands in the
+reference); on this platform topology discovery is os-level (no hwloc):
+``from_hardware`` splits the streams across the machine's cores, and
+binding uses ``os.sched_setaffinity`` where the OS provides it.
+
+MCA: ``--mca vpmap flat`` (default, one VP), ``--mca vpmap 2:4`` (2 VPs
+x 4 streams), ``--mca vpmap hw``; ``--mca runtime_bind_threads 1`` pins
+each worker to a core round-robin.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import debug_verbose, warning
+
+params.register("vpmap", "flat",
+                "virtual-process map: flat | <nvp>:<threads_per_vp> | hw")
+params.register("runtime_bind_threads", 0,
+                "bind worker threads to cores round-robin (Linux only)")
+
+
+class VPMap:
+    """Stream -> (vp, core) placement (reference: vpmap.h:45-68)."""
+
+    def __init__(self, nb_threads: int, vp_of: List[int],
+                 core_of: Optional[List[Optional[int]]] = None):
+        self.nb_threads = nb_threads
+        self._vp_of = vp_of
+        self._core_of = core_of or [None] * nb_threads
+        self.nb_vps = (max(vp_of) + 1) if vp_of else 1
+
+    # -- constructors (reference: vpmap_init_from_*) ----------------------
+    @classmethod
+    def from_flat(cls, nb_threads: int) -> "VPMap":
+        """One VP holding every stream (reference: vpmap_init_from_flat)."""
+        return cls(nb_threads, [0] * nb_threads)
+
+    @classmethod
+    def from_parameters(cls, spec: str, nb_threads: int) -> "VPMap":
+        """``<nvp>:<threads_per_vp>`` (reference:
+        vpmap_init_from_parameters)."""
+        try:
+            nvp_s, tpv_s = spec.split(":")
+            nvp, tpv = max(1, int(nvp_s)), max(1, int(tpv_s))
+        except ValueError:
+            warning("vpmap %r unparseable; falling back to flat", spec)
+            return cls.from_flat(nb_threads)
+        return cls(nb_threads, [min(i // tpv, nvp - 1)
+                                for i in range(nb_threads)])
+
+    @classmethod
+    def from_hardware(cls, nb_threads: int) -> "VPMap":
+        """Split streams over the visible cores (reference:
+        vpmap_init_from_hardware_affinity; without hwloc the 'socket'
+        granularity degenerates to one VP per contiguous core block)."""
+        ncores = os.cpu_count() or 1
+        per_vp = max(1, ncores // max(1, min(nb_threads, ncores)))
+        cores = list(range(ncores))
+        return cls(nb_threads,
+                   [min(i // per_vp, ncores - 1) for i in range(nb_threads)],
+                   [cores[i % ncores] for i in range(nb_threads)])
+
+    @classmethod
+    def from_mca(cls, nb_threads: int) -> "VPMap":
+        spec = str(params.get("vpmap", "flat"))
+        if spec == "hw":
+            return cls.from_hardware(nb_threads)
+        if ":" in spec:
+            return cls.from_parameters(spec, nb_threads)
+        return cls.from_flat(nb_threads)
+
+    # -- queries (reference: vpmap_get_*) ----------------------------------
+    def vp_of(self, th_id: int) -> int:
+        return self._vp_of[th_id] if th_id < len(self._vp_of) else 0
+
+    def core_of(self, th_id: int) -> Optional[int]:
+        return self._core_of[th_id] if th_id < len(self._core_of) else None
+
+    def threads_of_vp(self, vp: int) -> List[int]:
+        return [i for i, v in enumerate(self._vp_of) if v == vp]
+
+
+def bind_current_thread(core: Optional[int]) -> bool:
+    """Pin the calling thread to ``core`` (reference: parsec_bindthread).
+    Returns True on success; silently no-ops where unsupported."""
+    if core is None or not hasattr(os, "sched_setaffinity"):
+        return False
+    try:
+        os.sched_setaffinity(0, {core})
+        debug_verbose(7, "bound thread to core %d", core)
+        return True
+    except OSError:
+        return False

@@ -156,11 +156,20 @@ class XlaDevice(Device):
         cap_mb = int(params.get("device_mem_mb", 0))
         self._capacity = cap_mb * (1 << 20) if cap_mb > 0 else None
         self._bytes_used = 0
-        #: datum-id -> (weakref to device copy, nbytes); insertion order =
-        #: LRU order.  Weak so per-task temporaries (NEW-flow datums) do
-        #: not accumulate here forever — a finalizer drops the accounting
-        #: when the copy dies with its datum.
-        self._lru: "OrderedDict[int, Tuple[Any, int]]" = OrderedDict()
+        #: segment ledger over the HBM budget (reference: the GPU slab
+        #: zone_malloc, utils/zone_malloc.c — XLA owns physical HBM, so
+        #: the zone tracks logical segments to drive eviction exactly
+        #: where the reference drove cudaMalloc'd slabs)
+        if self._capacity is not None:
+            from parsec_tpu.utils.zone_alloc import ZoneAllocator
+            self._zone = ZoneAllocator(self._capacity)
+        else:
+            self._zone = None
+        #: datum-id -> (weakref to device copy, nbytes, zone offset);
+        #: insertion order = LRU order.  Weak so per-task temporaries
+        #: (NEW-flow datums) do not accumulate here forever — a finalizer
+        #: drops the accounting when the copy dies with its datum.
+        self._lru: "OrderedDict[int, Tuple[Any, int, Any]]" = OrderedDict()
         self._pins: Dict[int, int] = {}
         self._mem_lock = threading.Lock()
 
@@ -296,7 +305,7 @@ class XlaDevice(Device):
             from parsec_tpu.data.data import Data
             payload = copy.payload
             nbytes = getattr(payload, "nbytes", 0)
-            self._reserve(nbytes)
+            off = self._reserve(nbytes)
             if self._on_this_device(payload):
                 import jax.numpy as jnp
                 staged = jnp.array(payload, copy=True)
@@ -311,7 +320,7 @@ class XlaDevice(Device):
                                   coherency=Coherency.SHARED,
                                   version=copy.version)
             self.stats.bytes_in += nbytes
-            self._account(snap, dc, nbytes)
+            self._account(snap, dc, nbytes, off)
             return dc
         dc = datum.copy_on(self.space)
         fresh = dc is None
@@ -321,7 +330,7 @@ class XlaDevice(Device):
         if src is not None or dc.payload is None:
             payload = src.payload if src is not None else copy.payload
             nbytes = getattr(payload, "nbytes", 0)
-            self._reserve(nbytes)
+            off = self._reserve(nbytes)
             if self._on_this_device(payload):
                 # already resident (copy-on-write alias): device_put would
                 # be a no-op sharing the buffer, which donation/in-place
@@ -338,7 +347,11 @@ class XlaDevice(Device):
             dc.version = src.version if src is not None else copy.version
             self.stats.bytes_in += nbytes
             if fresh:
-                self._account(datum, dc, nbytes)
+                self._account(datum, dc, nbytes, off)
+            else:
+                # re-staged into an existing (previously accounted) copy:
+                # the fresh segment claim is surplus
+                self._zone_free(off)
         if copy.flags & FLAG_COW and copy is not dc:
             # The COW alias's payload aliases the producer's buffer (for
             # DATA-fed fan-outs: the collection's backing array).  The
@@ -421,7 +434,17 @@ class XlaDevice(Device):
             with self._cond:
                 if not self._retire:
                     return
-                if self._outputs_ready(self._retire[-1]):
+                newest = self._retire[-1]
+            # probe OUTSIDE the lock: is_ready() is a full RPC round trip
+            # on tunneled TPUs, and submit()/manager/sync all contend on
+            # _cond
+            newest_ready = self._outputs_ready(newest)
+            with self._cond:
+                if not self._retire:
+                    return
+                if self._retire[-1] is not newest:
+                    continue   # the list moved on; re-probe
+                if newest_ready:
                     batch = list(self._retire)
                     self._retire.clear()
                 elif len(self._retire) > max_unfinalized:
@@ -531,10 +554,11 @@ class XlaDevice(Device):
             if id(datum) in self._lru:
                 self._lru.move_to_end(id(datum))
 
-    def _account(self, datum, dc: DataCopy, nbytes: int) -> None:
+    def _account(self, datum, dc: DataCopy, nbytes: int,
+                 offset: Any = None) -> None:
         key = id(datum)
         with self._mem_lock:
-            self._lru[key] = (weakref.ref(dc), nbytes)
+            self._lru[key] = (weakref.ref(dc), nbytes, offset)
             self._bytes_used += nbytes
         weakref.finalize(dc, self._forget, key, nbytes)
 
@@ -549,28 +573,56 @@ class XlaDevice(Device):
             if ent is not None and ent[0]() is None:
                 self._lru.pop(key)
                 self._bytes_used -= ent[1]
+                self._zone_free(ent[2])
 
-    def _reserve(self, nbytes: int) -> None:
-        """Evict LRU unpinned copies until ``nbytes`` fit (reference:
-        parsec_gpu_data_reserve_device_space, device_cuda_module.c:864)."""
-        if self._capacity is None:
-            return
-        with self._mem_lock:
-            if self._bytes_used + nbytes <= self._capacity:
-                return
-            for key in list(self._lru.keys()):
-                if self._bytes_used + nbytes <= self._capacity:
-                    break
-                if self._pins.get(key, 0) > 0:
-                    continue
-                dcref, sz = self._lru.pop(key)
-                dc = dcref()
-                if dc is None:
-                    self._bytes_used -= sz
-                    continue
-                self._evict(dc.data, dc, sz)
+    def _zone_free(self, offset: Any) -> None:
+        if self._zone is not None and offset is not None:
+            self._zone.free(offset)
 
-    def _evict(self, datum, dc: DataCopy, nbytes: int) -> None:
+    def _reserve(self, nbytes: int) -> Any:
+        """Claim a segment of the HBM budget, evicting LRU unpinned
+        copies until it fits (reference:
+        parsec_gpu_data_reserve_device_space, device_cuda_module.c:864,
+        over the zone_malloc slab).  Returns the zone offset (None when
+        the budget is unlimited); the caller threads it into _account or
+        releases it via _zone_free if the copy turns out not to be
+        fresh."""
+        if self._zone is None:
+            return None
+        import time as _time
+        deadline = _time.monotonic() + 30.0
+        while True:
+            with self._mem_lock:
+                while True:
+                    off = self._zone.malloc(nbytes)
+                    if off is not None:
+                        return off
+                    victim = None
+                    for key in self._lru.keys():
+                        if self._pins.get(key, 0) <= 0:
+                            victim = key
+                            break
+                    if victim is None:
+                        break   # all pinned right now: wait outside
+                    dcref, sz, voff = self._lru.pop(victim)
+                    dc = dcref()
+                    if dc is None:
+                        self._bytes_used -= sz
+                        self._zone_free(voff)
+                        continue
+                    self._evict(dc.data, dc, sz, voff)
+            # every resident copy is transiently pinned by in-flight
+            # tasks: wait for a finalization to unpin instead of failing
+            # (the reference requeues, HOOK_RETURN_AGAIN, rather than
+            # aborting)
+            if _time.monotonic() > deadline:
+                raise MemoryError(
+                    f"device {self.name}: {nbytes} bytes exceed the HBM "
+                    f"budget and every resident copy stayed pinned")
+            _time.sleep(0.001)
+
+    def _evict(self, datum, dc: DataCopy, nbytes: int,
+               offset: Any = None) -> None:
         """Write back if authoritative, then drop (caller holds _mem_lock)."""
         if dc.coherency in (Coherency.OWNED, Coherency.EXCLUSIVE) and \
                 dc.version >= datum.newest_version():
@@ -579,6 +631,7 @@ class XlaDevice(Device):
         dc.payload = None
         dc.coherency = Coherency.INVALID
         self._bytes_used -= nbytes
+        self._zone_free(offset)
         self.stats.evictions += 1
 
     def _writeback_host(self, datum, dc: DataCopy) -> None:
@@ -596,7 +649,7 @@ class XlaDevice(Device):
         quiescent point, so replaced host payloads re-link into their
         collection's user-visible backing storage."""
         with self._mem_lock:
-            entries = [ref() for ref, _ in self._lru.values()]
+            entries = [ref() for ref, _sz, _off in self._lru.values()]
         for dc in entries:
             if dc is None:
                 continue

@@ -696,18 +696,25 @@ class DTDTaskpool(Taskpool):
         grapher = self.context.grapher if self.context else None
         ready: List[Task] = []
         outgoing: List[Tuple[int, dict]] = []
-        with self._window:
-            state.done = True
-            self._inflight -= 1
-            sends = sorted(state.remote_sends, key=lambda e: (e[0], e[2]))
-        # Encode outside the pool lock — a 64MB D2H pull under _dep_lock
-        # would stall the insertion and comm threads — but BEFORE the
-        # successor decrements below: the next writer of these tiles is a
-        # successor and cannot run until then, so the datum is stable
-        # (reference: delayed dep release + per-peer sends,
-        # remote_dep_mpi.c:519).
-        for dst, tile, ver in sends:
-            outgoing.append((dst, self._wire_msg("data", tile, ver)))
+        # Encode payloads outside the pool lock — a 64MB D2H pull under
+        # _dep_lock would stall the insertion and comm threads — but
+        # BEFORE marking the task done: a later writer inserted while we
+        # encode still takes an edge on us (done tasks are skipped by
+        # _edge) and cannot run until the successor decrements below, so
+        # the datum is stable.  Readers inserted mid-encode append to
+        # remote_sends, hence the delta loop (reference: delayed dep
+        # release + per-peer sends, remote_dep_mpi.c:519).
+        encoded: set = set()
+        while True:
+            with self._window:
+                delta = [e for e in state.remote_sends if e not in encoded]
+                if not delta:
+                    state.done = True
+                    self._inflight -= 1
+                    break
+            for dst, tile, ver in sorted(delta, key=lambda e: (e[0], e[2])):
+                outgoing.append((dst, self._wire_msg("data", tile, ver)))
+                encoded.add((dst, tile, ver))
         with self._window:
             for succ in state.successors:
                 if grapher is not None and succ.task is not None:

@@ -38,6 +38,11 @@ class _PerStream(Scheduler):
         super().install(context)
         self._locals = {}
         self._system = Dequeue()
+        # stats (reference: the display_stats hook, sched.h:299)
+        self._n_local = 0
+        self._n_steal = 0
+        self._n_system = 0
+        self._n_overflow = 0
 
     def _defer(self, tasks, distance) -> bool:
         if distance > 0:
@@ -56,6 +61,13 @@ class _PerStream(Scheduler):
         me = ids.index(es.th_id) if es.th_id in ids else 0
         return [self._locals[ids[(me + i) % len(ids)]]
                 for i in range(1, len(ids))]
+
+    def display_stats(self, es) -> str:
+        """reference: per-module queue/steal statistics (sched.h:299)."""
+        return (f"{self.name}: local={self._n_local} "
+                f"steals={self._n_steal} system={self._n_system} "
+                f"overflow={self._n_overflow} "
+                f"system_pending={len(self._system)}")
 
 
 class LocalLifo(_PerStream):
@@ -79,12 +91,17 @@ class LocalLifo(_PerStream):
         if q is not None:
             t = q.pop()
             if t is not None:
+                self._n_local += 1
                 return t
         for other in self._steal_order(es):
             t = other.pop()
             if t is not None:
+                self._n_steal += 1
                 return t
-        return self._system.pop_front()
+        t = self._system.pop_front()
+        if t is not None:
+            self._n_system += 1
+        return t
 
 
 class LocalFlatQueues(_PerStream):
@@ -106,6 +123,7 @@ class LocalFlatQueues(_PerStream):
             if len(q) < cap:
                 q.push_back(t)
             else:
+                self._n_overflow += 1
                 self._system.push_back(t)   # hbbuffer overflow to parent
 
     def select(self, es):
@@ -113,12 +131,17 @@ class LocalFlatQueues(_PerStream):
         if q is not None:
             t = q.pop_front()
             if t is not None:
+                self._n_local += 1
                 return t
         for other in self._steal_order(es):
             t = other.pop_back()            # steal the cold end
             if t is not None:
+                self._n_steal += 1
                 return t
-        return self._system.pop_front()
+        t = self._system.pop_front()
+        if t is not None:
+            self._n_system += 1
+        return t
 
 
 class PriorityBasedQueues(_PerStream):
@@ -142,12 +165,17 @@ class PriorityBasedQueues(_PerStream):
         if q is not None:
             t = q.pop_front()
             if t is not None:
+                self._n_local += 1
                 return t
         for other in self._steal_order(es):
             t = other.pop_back()            # steal lowest-priority end
             if t is not None:
+                self._n_steal += 1
                 return t
-        return self._system.pop_front()
+        t = self._system.pop_front()
+        if t is not None:
+            self._n_system += 1
+        return t
 
 
 class _HeapLocal:
@@ -189,22 +217,180 @@ class LocalTreeQueues(_PerStream):
         if q is not None:
             t = q.pop()
             if t is not None:
+                self._n_local += 1
                 return t
         for other in self._steal_order(es):
             t = other.pop()
             if t is not None:
+                self._n_steal += 1
                 return t
-        return self._system.pop_front()
+        t = self._system.pop_front()
+        if t is not None:
+            self._n_system += 1
+        return t
 
 
-class LocalHierQueues(LocalFlatQueues):
-    """lhq: hierarchical local queues; with a flat topology behaves as lfq
-    with deeper overflow (reference: sched_lhq_module.c)."""
+params.register("sched_lhq_group_size", 2,
+                "streams per intermediate hierarchy level in lhq")
 
 
-class LifoLocalPrio(LocalTreeQueues):
-    """llp: per-VP LIFO of priority heaps; degenerates to ltq on one VP
-    (reference: sched_llp_module.c)."""
+class LocalHierQueues(_PerStream):
+    """lhq: HIERARCHICAL local queues (reference: sched_lhq_module.c —
+    hbbuffers chained per topology level).  Without hwloc the levels are
+    synthesized from stream ids: per-stream bounded buffer -> per-GROUP
+    shared buffer (``sched_lhq_group_size`` streams) -> system queue.
+    Overflow walks up the chain; selection walks it down before stealing
+    from sibling streams of the same group, then other groups."""
+
+    def install(self, context):
+        super().install(context)
+        self._groups = {}   # group id -> shared Dequeue
+
+    def _make_local(self):
+        return Dequeue()
+
+    def _gid(self, th_id: int) -> int:
+        return th_id // max(1, int(params.get("sched_lhq_group_size", 2)))
+
+    def _group(self, th_id: int) -> Dequeue:
+        g = self._gid(th_id)
+        q = self._groups.get(g)
+        if q is None:
+            q = self._groups.setdefault(g, Dequeue())
+        return q
+
+    def flow_init(self, es):
+        super().flow_init(es)
+        self._group(es.th_id)
+
+    def schedule(self, es, tasks, distance=0):
+        if self._defer(tasks, distance):
+            return
+        q = self._locals.get(es.th_id)
+        if q is None:
+            self._system.chain_back(tasks)
+            return
+        cap = params.get("sched_lfq_queue_size", 16)
+        grp = self._group(es.th_id)
+        for t in tasks:
+            if len(q) < cap:
+                q.push_back(t)
+            elif len(grp) < cap * 4:        # next level up the hierarchy
+                grp.push_back(t)
+            else:
+                self._n_overflow += 1
+                self._system.push_back(t)
+
+    def select(self, es):
+        q = self._locals.get(es.th_id)
+        if q is not None:
+            t = q.pop_front()
+            if t is not None:
+                self._n_local += 1
+                return t
+        grp = self._group(es.th_id)
+        t = grp.pop_front()
+        if t is not None:
+            self._n_local += 1
+            return t
+        me = self._gid(es.th_id)
+        # steal: sibling streams in my group first (cache locality),
+        # then other groups' shared buffers, then their streams
+        for tid in sorted(self._locals):
+            if tid != es.th_id and self._gid(tid) == me:
+                t = self._locals[tid].pop_back()
+                if t is not None:
+                    self._n_steal += 1
+                    return t
+        for g in sorted(self._groups):
+            if g != me:
+                t = self._groups[g].pop_back()
+                if t is not None:
+                    self._n_steal += 1
+                    return t
+        for other in self._steal_order(es):
+            t = other.pop_back()
+            if t is not None:
+                self._n_steal += 1
+                return t
+        t = self._system.pop_front()
+        if t is not None:
+            self._n_system += 1
+        return t
+
+
+class _HeapRingLifo:
+    """LIFO of priority heaps: each schedule() call pushes its task chain
+    as ONE priority-sorted ring (reference: the task rings of
+    sched_llp_module.c / parsec_list_item_ring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stack: List[List] = []
+        self._seq = itertools.count()
+
+    def push_ring(self, tasks):
+        ring = []
+        for t in tasks:
+            heapq.heappush(ring, (-t.priority, next(self._seq), t))
+        with self._lock:
+            self._stack.append(ring)
+
+    def pop_best(self):
+        with self._lock:
+            if not self._stack:
+                return None
+            ring = self._stack.pop()
+            t = heapq.heappop(ring)[2]
+            if ring:
+                self._stack.append(ring)
+            return t
+
+
+class LifoLocalPrio(_PerStream):
+    """llp: per-VP LIFO of priority-sorted task rings (reference:
+    sched_llp_module.c) — streams of one virtual process share a LIFO
+    whose entries are whole released-task rings, newest ring first,
+    highest priority within the ring first."""
+
+    def install(self, context):
+        super().install(context)
+        self._vps = {}      # vp id -> _HeapRingLifo
+
+    def _make_local(self):
+        return None         # structures are per-VP, not per-stream
+
+    def _vp(self, es) -> _HeapRingLifo:
+        v = self._vps.get(es.vp_id)
+        if v is None:
+            v = self._vps.setdefault(es.vp_id, _HeapRingLifo())
+        return v
+
+    def flow_init(self, es):
+        self._locals[es.th_id] = es.vp_id
+        self._vp(es)
+
+    def schedule(self, es, tasks, distance=0):
+        if self._defer(tasks, distance):
+            return
+        self._vp(es).push_ring(tasks)
+
+    def select(self, es):
+        t = self._vp(es).pop_best()
+        if t is not None:
+            self._n_local += 1
+            return t
+        me = es.vp_id
+        for v in sorted(self._vps):
+            if v != me:
+                t = self._vps[v].pop_best()
+                if t is not None:
+                    self._n_steal += 1
+                    return t
+        t = self._system.pop_front()
+        if t is not None:
+            self._n_system += 1
+        return t
 
 
 register("ll", LocalLifo, priority=40)

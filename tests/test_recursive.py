@@ -1,0 +1,139 @@
+"""Recursive device tests (reference: parsec/recursive.h parsec_recursivecall;
+tests using the recursive device factor one tile by an inner taskpool).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.core.recursive import recursive_call
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.data.subtile import SubtileMatrix
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, TASK
+
+
+def _spd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    return (a @ a.T + n * np.eye(n, dtype=np.float32))
+
+
+def test_recursive_potrf_single_tile():
+    """The reference's flagship recursive pattern: one big tile factored
+    by an INNER tiled-Cholesky taskpool spawned from the outer task's
+    body; the outer task completes when the inner pool does and the
+    parent tile sees the committed result."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+
+    n, inner_mb = 64, 16
+    a = _spd(n)
+    A = TwoDimBlockCyclic(mb=n, nb=n, lm=n, ln=n, name="A") \
+        .from_array(a.copy())
+    after = []
+
+    def body(T, es, task):
+        sub = SubtileMatrix(task.data["T"].data, mb=inner_mb, nb=inner_mb)
+        inner = potrf_taskpool(sub, device="cpu")
+        return recursive_call(es, task, inner,
+                              callback=lambda _t: sub.commit())
+
+    p = PTG("rec")
+    p.task("FACT") \
+        .affinity(lambda A=A: A(0, 0)) \
+        .flow("T", "RW",
+              IN(DATA(lambda A=A: A(0, 0))),
+              OUT(TASK("CHECK", "T", lambda: dict())),
+              OUT(DATA(lambda A=A: A(0, 0)))) \
+        .body(body)
+    # a successor task proves the outer task's deps release only after
+    # the inner pool committed (ordering evidence, not just results)
+    p.task("CHECK") \
+        .affinity(lambda A=A: A(0, 0)) \
+        .flow("T", "READ",
+              IN(TASK("FACT", "T", lambda: dict()))) \
+        .body(lambda T: after.append(np.asarray(T).copy()))
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=120)
+
+    expect = np.linalg.cholesky(a).astype(np.float32)
+    got = np.tril(A.to_array())
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+    assert len(after) == 1
+    np.testing.assert_allclose(np.tril(after[0]), expect, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_recursive_nests_two_levels():
+    """Recursion composes: the inner pool's task itself recurses."""
+    N = 16
+    V = TwoDimBlockCyclic(mb=N, nb=N, lm=N, ln=N, name="V") \
+        .from_array(np.ones((N, N), np.float32))
+
+    def leaf_pool(sub):
+        q = PTG("leaf", MT=sub.mt, NT=sub.nt)
+        from parsec_tpu.dsl.ptg.api import Range
+        q.task("ADD", m=Range(0, sub.mt - 1), n=Range(0, sub.nt - 1)) \
+            .affinity(lambda m, n, S=sub: S(m, n)) \
+            .flow("X", "RW",
+                  IN(DATA(lambda m, n, S=sub: S(m, n))),
+                  OUT(DATA(lambda m, n, S=sub: S(m, n)))) \
+            .body(lambda X: X + 1.0)
+        return q.build()
+
+    def mid_body(T, es, task):
+        sub = SubtileMatrix(task.data["T"].data, mb=N // 2, nb=N // 2)
+        inner = PTG("mid", MT=sub.mt, NT=sub.nt)
+        from parsec_tpu.dsl.ptg.api import Range
+
+        def inner_body(X, es, task):
+            s2 = SubtileMatrix(task.data["X"].data, mb=N // 4, nb=N // 4,
+                               name="s2")
+            return recursive_call(es, task, leaf_pool(s2),
+                                  callback=lambda _t: s2.commit())
+
+        inner.task("REC", m=Range(0, sub.mt - 1), n=Range(0, sub.nt - 1)) \
+            .affinity(lambda m, n, S=sub: S(m, n)) \
+            .flow("X", "RW",
+                  IN(DATA(lambda m, n, S=sub: S(m, n))),
+                  OUT(DATA(lambda m, n, S=sub: S(m, n)))) \
+            .body(inner_body)
+        return recursive_call(es, task, inner.build(),
+                              callback=lambda _t: sub.commit())
+
+    p = PTG("outer")
+    p.task("GO") \
+        .affinity(lambda V=V: V(0, 0)) \
+        .flow("T", "RW",
+              IN(DATA(lambda V=V: V(0, 0))),
+              OUT(DATA(lambda V=V: V(0, 0)))) \
+        .body(mid_body)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=120)
+    np.testing.assert_allclose(V.to_array(), 2.0)
+
+
+def test_recursive_inner_failure_fails_outer():
+    """An inner-pool task error must fail the context, not hang it."""
+    V = TwoDimBlockCyclic(mb=8, nb=8, lm=8, ln=8, name="V") \
+        .from_array(np.ones((8, 8), np.float32))
+
+    def body(T, es, task):
+        inner = PTG("bad")
+        inner.task("BOOM") \
+            .affinity(lambda V=V: V(0, 0)) \
+            .body(lambda: (_ for _ in ()).throw(RuntimeError("inner boom")))
+        return recursive_call(es, task, inner.build())
+
+    p = PTG("outer")
+    p.task("GO") \
+        .affinity(lambda V=V: V(0, 0)) \
+        .flow("T", "RW",
+              IN(DATA(lambda V=V: V(0, 0))),
+              OUT(DATA(lambda V=V: V(0, 0)))) \
+        .body(body)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(p.build())
+        with pytest.raises(RuntimeError):
+            ctx.wait(timeout=60)
