@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from parsec_tpu.utils.debug_history import mark
 from parsec_tpu.utils.mca import params
 from parsec_tpu.utils.output import debug_verbose, warning
 
@@ -42,6 +43,7 @@ TAG_TERMDET = 4
 TAG_BARRIER = 5
 TAG_DTD = 6       # distributed DTD data/flush traffic
 TAG_BATCH = 7     # aggregated same-destination messages [(tag, payload)...]
+TAG_UTRIG = 8     # user-trigger termination declaration
 TAG_USER = 16     # first tag available to applications
 
 _LEN = struct.Struct("!IQ")   # (tag, payload length)
@@ -89,6 +91,7 @@ class CommEngine:
         pass
 
     def _dispatch(self, tag: int, src: int, payload: Any) -> None:
+        mark("recv tag=%d src=%d", tag, src)
         with self._cb_lock:
             cb = self._callbacks.get(tag)
             if cb is None:
@@ -228,6 +231,7 @@ class SocketCE(CommEngine):
                     self.on_error(exc)
 
     def send_am(self, tag: int, dst: int, payload: Any = None) -> None:
+        mark("send_am tag=%d dst=%d", tag, dst)
         if dst == self.rank:
             # local delivery short-circuit (counts as a message so the
             # termination balance stays symmetric)

@@ -35,10 +35,11 @@ import numpy as np
 
 from parsec_tpu.comm.engine import (CommEngine, TAG_ACTIVATE, TAG_BATCH,
                                     TAG_DTD, TAG_GET_REP, TAG_GET_REQ,
-                                    TAG_TERMDET)
+                                    TAG_TERMDET, TAG_UTRIG)
 from parsec_tpu.core import scheduling
 from parsec_tpu.core.engine import deliver_dep
 from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import warning
 
 params.register("comm_eager_limit", 64 * 1024,
                 "payloads up to this many bytes ride inside the activation")
@@ -57,13 +58,23 @@ def _decode(buf: bytes, dtype: str, shape) -> np.ndarray:
     return np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape).copy()
 
 
+params.register("comm_handle_timeout", 600.0,
+                "seconds before an unclaimed rendezvous handle is dropped "
+                "(a receiver that never GETs — eager race, dead peer — "
+                "must not strand the payload forever; a GET after the "
+                "purge fails the RECEIVER with a clear miss, not the "
+                "serving rank)")
+
+
 class _Handle:
-    __slots__ = ("data", "refs", "lock")
+    __slots__ = ("data", "refs", "lock", "born")
 
     def __init__(self, data, refs: int):
+        import time
         self.data = data
         self.refs = refs
         self.lock = threading.Lock()
+        self.born = time.monotonic()
 
 
 class RemoteDepEngine:
@@ -111,6 +122,7 @@ class RemoteDepEngine:
         ce.tag_register(TAG_TERMDET, self._termdet_cb)
         ce.tag_register(TAG_DTD, self._enq_cb("dtd"))
         ce.tag_register(TAG_BATCH, self._batch_cb)
+        ce.tag_register(TAG_UTRIG, self._utrig_cb)
         #: pending GET completions: handle -> (tp_id, deliveries)
         self._pending_gets: Dict[Tuple[int, int], dict] = {}
         #: DTD messages that raced their pool's registration on this rank
@@ -140,6 +152,31 @@ class RemoteDepEngine:
             self.ce.recv_msgs += 1   # each inner message counts
             self.ce._dispatch(tag, src, payload)
 
+    def send_user_trigger(self, tp_id: int) -> None:
+        """Broadcast a user-declared termination to every peer
+        (reference: the user_trigger termdet's own AM tag)."""
+        for r in range(self.nranks):
+            if r != self.rank:
+                self.ce.send_am(TAG_UTRIG, r, {"tp": tp_id})
+
+    def _utrig_cb(self, src: int, msg: dict) -> None:
+        tp = self.context.taskpools.get(msg["tp"])
+        if tp is None or tp.termdet is None:
+            # raced registration: retry until the SPMD peer reaches
+            # add_taskpool (bounded — a missing pool is a program error)
+            tries = msg.get("_tries", 0)
+            if tries and tries % 200 == 0:   # ~every 10s of waiting
+                warning("rank %d: user-trigger still waiting for "
+                        "taskpool %s to register", self.rank, msg["tp"])
+            # retry until the pool registers, like the ACTIVATE path —
+            # dropping the signal would hang the pool forever
+            t = threading.Timer(0.05, self._utrig_cb,
+                                args=(src, {**msg, "_tries": tries + 1}))
+            t.daemon = True
+            t.start()
+            return
+        tp.termdet.trigger(tp, propagate=False)
+
     def memcpy_shift(self, dst_copy, src_copy) -> None:
         """Thread-shift a local payload copy onto the comm-progress
         thread (reference: parsec_remote_dep_memcpy's short-circuit,
@@ -147,8 +184,30 @@ class RemoteDepEngine:
         so workers never block on memcpy)."""
         self._cmdq.put(("memcpy", dst_copy, src_copy))
 
+    def _purge_stale_handles(self) -> None:
+        """GC rendezvous handles no receiver ever pulled (reference gap
+        closed: refcounted handles with no timeout would leak if a rank
+        in the bcast tree dies or the eager race skips its GET)."""
+        import time
+        ttl = float(params.get("comm_handle_timeout", 120.0))
+        now = time.monotonic()
+        stale = []
+        with self._hlock:
+            for h, handle in list(self._handles.items()):
+                if now - handle.born > ttl:
+                    stale.append(h)
+                    del self._handles[h]
+        for h in stale:
+            warning("rank %d: dropping unclaimed rendezvous handle %d "
+                    "after %.0fs", self.rank, h, ttl)
+
     def _progress_loop(self) -> None:
+        import time
+        next_purge = time.monotonic() + 5.0
         while not self._stop:
+            if time.monotonic() > next_purge:
+                self._purge_stale_handles()
+                next_purge = time.monotonic() + 5.0
             try:
                 cmd = self._cmdq.get(timeout=0.05)
             except queue_mod.Empty:
@@ -359,7 +418,12 @@ class RemoteDepEngine:
         with self._hlock:
             handle = self._handles.get(h)
         if handle is None:
-            raise RuntimeError(f"rank {self.rank}: GET of unknown handle {h}")
+            # purged (TTL) or never existed: report the miss to the
+            # rank that actually cannot proceed — the requester — rather
+            # than crashing the serving rank
+            self._send_app(TAG_GET_REP, src,
+                           {"handle": h, "miss": True, "root": self.rank})
+            return
         buf, dt, shape = handle.data
         self._send_app(TAG_GET_REP, src,
                        {"handle": h, "buf": buf, "dtype": dt,
@@ -408,6 +472,12 @@ class RemoteDepEngine:
         key = (msg["root"], msg["handle"])
         pend = self._pending_gets.pop(key, None)
         if pend is None:
+            return
+        if msg.get("miss"):
+            self.context.record_error(RuntimeError(
+                f"rank {self.rank}: rendezvous payload {msg['handle']} "
+                f"from rank {src} expired before our GET "
+                "(comm_handle_timeout)"), None)
             return
         arr = _decode(msg["buf"], msg["dtype"], msg["shape"])
         self._deliver(pend["tp"], pend["deliveries"], arr)

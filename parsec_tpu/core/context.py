@@ -96,12 +96,15 @@ class Context:
             if ici.ndev >= 2:
                 self.ici = ici
 
-        # termination detection factory (per-taskpool module instances share
-        # this class; reference installs termdet per taskpool)
-        _, td_cls = components.select("termdet",
-                                      params.get("termdet", "") or None)
+        # termination detection: pools default to the MCA-selected module
+        # but may name their own via Taskpool.termdet_name (reference:
+        # termdet installed per taskpool, scheduling.c:692-697; modules
+        # local / user_trigger behind the §2.9 seam)
+        sel_name, td_cls = components.select(
+            "termdet", params.get("termdet", "") or None)
         self._termdet_cls = td_cls
         self._termdet = td_cls()
+        self._termdets = {sel_name: self._termdet}
 
         self.scheduler = create_scheduler(
             scheduler or (params.get("sched", "") or None))
@@ -165,13 +168,25 @@ class Context:
             # re-delivery path looks the pool up in this table — a message
             # arriving in between must find it
             self.taskpools[tp.taskpool_id] = tp
-            tp.attach(self, self._termdet)
+            tp.attach(self, self.termdet_for(tp))
             self._pending_start.append(tp)
         if self.comm is not None:
             # activations may have raced this registration
             self.comm.retry_delayed()
         if start:
             self.start()
+
+    def termdet_for(self, tp: Taskpool):
+        """The termdet module instance for a pool: its named override or
+        the context default (modules are shared per name)."""
+        name = getattr(tp, "termdet_name", None)
+        if not name:
+            return self._termdet
+        td = self._termdets.get(name)
+        if td is None:
+            _, cls = components.select("termdet", name)
+            td = self._termdets.setdefault(name, cls())
+        return td
 
     def start(self) -> None:
         """Fire startup hooks of attached pools
@@ -231,6 +246,12 @@ class Context:
             self.comm.wait_quiescence()
 
     def record_error(self, exc: Exception, task: Task) -> None:
+        from parsec_tpu.utils.debug_history import dump_history, paranoid
+        if paranoid(1):
+            marks = dump_history()
+            if marks:
+                debug_verbose(1, "debug history (%d marks, newest last):\n%s",
+                              len(marks), "\n".join(marks[-64:]))
         with self._cond:
             self._errors.append((exc, task))
             self._cond.notify_all()
@@ -239,10 +260,11 @@ class Context:
     def remote_dep_activate(self, es, task, flow, dep, succ_tc, succ_locals,
                             copy) -> None:
         if self.comm is None:
+            from parsec_tpu.utils.output import show_help
             raise RuntimeError(
                 f"{task}: successor {succ_tc.name}{succ_locals} lives on "
-                f"rank {succ_tc.rank_of(succ_locals)} but no comm engine is "
-                "attached")
+                f"rank {succ_tc.rank_of(succ_locals)}.\n"
+                + show_help("no-comm-engine", warn=False))
         self.comm.remote_dep_activate(es, task, flow, dep, succ_tc,
                                       succ_locals, copy)
 

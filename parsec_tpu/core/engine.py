@@ -23,6 +23,7 @@ from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency, Data,
 from parsec_tpu.data.reshape import as_dtt, convert, needs_reshape
 from parsec_tpu.core.task import (Dep, Flow, FromDesc, FromTask, New, Null,
                                   Task, TaskClass, ToDesc, ToTask)
+from parsec_tpu.utils.debug_history import paranoid
 from parsec_tpu.utils.mempool import MemoryPool
 from parsec_tpu.utils.output import warning
 
@@ -74,6 +75,10 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
             rec.expected = succ_tc.nb_task_inputs(succ_locals)
             rec.locals = dict(succ_locals)
         rec.arrivals += 1
+        if paranoid(2) and rec.arrivals > rec.expected:
+            raise AssertionError(
+                f"{succ_tc.name}{succ_locals}: {rec.arrivals} arrivals "
+                f"exceed the expected {rec.expected} task-fed inputs")
         if copy is not None and rec.inputs.get(flow_name) is not None:
             # JDF forbids data gathers: a data flow has exactly one source
             raise RuntimeError(
@@ -255,6 +260,8 @@ def _writeback(task: Task, flow: Flow, copy: DataCopy, ref,
             # the collection's dtype is authoritative at home (bf16
             # compute edges land back in the f32 collection)
             arr = arr.astype(want)
+        if paranoid(2):
+            old_v = datum.newest_version()
         datum.detach_copy(0)   # readers keep their pinned snapshot
         for c in datum.copies().values():
             c.coherency = Coherency.INVALID
@@ -263,6 +270,10 @@ def _writeback(task: Task, flow: Flow, copy: DataCopy, ref,
         datum.attach_copy(host)
         datum._version_clock += 1
         host.version = datum._version_clock
+        if paranoid(2) and host.version <= old_v:
+            raise AssertionError(
+                f"writeback of {datum} did not advance the version clock "
+                f"({old_v} -> {host.version})")
     # the user-visible backing array re-links at quiescence, when no
     # pinned reader of the old view can still be in flight
     if datum.collection is not None:

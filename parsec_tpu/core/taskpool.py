@@ -49,6 +49,10 @@ class Taskpool:
         self.state = TaskpoolState.CREATED
         self.nb_tasks = 0              # mutated only through termdet
         self.nb_pending_actions = 0    # idem
+        #: name of the termdet module this pool wants instead of the
+        #: context default (e.g. "user_trigger"; reference: DSLs install
+        #: their own termdet before parsec_context_add_taskpool)
+        self.termdet_name: Optional[str] = None
         self.task_classes: Dict[str, TaskClass] = {}
         self.arenas: Dict[str, Arena] = {}
         #: dep-countdown records for not-yet-ready tasks
@@ -60,6 +64,10 @@ class Taskpool:
         #: (reference: parsec_reshape.c promise table)
         from parsec_tpu.data.reshape import ReshapeCache
         self.reshape = ReshapeCache()
+        #: extensible per-pool info slots (reference: the info object
+        #: array hung off parsec_taskpool_t, class/info.h)
+        from parsec_tpu.utils.info import InfoObjectArray, taskpool_info
+        self.info = InfoObjectArray(taskpool_info, owner=self)
         self._complete_cbs: List[Callable[["Taskpool"], None]] = []
         self._done_event = threading.Event()
         self.priority = 0

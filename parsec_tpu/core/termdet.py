@@ -119,4 +119,40 @@ class LocalTermdet(Termdet):
         return self._addto(taskpool, "nb_pending_actions", delta)
 
 
+class UserTriggerTermdet(LocalTermdet):
+    """Termination declared by an explicit user call, propagated to every
+    rank over its own message tag (reference:
+    mca/termdet/termdet_user_trigger_module.c) — for irregular apps whose
+    task count is unknowable up front (the haar-tree/project_dyn pattern:
+    tasks keep discovering tasks until the algorithm decides it is done).
+
+    Counters are still tracked (and guarded against going negative), but
+    ZERO COUNTERS NEVER FIRE termination — only ``trigger`` does.  On the
+    triggering rank the call broadcasts to all peers; each rank fires its
+    local pool.
+    """
+
+    name = "user_trigger"
+
+    def _check(self, taskpool, st) -> bool:
+        return False    # only trigger() terminates
+
+    def trigger(self, taskpool, propagate: bool = True) -> None:
+        """Declare the taskpool terminated (reference:
+        parsec_termdet_user_trigger... the root's write of the
+        termination word)."""
+        ctx = taskpool.context
+        if propagate and ctx is not None and ctx.comm is not None:
+            ctx.comm.send_user_trigger(taskpool.taskpool_id)
+        fire = False
+        with self._lock:
+            st = self._state.get(id(taskpool))
+            if st is not None and st["state"] != TermdetState.TERMINATED:
+                st["state"] = TermdetState.TERMINATED
+                fire = True
+        if fire:
+            st["cb"]()
+
+
 components.add("termdet", "local", LocalTermdet, priority=50)
+components.add("termdet", "user_trigger", UserTriggerTermdet, priority=10)

@@ -59,15 +59,14 @@ class VPMap:
 
     @classmethod
     def from_hardware(cls, nb_threads: int) -> "VPMap":
-        """Split streams over the visible cores (reference:
+        """Split streams evenly over the visible cores (reference:
         vpmap_init_from_hardware_affinity; without hwloc the 'socket'
-        granularity degenerates to one VP per contiguous core block)."""
+        granularity degenerates to contiguous, balanced core blocks)."""
         ncores = os.cpu_count() or 1
-        per_vp = max(1, ncores // max(1, min(nb_threads, ncores)))
-        cores = list(range(ncores))
+        nvp = max(1, min(nb_threads, ncores))
         return cls(nb_threads,
-                   [min(i // per_vp, ncores - 1) for i in range(nb_threads)],
-                   [cores[i % ncores] for i in range(nb_threads)])
+                   [i * nvp // nb_threads for i in range(nb_threads)],
+                   [i % ncores for i in range(nb_threads)])
 
     @classmethod
     def from_mca(cls, nb_threads: int) -> "VPMap":

@@ -330,7 +330,10 @@ class XlaDevice(Device):
         if src is not None or dc.payload is None:
             payload = src.payload if src is not None else copy.payload
             nbytes = getattr(payload, "nbytes", 0)
-            off = self._reserve(nbytes)
+            # only a FRESH copy claims a zone segment: a re-staged copy
+            # already owns one, and a surplus claim could evict victims
+            # (or spuriously exhaust the budget) for nothing
+            off = self._reserve(nbytes) if fresh else None
             if self._on_this_device(payload):
                 # already resident (copy-on-write alias): device_put would
                 # be a no-op sharing the buffer, which donation/in-place
@@ -348,10 +351,6 @@ class XlaDevice(Device):
             self.stats.bytes_in += nbytes
             if fresh:
                 self._account(datum, dc, nbytes, off)
-            else:
-                # re-staged into an existing (previously accounted) copy:
-                # the fresh segment claim is surplus
-                self._zone_free(off)
         if copy.flags & FLAG_COW and copy is not dc:
             # The COW alias's payload aliases the producer's buffer (for
             # DATA-fed fan-outs: the collection's backing array).  The
@@ -616,9 +615,10 @@ class XlaDevice(Device):
             # (the reference requeues, HOOK_RETURN_AGAIN, rather than
             # aborting)
             if _time.monotonic() > deadline:
-                raise MemoryError(
-                    f"device {self.name}: {nbytes} bytes exceed the HBM "
-                    f"budget and every resident copy stayed pinned")
+                from parsec_tpu.utils.output import show_help
+                raise MemoryError(show_help(
+                    "device-oom", warn=False,
+                    budget=(self._capacity or 0) >> 20, nbytes=nbytes))
             _time.sleep(0.001)
 
     def _evict(self, datum, dc: DataCopy, nbytes: int,

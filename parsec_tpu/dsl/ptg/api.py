@@ -277,6 +277,8 @@ class TaskBuilder:
             _bind_body_outputs(task, ret, writable)
             return None
 
+        hook.__ptg_fn__ = fn            # raw body, for the PTG->DTD bridge
+        hook.__ptg_writable__ = writable
         self._incarnations.append((device, hook))
         return self
 

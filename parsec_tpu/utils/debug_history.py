@@ -1,0 +1,88 @@
+"""Debug-history ring buffer and paranoia tiers.
+
+Rebuild of the reference's debugging defenses (reference:
+parsec/utils/debug_marks.{c,h} — a ring buffer of protocol marks
+(activation/data messages) dumped post-mortem — and the
+PARSEC_DEBUG_PARANOID assertion tiers compiled into hot paths,
+scheduling.c:290-316).  Here both are runtime-selected:
+
+  --mca debug_paranoid 0    off (default: marks disabled, asserts off)
+  --mca debug_paranoid 1    protocol marks recorded in the ring
+  --mca debug_paranoid 2    + extra invariant assertions on hot paths
+
+``paranoid()`` is the tier gate; ``mark()`` records; ``dump_history()``
+returns the ring newest-last (and is printed on context error when
+marks are enabled).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import List
+
+from parsec_tpu.utils.mca import params
+
+params.register("debug_paranoid", 0,
+                "debug tier: 0=off, 1=protocol marks, 2=+hot-path asserts")
+params.register("debug_history_size", 1024,
+                "entries in the debug-mark ring buffer")
+
+_lock = threading.Lock()
+_ring: List = []
+_next = itertools.count()
+#: cached tier, refreshed at most every 0.5s — paranoid() runs per dep
+#: arrival and per wire message, so it must not take the MCA params lock
+#: on the hot path when debugging is off
+_cached = [0, 0.0]   # [level, expiry]
+
+
+def refresh_tier() -> None:
+    """Re-read the tier immediately (call after params.set at runtime;
+    the cache otherwise refreshes every 0.5s)."""
+    _cached[1] = 0.0
+
+
+def paranoid(level: int = 1) -> bool:
+    now = time.monotonic()
+    if now >= _cached[1]:
+        try:
+            _cached[0] = int(params.get("debug_paranoid", 0))
+        except (TypeError, ValueError):
+            _cached[0] = 0
+        _cached[1] = now + 0.5
+    return _cached[0] >= level
+
+
+def mark(fmt: str, *args) -> None:
+    """Record one mark (cheap: formatted lazily at dump unless args are
+    mutable).  Reference: parsec_debug_history_add."""
+    if not paranoid(1):
+        return
+    size = int(params.get("debug_history_size", 1024))
+    entry = (next(_next), time.monotonic(),
+             threading.current_thread().name, fmt, args)
+    with _lock:
+        _ring.append(entry)
+        if len(_ring) > size:
+            del _ring[: len(_ring) - size]
+
+
+def dump_history() -> List[str]:
+    """Newest-last formatted marks (reference: parsec_debug_history_dump)."""
+    with _lock:
+        entries = list(_ring)
+    out = []
+    for seq, ts, thread, fmt, args in entries:
+        try:
+            text = fmt % args if args else fmt
+        except (TypeError, ValueError):
+            text = f"{fmt} {args!r}"
+        out.append(f"[{seq}] {ts:.6f} {thread}: {text}")
+    return out
+
+
+def clear_history() -> None:
+    with _lock:
+        _ring.clear()

@@ -22,7 +22,8 @@ from parsec_tpu.utils.mca import params
 
 params.register("debug_verbose", 1, "global debug verbosity (0=errors only)")
 params.register("debug_color", True, "colorize terminal output")
-params.register("debug_history", 64, "debug-mark ring buffer size (0=off)")
+# (ring size and tier live in utils/debug_history: debug_history_size,
+# debug_paranoid)
 
 _COLORS = {
     "fatal": "\x1b[1;31m", "warning": "\x1b[33m", "inform": "\x1b[36m",
@@ -110,33 +111,20 @@ output = Output()
 # ---------------------------------------------------------------------------
 
 class _DebugHistory:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._ring = []
-        self._pos = 0
+    """Back-compat facade over utils.debug_history — ONE ring for output
+    lines and protocol marks (lazy import: mca <-> output cycle)."""
 
     def record(self, kind: str, msg: str) -> None:
-        size = params.get("debug_history", 64)
-        if not size:
-            return
-        with self._lock:
-            entry = (time.time(), threading.get_ident(), kind, msg)
-            if len(self._ring) < size:
-                self._ring.append(entry)
-            else:
-                self._ring[self._pos % size] = entry
-            self._pos += 1
+        from parsec_tpu.utils.debug_history import mark
+        mark("%s: %s", kind, msg)
 
     def mark(self, msg: str) -> None:
-        self.record("mark", msg)
+        from parsec_tpu.utils.debug_history import mark
+        mark("%s", msg)
 
     def dump(self) -> list:
-        with self._lock:
-            size = len(self._ring)
-            if size == 0:
-                return []
-            start = self._pos % size if self._pos > size else 0
-            return self._ring[start:] + self._ring[:start]
+        from parsec_tpu.utils.debug_history import dump_history
+        return dump_history()
 
 
 _history = _DebugHistory()
@@ -166,3 +154,42 @@ def inform(msg: str, *args) -> None:
 
 def debug_verbose(level: int, msg: str, *args, stream: int = 0) -> None:
     output.emit(stream, level, "debug", msg % args if args else msg)
+
+
+# -- templated help/error texts (reference: utils/show_help.{c,h}) ----------
+
+#: topic -> template; ``register_help`` lets components ship their own
+#: texts the way the reference installs help-*.txt files
+_help_topics = {
+    "no-comm-engine": (
+        "A task has successors on other ranks but no comm engine is\n"
+        "attached to this context.  Wire a SocketCE + RemoteDepEngine\n"
+        "(see parsec_tpu.comm.launch.run_distributed) before adding\n"
+        "distributed taskpools."),
+    "device-oom": (
+        "The device HBM budget ({budget} MiB) cannot hold a {nbytes}-byte\n"
+        "tile while every resident copy is pinned.  Raise --mca\n"
+        "device_mem_mb, shrink tiles, or lower device_inflight_depth."),
+    "scheduler-unknown": (
+        "Unknown scheduler component {name!r}; available: {available}."),
+}
+
+
+def register_help(topic: str, template: str) -> None:
+    _help_topics[topic] = template
+
+
+def show_help(topic: str, *, warn: bool = True, **kwargs) -> str:
+    """Emit a templated help text (reference: parsec_show_help): returns
+    the formatted message and, by default, prints it as a warning."""
+    template = _help_topics.get(topic)
+    if template is None:
+        text = f"(no help text for topic {topic!r}; args: {kwargs})"
+    else:
+        try:
+            text = template.format(**kwargs)
+        except (KeyError, IndexError):
+            text = f"{template}  [unformatted args: {kwargs}]"
+    if warn:
+        output.emit(0, 0, "help", f"[{topic}]\n{text}")
+    return text

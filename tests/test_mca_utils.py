@@ -175,3 +175,71 @@ def test_logfile_has_no_ansi(tmp_path):
     out.emit(sid, 1, "inform", "plain")
     out.close(sid)
     assert "\x1b[" not in (tmp_path / "f.log").read_text()
+
+
+# -- info registry (reference: class/info.{c,h}) ----------------------------
+
+def test_info_space_and_object_array():
+    from parsec_tpu.utils.info import InfoObjectArray, InfoSpace
+    sp = InfoSpace("t")
+    iid = sp.register("streams", constructor=lambda owner: {"n": owner})
+    assert sp.register("streams") == iid          # idempotent
+    arr = InfoObjectArray(sp, owner=7)
+    assert arr.get("streams") == {"n": 7}         # lazy constructor
+    arr.set("streams", "override")
+    assert arr.get(iid) == "override"
+    assert arr.get("unknown", default=3) == 3
+    sp.unregister("streams")
+    arr2 = InfoObjectArray(sp, owner=1)
+    assert arr2.get("streams", default="gone") == "gone"
+
+
+def test_info_on_taskpool_and_device():
+    from parsec_tpu.core.taskpool import Taskpool
+    from parsec_tpu.devices.device import HostDevice
+    from parsec_tpu.utils.info import device_info, taskpool_info
+    taskpool_info.register("userdata")
+    tp = Taskpool("t")
+    tp.info.set("userdata", 42)
+    assert tp.info.get("userdata") == 42
+    device_info.register("workspace", constructor=lambda d: [d.name])
+    assert HostDevice().info.get("workspace") == ["cpu"]
+
+
+# -- debug history + paranoia tiers (reference: debug_marks.{c,h},
+# PARSEC_DEBUG_PARANOID) ----------------------------------------------------
+
+def test_debug_history_ring_and_tiers():
+    from parsec_tpu.utils.debug_history import (clear_history, dump_history,
+                                                mark, paranoid, refresh_tier)
+    from parsec_tpu.utils.mca import params
+    clear_history()
+    refresh_tier()
+    assert not paranoid(1)
+    mark("dropped %d", 1)                  # tier 0: not recorded
+    assert dump_history() == []
+    params.set("debug_paranoid", 1)
+    params.set("debug_history_size", 4)
+    refresh_tier()
+    try:
+        assert paranoid(1) and not paranoid(2)
+        for i in range(9):
+            mark("msg %d", i)
+        hist = dump_history()
+        assert len(hist) == 4              # ring bounded
+        assert "msg 8" in hist[-1]
+    finally:
+        params.unset("debug_paranoid")
+        params.unset("debug_history_size")
+        refresh_tier()
+        clear_history()
+
+
+def test_show_help_templates(capfd):
+    from parsec_tpu.utils.output import register_help, show_help
+    text = show_help("device-oom", budget=64, nbytes=1024)
+    assert "64 MiB" in text and "1024-byte" in text
+    assert "device-oom" in capfd.readouterr().err
+    register_help("custom-topic", "hello {who}")
+    assert show_help("custom-topic", warn=False, who="world") == "hello world"
+    assert "no help text" in show_help("missing", warn=False)

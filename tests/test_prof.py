@@ -118,3 +118,54 @@ def test_trace_roundtrip_dictionary_and_streams(tmp_path):
     assert (df["name"] == "MYEV").all()
     assert df.iloc[0]["info"] == {"val": 42}
     assert df.iloc[0]["stream"] == 7
+
+
+def test_trace_tools_cli(tmp_path):
+    """tools/trace_info.py (dbpinfos analog) and tools/trace2chrome.py
+    (the OTF2-role interoperable export) run on a real runtime trace
+    (reference: tools/profiling/dbpinfos, profiling_otf2.c)."""
+    import json
+    import subprocess
+    import sys
+
+    import numpy as np
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range
+    from parsec_tpu.prof.pins import TaskProfilerPins
+    from parsec_tpu.prof import profiling
+
+    prof = profiling.profiling_init("tools-test")
+    V = VectorTwoDimCyclic(mb=2, lm=8)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0.0
+    p = PTG("tooltrace", NT=4)
+    p.task("T", k=Range(0, 3)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("X", "RW", IN(DATA(lambda k, V=V: V(k))),
+              OUT(DATA(lambda k, V=V: V(k)))) \
+        .body(lambda X: X + 1.0)
+    with Context(nb_cores=2) as ctx:
+        pins = TaskProfilerPins(prof)
+        pins.install(ctx)
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=60)
+        pins.uninstall(ctx)
+    path = prof.dump(str(tmp_path / "tools.ptt"))
+    profiling.profiling_fini()
+
+    env = {**__import__("os").environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "tools/trace_info.py", path, "--stats"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "dictionary" in r.stdout and "total events" in r.stdout
+
+    out = str(tmp_path / "tools.json")
+    r = subprocess.run(
+        [sys.executable, "tools/trace2chrome.py", path, "-o", out],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    doc = json.load(open(out))
+    assert doc["traceEvents"], "no events exported"
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in doc["traceEvents"])

@@ -1,0 +1,76 @@
+"""user_trigger termdet tests (reference:
+mca/termdet/termdet_user_trigger_module.c; the dynamic-termdet pattern of
+tests/apps/haar_tree project_dyn.jdf — pools whose task count is
+unknowable terminate on an explicit user call, propagated to all ranks).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.launch import run_distributed
+from parsec_tpu.core.context import Context
+from parsec_tpu.dsl.dtd import DTDTaskpool, INOUT
+
+
+def test_user_trigger_local():
+    """Zero counters never fire; trigger() does."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    V = VectorTwoDimCyclic(mb=2, lm=2)
+    V.data_of(0).copy_on(0).payload[:] = 0.0
+    with Context(nb_cores=2) as ctx:
+        tp = DTDTaskpool("dyn")
+        tp.termdet_name = "user_trigger"
+        ctx.add_taskpool(tp)
+        ctx.start()
+        t = tp.tile_of(V, 0)
+        for _ in range(5):
+            tp.insert_task(lambda T: T + 1.0, (t, INOUT))
+        # drain the inserted work, then prove the pool is still alive
+        deadline = time.monotonic() + 30
+        while tp._inflight > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert not tp.completed, \
+            "user_trigger pool must not self-terminate on zero counters"
+        tp.termdet.trigger(tp)
+        assert tp.wait_local(10)
+        ctx.wait(timeout=30)
+    np.testing.assert_allclose(
+        np.asarray(V.data_of(0).pull_to_host().payload), 5.0)
+
+
+def _dyn_rank(ctx, rank, nranks):
+    """Rank 0 declares termination; every rank's pool completes."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    V = VectorTwoDimCyclic(mb=2, lm=2 * nranks, nodes=nranks, myrank=rank)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0.0
+    tp = DTDTaskpool("dyn")
+    tp.termdet_name = "user_trigger"
+    ctx.add_taskpool(tp)
+    ctx.start()
+    t = tp.tile_of(V, rank)    # purely local work on each rank
+    for _ in range(3 + rank):
+        tp.insert_task(lambda T: T + 1.0, (t, INOUT))
+    # rank 0 waits for its own work then declares global termination
+    deadline = time.monotonic() + 30
+    while tp._inflight > 0:
+        if time.monotonic() > deadline:
+            raise TimeoutError("local drain")
+        time.sleep(0.01)
+    ctx.comm.ce.barrier()      # all ranks drained their local work
+    if rank == 0:
+        tp.termdet.trigger(tp)
+    if not tp.wait_local(30):
+        raise TimeoutError(f"rank {rank}: pool never terminated")
+    ctx.wait(timeout=60)
+    got = np.asarray(V.data_of(rank).pull_to_host().payload)
+    np.testing.assert_allclose(got, float(3 + rank))
+    return "ok"
+
+
+def test_user_trigger_distributed():
+    assert run_distributed(_dyn_rank, 3) == ["ok"] * 3

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Convert a binary trace (.ptt) to Chrome trace-event JSON.
+
+The interoperable-trace-format role of the reference's OTF2 backend
+(reference: parsec/profiling_otf2.c), targeted at the tooling that is
+native on TPU stacks: chrome://tracing and Perfetto open the output
+directly.  Usage:
+
+    python tools/trace2chrome.py run.ptt -o run.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help=".ptt trace file")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output JSON (default: <trace>.json)")
+    args = ap.parse_args(argv)
+    out = args.out or (os.path.splitext(args.trace)[0] + ".json")
+
+    from parsec_tpu.prof.reader import intervals, read_trace
+    meta, df = read_trace(args.trace)
+    iv = intervals(df) if len(df) else df
+
+    events = []
+    if len(iv):
+        t0 = float(iv["ts_begin"].min())
+        for row in iv.itertuples():
+            events.append({
+                "name": row.name,
+                "cat": "task",
+                "ph": "X",                      # complete event
+                "ts": (float(row.ts_begin) - t0) * 1e6,
+                "dur": float(row.duration) * 1e6,
+                "pid": int(row.taskpool_id),
+                "tid": int(row.stream),
+                "args": {"event_id": int(row.event_id),
+                         "info": repr(row.info) if row.info is not None
+                         else ""},
+            })
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"hr_id": meta["hr_id"], **meta.get("info", {})},
+    }
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    print(f"{out}: {len(events)} events")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Summarize a binary trace (.ptt) — the dbpinfos analog.
+
+Reference: tools/profiling/dbpreader.c + dbpinfos — dump a trace's
+header, dictionary, per-stream event counts, and per-event-class timing
+statistics.  Usage:
+
+    python tools/trace_info.py run.ptt [--events] [--stats]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help=".ptt trace file")
+    ap.add_argument("--events", action="store_true",
+                    help="dump every event row")
+    ap.add_argument("--stats", action="store_true",
+                    help="per-class interval timing statistics")
+    args = ap.parse_args(argv)
+
+    from parsec_tpu.prof.reader import intervals, read_trace
+    meta, df = read_trace(args.trace)
+
+    print(f"trace: {args.trace}")
+    print(f"hr_id: {meta['hr_id']}")
+    for k, v in sorted(meta.get("info", {}).items()):
+        print(f"info : {k} = {v}")
+    print(f"dictionary ({len(meta['dictionary'])} classes):")
+    for key, name, attrs in meta["dictionary"]:
+        print(f"  [{key:3d}] {name}{'  ' + attrs if attrs else ''}")
+    print(f"streams ({len(meta['streams'])}):")
+    for sid, name, nev in meta["streams"]:
+        print(f"  [{sid:3d}] {name or '<unnamed>'}: {nev} events")
+    print(f"total events: {len(df)}")
+
+    if args.events:
+        print(df.to_string())
+    if args.stats and len(df):
+        iv = intervals(df)
+        if len(iv):
+            g = iv.groupby("name")["duration"]
+            print("per-class interval stats (seconds):")
+            print(g.agg(["count", "sum", "mean", "min", "max"])
+                  .to_string(float_format=lambda v: f"{v:.6f}"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
