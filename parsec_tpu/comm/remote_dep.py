@@ -165,11 +165,15 @@ class RemoteDepEngine:
             # raced registration: retry until the SPMD peer reaches
             # add_taskpool (bounded — a missing pool is a program error)
             tries = msg.get("_tries", 0)
+            if tries >= 2400:   # ~2 minutes: the pool will never appear
+                self.context.record_error(RuntimeError(
+                    f"rank {self.rank}: user-trigger for taskpool "
+                    f"{msg['tp']} which never registered (mismatched "
+                    "SPMD insertion?)"), None)
+                return
             if tries and tries % 200 == 0:   # ~every 10s of waiting
                 warning("rank %d: user-trigger still waiting for "
                         "taskpool %s to register", self.rank, msg["tp"])
-            # retry until the pool registers, like the ACTIVATE path —
-            # dropping the signal would hang the pool forever
             t = threading.Timer(0.05, self._utrig_cb,
                                 args=(src, {**msg, "_tries": tries + 1}))
             t.daemon = True

@@ -18,6 +18,29 @@ import threading
 from typing import Any, Iterable, List, Optional
 
 
+def make_dequeue():
+    """Scheduler-queue factory.  The native C++ dequeue is OPT-IN
+    (``--mca native_queues 1``): for Python-object payloads the measured
+    throughput is ~4x LOWER than the pure-Python deque (each op pays a
+    ctypes FFI crossing plus id-parking under the GIL, which the queue
+    cannot escape), so objects default to Python and the native queue
+    serves payloads that are genuinely u64 handles end-to-end
+    (reference seam: parsec_dequeue_t)."""
+    from parsec_tpu.utils.mca import params
+    params.register("native_queues", 0,
+                    "use the native dequeue for scheduler object queues "
+                    "(measured slower for Python payloads; see "
+                    "containers.lists.make_dequeue)")
+    try:
+        if int(params.get("native_queues", 0)):
+            from parsec_tpu.native import NativeDequeue, available
+            if available():
+                return NativeDequeue()
+    except Exception:
+        pass
+    return Dequeue()
+
+
 class Lifo:
     """LIFO stack (reference: parsec_lifo_t)."""
 

@@ -260,8 +260,8 @@ def _writeback(task: Task, flow: Flow, copy: DataCopy, ref,
             # the collection's dtype is authoritative at home (bf16
             # compute edges land back in the f32 collection)
             arr = arr.astype(want)
-        if paranoid(2):
-            old_v = datum.newest_version()
+        check_versions = paranoid(2)   # sample ONCE: the tier may move
+        old_v = datum.newest_version() if check_versions else 0
         datum.detach_copy(0)   # readers keep their pinned snapshot
         for c in datum.copies().values():
             c.coherency = Coherency.INVALID
@@ -270,7 +270,7 @@ def _writeback(task: Task, flow: Flow, copy: DataCopy, ref,
         datum.attach_copy(host)
         datum._version_clock += 1
         host.version = datum._version_clock
-        if paranoid(2) and host.version <= old_v:
+        if check_versions and host.version <= old_v:
             raise AssertionError(
                 f"writeback of {datum} did not advance the version clock "
                 f"({old_v} -> {host.version})")

@@ -161,8 +161,16 @@ class XlaDevice(Device):
         #: the zone tracks logical segments to drive eviction exactly
         #: where the reference drove cudaMalloc'd slabs)
         if self._capacity is not None:
-            from parsec_tpu.utils.zone_alloc import ZoneAllocator
-            self._zone = ZoneAllocator(self._capacity)
+            self._zone = None
+            try:
+                from parsec_tpu.native import NativeZoneAllocator, available
+                if available():
+                    self._zone = NativeZoneAllocator(self._capacity)
+            except Exception:
+                pass
+            if self._zone is None:
+                from parsec_tpu.utils.zone_alloc import ZoneAllocator
+                self._zone = ZoneAllocator(self._capacity)
         else:
             self._zone = None
         #: datum-id -> (weakref to device copy, nbytes, zone offset);

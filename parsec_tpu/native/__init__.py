@@ -1,0 +1,267 @@
+"""ctypes bindings for the native runtime core.
+
+The C++ library (core.cpp) carries the hot-path primitives the reference
+keeps native — MPMC handle queues, the zone allocator, binary trace
+buffers — built on demand (atomically, rename-into-place).  Every Python
+consumer keeps a pure-Python fallback, selected via ``available()`` /
+``--mca native_core``:
+
+  utils.zone_alloc           <- NativeZoneAllocator (device HBM ledger,
+                                default on)
+  prof.profiling             <- NativeTraceBuffer (event append path,
+                                default on)
+  containers.make_dequeue    <- NativeDequeue (OPT-IN via native_queues:
+                                measured slower for Python-object
+                                payloads, see make_dequeue)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import debug_verbose, warning
+
+params.register("native_core", 1,
+                "use the C++ runtime core when it builds (0 = pure Python)")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libparsec_tpu.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    """Compile to a temp name and rename into place: spawned rank
+    processes may build concurrently on a fresh checkout, and a reader
+    must never dlopen a half-written .so (rename is atomic)."""
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    try:
+        r = subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+             "-shared", "-o", tmp, os.path.join(_HERE, "core.cpp")],
+            capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            warning("native core build failed:\n%s", r.stderr[-2000:])
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        warning("native core build unavailable: %s", exc)
+        return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (once) and load the shared library; None when disabled or
+    the toolchain is missing."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not int(params.get("native_core", 1)):
+            return None
+        if not os.path.exists(_SO) or \
+                os.path.getmtime(_SO) < os.path.getmtime(
+                    os.path.join(_HERE, "core.cpp")):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as exc:
+            warning("native core load failed: %s", exc)
+            return None
+        _sign(lib)
+        _lib = lib
+        debug_verbose(5, "native core loaded: %s", _SO)
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _sign(lib: ctypes.CDLL) -> None:
+    C = ctypes
+    u64, i64, i32 = C.c_uint64, C.c_int64, C.c_int32
+    p, d = C.c_void_p, C.c_double
+    sigs = {
+        "ptq_deq_new": ([], p),
+        "ptq_deq_delete": ([p], None),
+        "ptq_deq_push_back": ([p, u64], None),
+        "ptq_deq_push_front": ([p, u64], None),
+        "ptq_deq_pop_front": ([p, C.POINTER(u64)], C.c_int),
+        "ptq_deq_pop_back": ([p, C.POINTER(u64)], C.c_int),
+        "ptq_deq_len": ([p], u64),
+        "ptq_zone_new": ([u64, u64], p),
+        "ptq_zone_delete": ([p], None),
+        "ptq_zone_malloc": ([p, u64], i64),
+        "ptq_zone_release": ([p, i64], C.c_int),
+        "ptq_zone_used": ([p], u64),
+        "ptq_zone_free_bytes": ([p], u64),
+        "ptq_zone_defragmented": ([p], C.c_int),
+        "ptq_trace_new": ([u64], p),
+        "ptq_trace_delete": ([p], None),
+        "ptq_trace_event": ([p, i32, i32, u64, u64, u64, d], None),
+        "ptq_trace_count": ([p], u64),
+        "ptq_trace_event_size": ([], u64),
+        "ptq_trace_read": ([p, u64, C.POINTER(C.c_uint8), u64], u64),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+
+
+# ---------------------------------------------------------------------------
+# Python wrappers
+# ---------------------------------------------------------------------------
+
+class NativeDequeue:
+    """MPMC dequeue of Python objects over native u64 handles (reference:
+    parsec_dequeue_t).  Handles are id()s parked in a side table so the
+    queue discipline itself runs without the interpreter lock."""
+
+    def __init__(self):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._h = self._lib.ptq_deq_new()
+        self._objs = {}
+        self._olock = threading.Lock()
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.ptq_deq_delete(h)
+            self._h = None
+
+    def _park(self, obj) -> int:
+        key = id(obj)
+        with self._olock:
+            self._objs.setdefault(key, []).append(obj)
+        return key
+
+    def _claim(self, key: int):
+        with self._olock:
+            lst = self._objs[key]
+            obj = lst.pop()
+            if not lst:
+                del self._objs[key]
+            return obj
+
+    def push_back(self, obj) -> None:
+        self._lib.ptq_deq_push_back(self._h, self._park(obj))
+
+    def push_front(self, obj) -> None:
+        self._lib.ptq_deq_push_front(self._h, self._park(obj))
+
+    def chain_back(self, objs) -> None:
+        for o in objs:
+            self.push_back(o)
+
+    def _pop(self, fn):
+        out = ctypes.c_uint64()
+        if not fn(self._h, ctypes.byref(out)):
+            return None
+        return self._claim(out.value)
+
+    def pop_front(self):
+        return self._pop(self._lib.ptq_deq_pop_front)
+
+    def pop_back(self):
+        return self._pop(self._lib.ptq_deq_pop_back)
+
+    def __len__(self):
+        return int(self._lib.ptq_deq_len(self._h))
+
+
+class NativeZoneAllocator:
+    """Drop-in twin of utils.zone_alloc.ZoneAllocator over the C++
+    segment allocator (reference: utils/zone_malloc.c)."""
+
+    def __init__(self, total_bytes: int, unit_bytes: int = 512):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._h = self._lib.ptq_zone_new(int(total_bytes), int(unit_bytes))
+        if not self._h:
+            raise ValueError("zone size and unit must be positive and "
+                             "total >= unit")
+        self.unit = unit_bytes
+        self.nb_units = total_bytes // unit_bytes
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.ptq_zone_delete(h)
+            self._h = None
+
+    def malloc(self, nbytes: int):
+        off = self._lib.ptq_zone_malloc(self._h, int(nbytes))
+        return None if off < 0 else int(off)
+
+    def free(self, offset: int) -> None:
+        if self._lib.ptq_zone_release(self._h, int(offset)) != 0:
+            raise ValueError(f"bad free at offset {offset}")
+
+    def used_bytes(self) -> int:
+        return int(self._lib.ptq_zone_used(self._h))
+
+    def free_bytes(self) -> int:
+        return int(self._lib.ptq_zone_free_bytes(self._h))
+
+    def check_defrag(self) -> bool:
+        return bool(self._lib.ptq_zone_defragmented(self._h))
+
+
+class NativeTraceBuffer:
+    """Append-only event buffer (reference: the per-thread buffers of
+    profiling.c).  ``drain()`` returns (key, flags, taskpool_id,
+    event_id, object_id, ts) tuples."""
+
+    #: signed 64-bit fields on the way OUT so negative sentinels (e.g.
+    #: object_id -1) round-trip through the C struct's two's complement
+    _EVFMT = "<iiqqqd"
+
+    def __init__(self, reserve: int = 4096):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._h = self._lib.ptq_trace_new(int(reserve))
+        self._evsz = int(self._lib.ptq_trace_event_size())
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.ptq_trace_delete(h)
+            self._h = None
+
+    def event(self, key: int, flags: int, taskpool_id: int, event_id: int,
+              object_id: int, ts: float) -> None:
+        self._lib.ptq_trace_event(self._h, key, flags, taskpool_id,
+                                  event_id, object_id, ts)
+
+    def __len__(self):
+        return int(self._lib.ptq_trace_count(self._h))
+
+    def drain(self, start: int = 0):
+        import struct
+        n = len(self) - start
+        if n <= 0:
+            return []
+        buf = (ctypes.c_uint8 * (n * self._evsz))()
+        got = self._lib.ptq_trace_read(self._h, start, buf, len(buf))
+        raw = bytes(buf[:got])
+        return [struct.unpack_from(self._EVFMT, raw, i * self._evsz)
+                for i in range(got // self._evsz)]

@@ -26,6 +26,7 @@ class TaskProfilerPins:
     def __init__(self, profile: Profile):
         self.profile = profile
         self._event_ids: Dict[int, int] = {}   # task seq -> trace event id
+        self._closed: set = set()              # eids closed by exec_end
 
     def install(self, context) -> None:
         context.pins_register("exec_begin", self._begin)
@@ -50,23 +51,24 @@ class TaskProfilerPins:
 
     def _end(self, es, event, task) -> None:
         eid = self._event_ids.get(task.seq, 0)
+        self._closed.add(eid)
         self.profile.trace_interval_end(
             self._sb(es), task.task_class.name, task.taskpool.taskpool_id,
             eid, object_id=hash(task.key))
 
     def _complete(self, es, event, task) -> None:
         # device (ASYNC) tasks never ran exec_end on a worker stream:
-        # close their interval at completion
+        # close their interval at completion (closed-set membership, not
+        # a buffer scan — END events may live in the native buffer)
         eid = self._event_ids.pop(task.seq, None)
         if eid is None:
             return
-        sb = self._sb(es)
-        for key, flags, tp, e, oid, ts, info in reversed(sb.events):
-            if e == eid and flags & 2:      # already closed by _end
-                return
+        if eid in self._closed:             # already closed by _end
+            self._closed.discard(eid)
+            return
         self.profile.trace_interval_end(
-            sb, task.task_class.name, task.taskpool.taskpool_id, eid,
-            object_id=hash(task.key))
+            self._sb(es), task.task_class.name, task.taskpool.taskpool_id,
+            eid, object_id=hash(task.key))
 
 
 def install_task_profiler(context, profile: Profile) -> TaskProfilerPins:

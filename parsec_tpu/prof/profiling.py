@@ -50,19 +50,46 @@ class EventClass:
 
 class StreamBuffer:
     """Per-execution-stream event buffer (reference: per-thread profiling
-    buffers; appending never takes a lock)."""
+    buffers; appending never takes a lock).
+
+    Info-less events — the overwhelming majority — append into the
+    NATIVE C++ buffer when the native core is available (reference:
+    profiling.c's fixed-size binary records); events carrying a Python
+    info payload stay in the Python list; both merge, ordered by
+    timestamp, at dump time.
+    """
 
     def __init__(self, stream_id: int, name: str):
         self.stream_id = stream_id
         self.name = name
         self.events: List[Tuple] = []
+        self._native = None
+        try:
+            from parsec_tpu.native import NativeTraceBuffer, available
+            if available():
+                self._native = NativeTraceBuffer()
+        except Exception:   # toolchain missing: pure-Python path
+            self._native = None
 
     def trace(self, key: int, flags: int, taskpool_id: int, event_id: int,
               object_id: int = 0, info: Any = None,
               timestamp: Optional[float] = None) -> None:
+        ts = timestamp if timestamp is not None else time.perf_counter()
+        if info is None and self._native is not None:
+            self._native.event(key, flags, taskpool_id, event_id,
+                               object_id, ts)
+            return
         self.events.append((key, flags, taskpool_id, event_id, object_id,
-                            timestamp if timestamp is not None
-                            else time.perf_counter(), info))
+                            ts, info))
+
+    def merged_events(self) -> List[Tuple]:
+        """All events (native + python), timestamp-ordered."""
+        if self._native is None:
+            return list(self.events)
+        merged = [ev + (None,) for ev in self._native.drain()]
+        merged.extend(self.events)
+        merged.sort(key=lambda e: e[5])
+        return merged
 
 
 class Profile:
@@ -126,13 +153,14 @@ class Profile:
         with self._lock:
             streams = list(self._streams.values())
             dico = list(self._dict.values())
+        merged = {sb.stream_id: sb.merged_events() for sb in streams}
         buf = io.BytesIO()
         buf.write(MAGIC)
         meta = {
             "hr_id": self.hr_id,
             "info": self._info,
             "dictionary": [(ec.key, ec.name, ec.attributes) for ec in dico],
-            "streams": [(sb.stream_id, sb.name, len(sb.events))
+            "streams": [(sb.stream_id, sb.name, len(merged[sb.stream_id]))
                         for sb in streams],
         }
         mb = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
@@ -141,7 +169,7 @@ class Profile:
         for sb in streams:
             infos = {}
             for i, (key, flags, tp, eid, oid, ts, info) in \
-                    enumerate(sb.events):
+                    enumerate(merged[sb.stream_id]):
                 buf.write(_EV.pack(key, flags, tp, eid, oid, ts))
                 if info is not None:
                     infos[i] = info

@@ -16,7 +16,8 @@ import itertools
 import threading
 from typing import List, Optional
 
-from parsec_tpu.containers.lists import Dequeue, Lifo, OrderedList
+from parsec_tpu.containers.lists import (Dequeue, Lifo, OrderedList,
+                                          make_dequeue)
 from parsec_tpu.core.task import Task
 from parsec_tpu.sched import Scheduler, register
 from parsec_tpu.utils.mca import params
@@ -37,7 +38,7 @@ class _PerStream(Scheduler):
     def install(self, context):
         super().install(context)
         self._locals = {}
-        self._system = Dequeue()
+        self._system = make_dequeue()   # native-core backed when available
         # stats (reference: the display_stats hook, sched.h:299)
         self._n_local = 0
         self._n_steal = 0

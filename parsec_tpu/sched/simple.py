@@ -12,7 +12,7 @@ import random
 import threading
 from typing import List, Optional
 
-from parsec_tpu.containers.lists import Dequeue, OrderedList
+from parsec_tpu.containers.lists import Dequeue, OrderedList, make_dequeue
 from parsec_tpu.core.task import Task
 from parsec_tpu.sched import Scheduler, register
 
@@ -23,7 +23,7 @@ class GlobalDequeue(Scheduler):
 
     def install(self, context):
         super().install(context)
-        self._q = Dequeue()
+        self._q = make_dequeue()   # native-core backed when available
 
     def schedule(self, es, tasks, distance=0):
         self._q.chain_back(tasks)

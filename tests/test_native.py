@@ -1,0 +1,160 @@
+"""Native C++ runtime core tests (reference: the container unit tests of
+tests/class/ — lifo.c, hash.c, atomics.c multithreaded stress — applied
+to the ctypes-bound C++ primitives, plus parity with their Python twins
+and end-to-end runtime use)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("parsec_tpu.native")
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core did not build")
+
+
+def test_dequeue_order_and_identity():
+    d = native.NativeDequeue()
+    objs = [object() for _ in range(8)]
+    for o in objs:
+        d.push_back(o)
+    assert len(d) == 8
+    assert d.pop_front() is objs[0]
+    assert d.pop_back() is objs[-1]
+    d.push_front(objs[0])
+    assert d.pop_front() is objs[0]
+
+
+def test_dequeue_same_object_twice():
+    d = native.NativeDequeue()
+    o = object()
+    d.push_back(o)
+    d.push_back(o)
+    assert d.pop_front() is o and d.pop_front() is o and d.pop_front() is None
+
+
+def test_dequeue_mpmc_stress():
+    """Multithreaded producers/consumers: nothing lost, nothing doubled
+    (the tests/class/lifo.c pattern)."""
+    d = native.NativeDequeue()
+    N, NPROD = 2000, 4
+    seen = []
+    seen_lock = threading.Lock()
+    done = threading.Event()
+
+    def produce(base):
+        for i in range(N):
+            d.push_back(base + i)
+
+    def consume():
+        while not (done.is_set() and len(d) == 0):
+            v = d.pop_front()
+            if v is not None:
+                with seen_lock:
+                    seen.append(v)
+
+    cons = [threading.Thread(target=consume) for _ in range(3)]
+    for c in cons:
+        c.start()
+    prods = [threading.Thread(target=produce, args=(k * N,))
+             for k in range(NPROD)]
+    for p in prods:
+        p.start()
+    for p in prods:
+        p.join()
+    done.set()
+    for c in cons:
+        c.join(timeout=30)
+    assert sorted(seen) == sorted(k * N + i
+                                  for k in range(NPROD) for i in range(N))
+
+
+def test_zone_parity_with_python():
+    """The native allocator mirrors ZoneAllocator semantics exactly."""
+    from parsec_tpu.utils.zone_alloc import ZoneAllocator
+    py = ZoneAllocator(8192, 512)
+    cc = native.NativeZoneAllocator(8192, 512)
+    offs_py, offs_cc = [], []
+    for nbytes in (100, 512, 1024, 2048, 513):
+        offs_py.append(py.malloc(nbytes))
+        offs_cc.append(cc.malloc(nbytes))
+    assert offs_py == offs_cc
+    # free middle, realloc into the hole, coalesce checks
+    py.free(offs_py[2]); cc.free(offs_cc[2])
+    assert py.malloc(700) == cc.malloc(700)
+    assert py.used_bytes() == cc.used_bytes()
+    assert py.free_bytes() == cc.free_bytes()
+    with pytest.raises(ValueError):
+        cc.free(offs_cc[2] + 1 * 512 * 100)   # never-allocated offset
+
+
+def test_zone_exhaustion_and_defrag():
+    z = native.NativeZoneAllocator(2048, 512)
+    offs = [z.malloc(512) for _ in range(4)]
+    assert None not in offs
+    assert z.malloc(1) is None
+    for o in offs:
+        z.free(o)
+    assert z.check_defrag()
+    assert z.malloc(2048) == 0
+
+
+def test_trace_buffer_drain():
+    t = native.NativeTraceBuffer()
+    for i in range(100):
+        t.event(i, i & 3, 1, i, 0, float(i))
+    assert len(t) == 100
+    evs = t.drain()
+    assert evs[0] == (0, 0, 1, 0, 0, 0.0)
+    assert evs[99] == (99, 3, 1, 99, 0, 99.0)
+    assert t.drain(start=98) == evs[98:]
+
+
+def test_runtime_on_native_queues():
+    """A full PTG run with native system queues + native HBM zone budget
+    produces correct numerics (the integration seam)."""
+    from parsec_tpu.apps.gemm import gemm_taskpool
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.utils.mca import params
+
+    rng = np.random.default_rng(9)
+    n, mb = 64, 16
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="A").from_array(a)
+    B = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="B").from_array(b)
+    C = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="C").from_array(
+        np.zeros((n, n), np.float32))
+    params.set("device_mem_mb", 1)
+    params.set("native_queues", 1)
+    try:
+        with Context(nb_cores=2, scheduler="gd") as ctx:
+            assert type(ctx.scheduler._q).__name__ == "NativeDequeue"
+            ctx.add_taskpool(gemm_taskpool(A, B, C, device="tpu"))
+            ctx.wait(timeout=120)
+    finally:
+        params.unset("device_mem_mb")
+        params.unset("native_queues")
+    np.testing.assert_allclose(C.to_array(), a @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_native_trace_merges_with_info_events(tmp_path):
+    """StreamBuffer routes info-less events through the native buffer and
+    merges both sources in timestamp order at dump."""
+    from parsec_tpu.prof import profiling
+    from parsec_tpu.prof.reader import read_trace
+
+    prof = profiling.Profile("native-merge")
+    ec = prof.add_event_class("X")
+    sb = prof.stream(0, "s0")
+    sb.trace(ec.key, 1, 1, 1, timestamp=1.0)               # native
+    sb.trace(ec.key, 2, 1, 1, info={"k": 2}, timestamp=2.0)  # python
+    sb.trace(ec.key, 1, 1, 2, timestamp=3.0)               # native
+    if sb._native is not None:
+        assert len(sb.events) == 1 and len(sb._native) == 2
+    path = prof.dump(str(tmp_path / "m.ptt"))
+    _meta, df = read_trace(path)
+    assert list(df["ts"]) == [1.0, 2.0, 3.0]
+    assert df.iloc[1]["info"] == {"k": 2}
