@@ -44,17 +44,35 @@ TAG_BARRIER = 5
 TAG_DTD = 6       # distributed DTD data/flush traffic
 TAG_BATCH = 7     # aggregated same-destination messages [(tag, payload)...]
 TAG_UTRIG = 8     # user-trigger termination declaration
+TAG_PUT = 9       # one-sided put into a registered region
+TAG_GET1 = 10     # one-sided get request
+TAG_GET1_REP = 11
 TAG_USER = 16     # first tag available to applications
 
 _LEN = struct.Struct("!IQ")   # (tag, payload length)
 
 
 class CommEngine:
-    """Vtable (reference: parsec_comm_engine_t)."""
+    """Vtable (reference: parsec_comm_engine_t — AM tag register/send,
+    registered-memory one-sided put/get, pack/unpack, progress, sync,
+    capability flags parsec_comm_engine.h:161-183)."""
+
+    #: capability flags (reference: the CE capabilities the remote-dep
+    #: layer queries to pick eager vs rendezvous and threading mode)
+    CAP_ONESIDED = True     # put/get over registered regions
+    CAP_MT = True           # sends are thread-safe
 
     def __init__(self, rank: int, nranks: int):
         self.rank = rank
         self.nranks = nranks
+        #: registered memory regions: id -> writable numpy view
+        #: (reference: memory registration handles of ce.mem_register)
+        self._regions: Dict[int, Any] = {}
+        self._region_seq = 0
+        self._reg_lock = threading.Lock()
+        #: completion callbacks of outstanding one-sided ops
+        self._osc: Dict[int, Callable] = {}
+        self._osc_seq = 0
         self._callbacks: Dict[int, Callable] = {}
         #: messages for tags nobody registered yet — replayed on register
         #: (the reference posts persistent recvs per tag at init; here a
@@ -90,6 +108,127 @@ class CommEngine:
     def fini(self) -> None:
         pass
 
+    # -- pack/unpack (reference: ce.pack/unpack) ------------------------
+    @staticmethod
+    def pack(arr) -> dict:
+        """Serialize an array payload for the wire."""
+        import numpy as np
+        a = np.asarray(arr)
+        return {"buf": a.tobytes(), "dtype": a.dtype.str, "shape": a.shape}
+
+    @staticmethod
+    def unpack(msg: dict):
+        import numpy as np
+        return np.frombuffer(msg["buf"], dtype=np.dtype(msg["dtype"])) \
+            .reshape(msg["shape"]).copy()
+
+    # -- registered memory + one-sided put/get (reference: ce.mem_register
+    # / ce.put:793 / ce.get:896 of parsec_mpi_funnelled.c — emulated over
+    # two-sided AM exactly like the reference's MPI module) --------------
+    def mem_register(self, array) -> int:
+        """Expose a writable array to one-sided access; returns the
+        region handle peers name in put/get."""
+        with self._reg_lock:
+            self._region_seq += 1
+            rid = self._region_seq
+            self._regions[rid] = array
+        return rid
+
+    def mem_unregister(self, rid: int) -> None:
+        with self._reg_lock:
+            self._regions.pop(rid, None)
+
+    def _register_onesided(self) -> None:
+        """Wire the put/get emulation tags (called by subclasses once
+        transport recv is up)."""
+        self.tag_register(TAG_PUT, self._put_cb)
+        self.tag_register(TAG_GET1, self._get1_cb)
+        self.tag_register(TAG_GET1_REP, self._get1_rep_cb)
+
+    def put(self, dst: int, local_array, remote_rid: int,
+            on_complete: Optional[Callable] = None) -> None:
+        """Write ``local_array`` into peer ``dst``'s registered region;
+        ``on_complete(error=None)`` runs on the comm thread once the
+        remote copy landed — or failed (reference: mpi_no_thread_put)."""
+        with self._reg_lock:
+            self._osc_seq += 1
+            op = self._osc_seq
+            if on_complete is not None:
+                self._osc[op] = ("put", on_complete)
+        self.send_am(TAG_PUT, dst, {"rid": remote_rid, "op": op,
+                                    "from": self.rank,
+                                    **self.pack(local_array)})
+
+    def get(self, dst: int, remote_rid: int,
+            on_data: Callable) -> None:
+        """Fetch peer ``dst``'s registered region; ``on_data(array)``
+        runs on the comm thread (``None`` on failure; reference:
+        mpi_no_thread_get)."""
+        with self._reg_lock:
+            self._osc_seq += 1
+            op = self._osc_seq
+            self._osc[op] = ("get", on_data)
+        self.send_am(TAG_GET1, dst, {"rid": remote_rid, "op": op,
+                                     "from": self.rank})
+
+    def _osc_fail(self, dst: int, op: int, why: str) -> None:
+        """An op that cannot complete still gets a terminal reply — a
+        silent drop would leak the originator's callback and hang its
+        waiter."""
+        self.send_am(TAG_GET1_REP, dst, {"op": op, "error": why})
+
+    def _put_cb(self, src: int, msg: dict) -> None:
+        import numpy as np
+        # hold the lock across the copy: concurrent put/get on one
+        # region from different peer recv threads must not tear
+        with self._reg_lock:
+            target = self._regions.get(msg["rid"])
+            if target is not None:
+                tgt = np.asarray(target)
+                try:
+                    # zero-copy source view straight into the region
+                    src_view = np.frombuffer(
+                        msg["buf"],
+                        dtype=np.dtype(msg["dtype"])).reshape(tgt.shape)
+                    np.copyto(tgt, src_view)
+                except ValueError as exc:
+                    self._osc_fail(msg["from"], msg["op"], str(exc))
+                    return
+        if target is None:
+            warning("rank %d: PUT into unknown region %s", self.rank,
+                    msg["rid"])
+            self._osc_fail(msg["from"], msg["op"], "unknown region")
+            return
+        self.send_am(TAG_GET1_REP, msg["from"],
+                     {"op": msg["op"], "ack": True})
+
+    def _get1_cb(self, src: int, msg: dict) -> None:
+        with self._reg_lock:
+            target = self._regions.get(msg["rid"])
+            packed = self.pack(target) if target is not None else None
+        if packed is None:
+            warning("rank %d: GET of unknown region %s", self.rank,
+                    msg["rid"])
+            self._osc_fail(msg["from"], msg["op"], "unknown region")
+            return
+        self.send_am(TAG_GET1_REP, msg["from"],
+                     {"op": msg["op"], **packed})
+
+    def _get1_rep_cb(self, src: int, msg: dict) -> None:
+        with self._reg_lock:
+            ent = self._osc.pop(msg["op"], None)
+        if ent is None:
+            return
+        kind, cb = ent
+        err = msg.get("error")
+        if err is not None:
+            warning("rank %d: one-sided op %d failed at peer %d: %s",
+                    self.rank, msg["op"], src, err)
+        if kind == "put":
+            cb(err)
+        else:
+            cb(None if err is not None else self.unpack(msg))
+
     def _dispatch(self, tag: int, src: int, payload: Any) -> None:
         mark("recv tag=%d src=%d", tag, src)
         with self._cb_lock:
@@ -121,6 +260,7 @@ class SocketCE(CommEngine):
         self._bar_arrived: Dict[int, int] = {}
         self._bar_released: set = set()
         self.tag_register(TAG_BARRIER, self._barrier_cb)
+        self._register_onesided()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

@@ -242,6 +242,45 @@ class SymTwoDimBlockCyclic(TwoDimBlockCyclic):
         return super().data_of(m, n)
 
 
+class BandTwoDimBlockCyclic(TwoDimBlockCyclic):
+    """Band storage: only tiles within ``band_km`` of the diagonal exist
+    (reference: two_dim_rectangle_cyclic_band.c /
+    sym_two_dim_rectangle_cyclic_band.c — the *_band variants store the
+    band of a (symmetric) matrix; out-of-band tiles are not stored and
+    must not be addressed)."""
+
+    LOWER = SymTwoDimBlockCyclic.LOWER
+    UPPER = SymTwoDimBlockCyclic.UPPER
+
+    def __init__(self, *args, band_km: int = 1, uplo: Optional[int] = None,
+                 **kw):
+        super().__init__(*args, **kw)
+        self.band_km = band_km          # tiles kept each side of diagonal
+        self.uplo = uplo                # None=full band, LOWER, or UPPER
+
+    def tile_exists(self, m: int, n: int = 0) -> bool:
+        if not super().tile_exists(m, n):
+            return False
+        d = m - n
+        if self.uplo == self.LOWER and d < 0:   # below-diagonal only
+            return False
+        if self.uplo == self.UPPER and d > 0:
+            return False
+        return abs(d) <= self.band_km
+
+    def _check_band(self, m: int, n: int) -> None:
+        if not self.tile_exists(m, n):
+            raise KeyError(f"{self.name}({m},{n}) outside the stored band")
+
+    def rank_of(self, m: int, n: int = 0) -> int:
+        self._check_band(m, n)
+        return super().rank_of(m, n)
+
+    def data_of(self, m: int, n: int = 0) -> Data:
+        self._check_band(m, n)
+        return super().data_of(m, n)
+
+
 class TwoDimTabular(TiledMatrix):
     """Arbitrary tile->rank table (reference: two_dim_tabular.c)."""
 

@@ -29,6 +29,7 @@ tests/collections/reshape/):
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -118,13 +119,28 @@ class ReshapeCache:
         self.conversions = 0   # completed materializations (stats/tests)
 
     def get_copy(self, copy: DataCopy, dtt: Dtt) -> DataCopy:
-        """The converted counterpart of ``copy`` under ``dtt``."""
+        """The converted counterpart of ``copy`` under ``dtt``.
+
+        Lifetime: once materialized, the table keeps only WEAK references
+        — consumers hold the converted copy through their task bindings,
+        so the cache must not pin it (nor the source copy, which the
+        pending future's trigger closure holds) for the pool's lifetime
+        (reference: reshape promises are freed when the last consumer
+        used them, parsec_reshape.c / datacopy-future cleanup).  A later
+        consumer of the same (source, dtt) either hits the still-live
+        converted copy — identity-checked against the source to rule out
+        id() reuse — or pays a re-conversion."""
         if not needs_reshape(copy, dtt):
             return copy
         key = (id(copy), copy.version, dtt.key())
         with self._lock:
-            fut = self._futures.get(key)
-            if fut is None:
+            ent = self._futures.get(key)
+            if isinstance(ent, tuple):          # (weak dc, weak src)
+                dc, src = ent[0](), ent[1]()
+                if dc is not None and src is copy:
+                    return dc
+                ent = None
+            if ent is None:
                 def trigger(_spec, copy=copy, dtt=dtt):
                     self.conversions += 1
                     arr = convert(copy.payload, dtt)
@@ -136,9 +152,25 @@ class ReshapeCache:
                     dc.dtt = dtt
                     datum.attach_copy(dc)
                     return dc
-                fut = DataCopyFuture(trigger)
-                self._futures[key] = fut
-        return fut.get_copy()
+                ent = DataCopyFuture(trigger)
+                self._futures[key] = ent
+            fut = ent
+        dc = fut.get_copy()
+
+        def prune(_ref, key=key):
+            with self._lock:
+                ent = self._futures.get(key)
+                if isinstance(ent, tuple) and ent[0]() is None:
+                    del self._futures[key]
+
+        with self._lock:
+            if self._futures.get(key) is fut:
+                # materialized: drop the future and its source pin; the
+                # weakref callback prunes the dead entry so the table
+                # does not grow one tombstone per conversion forever
+                self._futures[key] = (weakref.ref(dc, prune),
+                                      weakref.ref(copy))
+        return dc
 
     def clear(self) -> None:
         with self._lock:

@@ -1,0 +1,133 @@
+"""Irregular-app tests: DTD merge sort, adaptive Haar tree, all2all, and
+band collections (reference: tests/apps/{merge_sort,haar_tree,all2all},
+data_dist/matrix *_band variants)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.dsl.dtd import DTDTaskpool
+
+
+def test_merge_sort_dtd():
+    """reference: tests/apps/merge_sort — leaf sorts + merge tree."""
+    from parsec_tpu.apps.trees import merge_sort_dtd
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal(1000).astype(np.float32)
+    with Context(nb_cores=4) as ctx:
+        tp = DTDTaskpool("msort")
+        ctx.add_taskpool(tp)
+        ctx.start()
+        out = merge_sort_dtd(tp, data, leaf=37)
+        tp.wait(timeout=60)
+        got = np.asarray(out.data.pull_to_host().payload)
+    np.testing.assert_allclose(got, np.sort(data))
+
+
+def test_haar_tree_dynamic_termination():
+    """reference: tests/apps/haar_tree project_dyn — tasks spawn tasks
+    at runtime; the user_trigger termdet ends the pool when the
+    algorithm (not a task count) says so."""
+    from parsec_tpu.apps.trees import HaarProjection
+
+    def f(x):
+        return np.where(x < 0.3, 0.0, np.where(x < 0.7, 1.0, 0.25))
+
+    proj = HaarProjection(f, eps=1e-3, min_width=1e-3)
+    with Context(nb_cores=4) as ctx:
+        tp = DTDTaskpool("haar")
+        tp.termdet_name = "user_trigger"
+        ctx.add_taskpool(tp)
+        ctx.start()
+        proj.run(tp)
+        tp.wait(timeout=60)
+        ctx.wait(timeout=60)
+    # adaptivity: refined near the jumps, coarse elsewhere
+    assert proj.nodes > 16, "tree never refined"
+    assert len(proj.leaves) >= 4
+    xs = np.linspace(0.05, 0.95, 400)
+    err = np.abs(proj.evaluate(xs) - f(xs))
+    assert np.mean(err) < 0.05, np.mean(err)
+
+
+def test_haar_tree_requires_user_trigger():
+    from parsec_tpu.apps.trees import HaarProjection
+    proj = HaarProjection(lambda x: x)
+    with Context(nb_cores=2) as ctx:
+        tp = DTDTaskpool("haar2")
+        ctx.add_taskpool(tp)
+        ctx.start()
+        with pytest.raises(ValueError, match="user_trigger"):
+            proj.run(tp)
+        tp.wait(timeout=30)
+
+
+def test_band_collection():
+    """reference: *_band.c — only band tiles stored/addressable."""
+    from parsec_tpu.data.matrix import BandTwoDimBlockCyclic
+    B = BandTwoDimBlockCyclic(mb=4, nb=4, lm=24, ln=24, band_km=1,
+                              name="B")
+    assert B.tile_exists(2, 2) and B.tile_exists(2, 1) and B.tile_exists(2, 3)
+    assert not B.tile_exists(0, 5) and not B.tile_exists(5, 0)
+    with pytest.raises(KeyError):
+        B.data_of(0, 4)
+    assert sorted(B.local_tiles()) == [
+        (m, n) for m in range(6) for n in range(6) if abs(m - n) <= 1]
+    # lower-band variant
+    L = BandTwoDimBlockCyclic(mb=4, nb=4, lm=24, ln=24, band_km=2, uplo=0,
+                              name="L")
+    assert L.tile_exists(3, 1) and not L.tile_exists(1, 3)
+    # tiles work end to end
+    B.data_of(1, 2).copy_on(0).payload[:] = 7.0
+    np.testing.assert_allclose(
+        np.asarray(B.data_of(1, 2).pull_to_host().payload), 7.0)
+
+
+def _all2all(ctx, rank, nranks):
+    """reference: tests/apps/all2all — every rank sends a distinct block
+    to every other rank (PTG over two distributions)."""
+    from parsec_tpu.data.matrix import TwoDimTabular
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+
+    mb = 4
+    # S(i,j) owned by rank i; R(i,j) owned by rank j: the (i,j) edge is
+    # an i->j message — all pairs = all-to-all
+    S = TwoDimTabular(mb=mb, nb=mb, lm=nranks * mb, ln=nranks * mb,
+                      table=[i for i in range(nranks)
+                             for _ in range(nranks)],
+                      nodes=nranks, myrank=rank, name="S")
+    R = TwoDimTabular(mb=mb, nb=mb, lm=nranks * mb, ln=nranks * mb,
+                      table=[j for _ in range(nranks)
+                             for j in range(nranks)],
+                      nodes=nranks, myrank=rank, name="R")
+    for i, j in S.local_tiles():
+        S.data_of(i, j).copy_on(0).payload[:] = 100.0 * i + j
+    for i, j in R.local_tiles():
+        R.data_of(i, j).copy_on(0).payload[:] = -1.0
+
+    p = PTG("a2a", N=nranks)
+    p.task("SEND", i=Range(0, nranks - 1), j=Range(0, nranks - 1)) \
+        .affinity(lambda i, j, S=S: S(i, j)) \
+        .flow("T", "READ",
+              IN(DATA(lambda i, j, S=S: S(i, j))),
+              OUT(TASK("RECV", "T", lambda i, j: dict(i=i, j=j)))) \
+        .body(lambda: None)
+    p.task("RECV", i=Range(0, nranks - 1), j=Range(0, nranks - 1)) \
+        .affinity(lambda i, j, R=R: R(i, j)) \
+        .flow("T", "READ", IN(TASK("SEND", "T", lambda i, j: dict(i=i, j=j)))) \
+        .flow("D", "RW",
+              IN(DATA(lambda i, j, R=R: R(i, j))),
+              OUT(DATA(lambda i, j, R=R: R(i, j)))) \
+        .body(lambda T, D: np.asarray(T).copy())
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=120)
+    for i, j in R.local_tiles():
+        got = np.asarray(R.data_of(i, j).pull_to_host().payload)
+        np.testing.assert_allclose(got, 100.0 * i + j,
+                                   err_msg=f"R({i},{j}) on rank {rank}")
+    return "ok"
+
+
+def test_all2all_4ranks():
+    from parsec_tpu.comm.launch import run_distributed
+    assert run_distributed(_all2all, 4, timeout=240) == ["ok"] * 4

@@ -268,3 +268,64 @@ def _many_small_msgs(ctx, rank, nranks):
 
 def test_funnelled_many_small_messages():
     assert run_distributed(_many_small_msgs, 3, timeout=240) == ["ok"] * 3
+
+
+# -- CE one-sided put/get over registered memory (reference:
+# dtd_test_ce.c drives the comm-engine vtable directly: AM + put/get;
+# mpi_no_thread_put:793 / get:896) -----------------------------------------
+
+def _ce_onesided(ctx, rank, nranks):
+    import threading
+    import numpy as np
+    ce = ctx.comm.ce
+    assert ce.CAP_ONESIDED and ce.CAP_MT
+    # each rank registers a region; peers write and read it one-sidedly
+    mine = np.zeros(8, np.float32)
+    rid = ce.mem_register(mine)
+    # exchange region ids (they happen to be equal, but don't assume)
+    rids = [None] * nranks
+    got_rids = threading.Event()
+    from parsec_tpu.comm.engine import TAG_USER
+
+    def rid_cb(src, payload):
+        rids[src] = payload
+        if all(r is not None for r in rids):
+            got_rids.set()
+
+    ce.tag_register(TAG_USER, rid_cb)
+    ce.barrier()
+    for r in range(nranks):
+        ce.send_am(TAG_USER, r, rid)
+    assert got_rids.wait(30)
+
+    # PUT: write my pattern into my right neighbor's region
+    right = (rank + 1) % nranks
+    acked = threading.Event()
+    errs = []
+    ce.put(right, np.full(8, 10.0 + rank, np.float32), rids[right],
+           on_complete=lambda err=None: (errs.append(err) if err else None,
+                                         acked.set()))
+    assert acked.wait(30)
+    assert not errs, errs
+    ce.barrier()
+    np.testing.assert_allclose(mine, 10.0 + (rank - 1) % nranks)
+
+    # GET: read my left neighbor's region back
+    left = (rank - 1) % nranks
+    box = {}
+    fetched = threading.Event()
+
+    def on_data(arr):
+        box["arr"] = arr
+        fetched.set()
+
+    ce.get(left, rids[left], on_data)
+    assert fetched.wait(30)
+    np.testing.assert_allclose(box["arr"], 10.0 + (left - 1) % nranks)
+    ce.barrier()
+    ce.mem_unregister(rid)
+    return "ok"
+
+
+def test_ce_onesided_put_get():
+    assert run_distributed(_ce_onesided, 3) == ["ok"] * 3
