@@ -32,6 +32,8 @@ def merge_sort_dtd(tp: DTDTaskpool, data: np.ndarray,
     returns the tile holding the fully sorted result (read it after
     ``tp.wait()``)."""
     n = len(data)
+    if n == 0:
+        return tp.tile_new((0,), dtype=data.dtype)
     level: List = []
     # leaves: sort each chunk in place
     for lo in range(0, n, leaf):

@@ -52,6 +52,30 @@ TAG_USER = 16     # first tag available to applications
 _LEN = struct.Struct("!IQ")   # (tag, payload length)
 
 
+def wire_dtype(dtype) -> str:
+    """A dtype string that round-trips over the wire.  Extension dtypes
+    (ml_dtypes bfloat16 & friends) have a ``.str`` of raw void bytes —
+    their NAME is the parseable spelling."""
+    import numpy as _np
+    dt = _np.dtype(dtype)
+    s = dt.str
+    try:
+        if _np.dtype(s) == dt:
+            return s
+    except TypeError:
+        pass
+    return dt.name
+
+
+def parse_dtype(spec: str):
+    import numpy as _np
+    try:
+        return _np.dtype(spec)
+    except TypeError:
+        import ml_dtypes  # noqa: F401  (registers bfloat16 et al.)
+        return _np.dtype(spec)
+
+
 class CommEngine:
     """Vtable (reference: parsec_comm_engine_t — AM tag register/send,
     registered-memory one-sided put/get, pack/unpack, progress, sync,
@@ -114,12 +138,13 @@ class CommEngine:
         """Serialize an array payload for the wire."""
         import numpy as np
         a = np.asarray(arr)
-        return {"buf": a.tobytes(), "dtype": a.dtype.str, "shape": a.shape}
+        return {"buf": a.tobytes(), "dtype": wire_dtype(a.dtype),
+                "shape": a.shape}
 
     @staticmethod
     def unpack(msg: dict):
         import numpy as np
-        return np.frombuffer(msg["buf"], dtype=np.dtype(msg["dtype"])) \
+        return np.frombuffer(msg["buf"], dtype=parse_dtype(msg["dtype"])) \
             .reshape(msg["shape"]).copy()
 
     # -- registered memory + one-sided put/get (reference: ce.mem_register
@@ -189,9 +214,9 @@ class CommEngine:
                     # zero-copy source view straight into the region
                     src_view = np.frombuffer(
                         msg["buf"],
-                        dtype=np.dtype(msg["dtype"])).reshape(tgt.shape)
+                        dtype=parse_dtype(msg["dtype"])).reshape(tgt.shape)
                     np.copyto(tgt, src_view)
-                except ValueError as exc:
+                except (TypeError, ValueError) as exc:
                     self._osc_fail(msg["from"], msg["op"], str(exc))
                     return
         if target is None:

@@ -50,12 +50,12 @@ _handle_seq = itertools.count(1)
 
 
 def _encode(arr) -> Tuple[bytes, str, Tuple[int, ...]]:
-    a = np.asarray(arr)
-    return a.tobytes(), a.dtype.str, a.shape
+    p = CommEngine.pack(arr)
+    return p["buf"], p["dtype"], p["shape"]
 
 
 def _decode(buf: bytes, dtype: str, shape) -> np.ndarray:
-    return np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape).copy()
+    return CommEngine.unpack({"buf": buf, "dtype": dtype, "shape": shape})
 
 
 params.register("comm_handle_timeout", 600.0,

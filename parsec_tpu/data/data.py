@@ -191,6 +191,33 @@ class Data:
             self._version_clock += 1
             c.version = self._version_clock
 
+    def overwrite_host(self, arr) -> "DataCopy":
+        """Land ``arr`` as the NEW authoritative host value: write in
+        place when the host buffer matches (collection backing views
+        stay linked), invalidate every other copy, bump the version
+        clock.  The one sanctioned externally-sourced write — network
+        payloads, checkpoint restore — so the coherency transition lives
+        here, not in every caller."""
+        import numpy as _np
+        a = _np.asarray(arr)
+        with self._lock:
+            host = self._copies.get(0)
+            if host is None:
+                host = self.create_copy(0, payload=a.copy())
+            elif isinstance(host.payload, _np.ndarray) and \
+                    host.payload.shape == a.shape and \
+                    host.payload.dtype == a.dtype:
+                _np.copyto(host.payload, a)
+            else:
+                host.payload = a.copy()
+            for c in self._copies.values():
+                if c is not host:
+                    c.coherency = Coherency.INVALID
+            self._version_clock += 1
+            host.version = self._version_clock
+            host.coherency = Coherency.EXCLUSIVE
+            return host
+
     def pull_to_host(self) -> Optional[DataCopy]:
         """Make the host copy current WITHOUT stealing ownership: the
         newest device copy stays valid (EXCLUSIVE degrades to OWNED) so

@@ -116,7 +116,19 @@ class ReshapeCache:
     def __init__(self):
         self._futures: Dict[Tuple, DataCopyFuture] = {}
         self._lock = threading.Lock()
+        #: keys whose converted copy died; the GC-triggered weakref
+        #: callback must NOT take _lock (the cycle collector can run
+        #: while this thread already holds it), so it only appends here
+        #: (list.append is atomic) and lookups drain the list under lock
+        self._dead: list = []
         self.conversions = 0   # completed materializations (stats/tests)
+
+    def _drain_dead_locked(self) -> None:
+        while self._dead:
+            key = self._dead.pop()
+            ent = self._futures.get(key)
+            if isinstance(ent, tuple) and ent[0]() is None:
+                del self._futures[key]
 
     def get_copy(self, copy: DataCopy, dtt: Dtt) -> DataCopy:
         """The converted counterpart of ``copy`` under ``dtt``.
@@ -134,6 +146,7 @@ class ReshapeCache:
             return copy
         key = (id(copy), copy.version, dtt.key())
         with self._lock:
+            self._drain_dead_locked()
             ent = self._futures.get(key)
             if isinstance(ent, tuple):          # (weak dc, weak src)
                 dc, src = ent[0](), ent[1]()
@@ -158,16 +171,13 @@ class ReshapeCache:
         dc = fut.get_copy()
 
         def prune(_ref, key=key):
-            with self._lock:
-                ent = self._futures.get(key)
-                if isinstance(ent, tuple) and ent[0]() is None:
-                    del self._futures[key]
+            self._dead.append(key)   # lock-free; drained under _lock
 
         with self._lock:
             if self._futures.get(key) is fut:
                 # materialized: drop the future and its source pin; the
-                # weakref callback prunes the dead entry so the table
-                # does not grow one tombstone per conversion forever
+                # weakref callback queues the dead entry for pruning so
+                # the table does not grow one tombstone per conversion
                 self._futures[key] = (weakref.ref(dc, prune),
                                       weakref.ref(copy))
         return dc
@@ -175,3 +185,4 @@ class ReshapeCache:
     def clear(self) -> None:
         with self._lock:
             self._futures.clear()
+            self._dead.clear()

@@ -52,6 +52,10 @@ params.register("device_mem_mb", 0,
                 "device copy-cache capacity in MiB (0 = unlimited)")
 params.register("device_donate", 1,
                 "donate written-flow input buffers to XLA (TPU/GPU only)")
+params.register("device_max_faults", 0,
+                "disable a device after this many launch faults and fall "
+                "back to other incarnations (0 = fail the context, like "
+                "an unguarded run; reference: HOOK_RETURN_DISABLE)")
 
 
 class XlaKernel:
@@ -236,12 +240,47 @@ class XlaDevice(Device):
                 from parsec_tpu.core import scheduling
                 self.stats.faults += 1
                 self.load_sub(load)
+                if self._degrade(task, exc):
+                    continue
                 self.es.context.record_error(exc, task)
                 scheduling.complete_execution(self.es, task, failed=True)
             finally:
                 with self._cond:
                     self._launching -= 1
                     self._cond.notify_all()
+
+    def _degrade(self, task: Task, exc: Exception) -> bool:
+        """Degraded mode (the reference's ONLY fault tolerance: device
+        errors disable the device and push tasks back to the CPU
+        incarnation, PARSEC_HOOK_RETURN_DISABLE /
+        device_cuda_module.c:2757-2762).  After ``device_max_faults``
+        launch failures the device disables itself and the failing task
+        — plus everything still queued here — reschedules to fall
+        through to the next incarnation.  Returns True when the task was
+        rescued."""
+        limit = int(params.get("device_max_faults", 0))
+        if limit <= 0 or self.es is None:
+            return False      # unguarded: the fault fails the context
+        from parsec_tpu.core import scheduling
+        from parsec_tpu.utils.output import warning
+        rescued = [task]
+        with self._cond:
+            if self.stats.faults >= limit and self.enabled:
+                # past the limit: stop taking work and drain the queue
+                # back to the scheduler for other incarnations
+                self.enabled = False
+                warning("device %s disabled after %d faults (%s); "
+                        "falling back to other incarnations", self.name,
+                        self.stats.faults, exc)
+            if not self.enabled:
+                while self._pending:
+                    qtask, _spec, qload = self._pending.popleft()
+                    self.load_sub(qload)
+                    rescued.append(qtask)
+        for t in rescued:
+            t.status = scheduling.TaskStatus.READY
+        scheduling.schedule(self.es, rescued)
+        return True
 
     def _launch(self, task: Task, spec: XlaKernel, load: float) -> None:
         tc = task.task_class

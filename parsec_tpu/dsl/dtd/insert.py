@@ -45,23 +45,9 @@ from parsec_tpu.utils.mca import params
 
 
 def _apply_payload(datum: Data, arr: np.ndarray) -> None:
-    """Land a network payload as the datum's new authoritative host value
-    (in place when possible, so collection backing views stay linked)."""
-    with datum._lock:
-        host = datum.copy_on(0)
-        if host is None:
-            host = datum.create_copy(0, payload=np.array(arr, copy=True))
-        elif isinstance(host.payload, np.ndarray) and \
-                host.payload.shape == arr.shape:
-            np.copyto(host.payload, arr)
-        else:
-            host.payload = np.array(arr, copy=True)
-        for c in datum.copies().values():
-            if c is not host:
-                c.coherency = Coherency.INVALID
-        datum._version_clock += 1
-        host.version = datum._version_clock
-        host.coherency = Coherency.EXCLUSIVE
+    """Land a network payload as the datum's new authoritative host
+    value (the coherency transition lives in Data.overwrite_host)."""
+    datum.overwrite_host(arr)
 
 params.register("dtd_window_size", 2048,
                 "max in-flight DTD tasks before insert_task throttles")
@@ -595,19 +581,19 @@ class DTDTaskpool(Taskpool):
 
     def _wire_msg(self, kind: str, tile: DTDTile, ver: int) -> dict:
         """Encode a tile payload message (pulls the tile home first)."""
+        from parsec_tpu.comm.engine import CommEngine
         copy = tile.data.pull_to_host()
-        arr = np.asarray(copy.payload)
         return {"tp": self.taskpool_id, "kind": kind,
-                "tile": tile.wire_key, "ver": ver, "buf": arr.tobytes(),
-                "dtype": arr.dtype.str, "shape": arr.shape}
+                "tile": tile.wire_key, "ver": ver,
+                **CommEngine.pack(copy.payload)}
 
     def _send_payload(self, dst: int, tile: DTDTile, ver: int) -> None:
         self.context.comm.dtd_send(dst, self._wire_msg("data", tile, ver))
 
     def _dtd_incoming(self, src: int, msg: dict) -> None:
         """Comm-thread entry for DTD payload/flush messages."""
-        arr = np.frombuffer(msg["buf"], dtype=np.dtype(msg["dtype"])) \
-            .reshape(msg["shape"]).copy()
+        from parsec_tpu.comm.engine import CommEngine
+        arr = CommEngine.unpack(msg)
         wire = tuple(msg["tile"])
         if msg["kind"] == "data":
             key = (wire, msg["ver"])

@@ -1,0 +1,98 @@
+"""Checkpoint / restore of collection state.
+
+The reference has NO runtime checkpointing (SURVEY §5.4: taskpool state
+is never serialized; the closest mechanisms are completion callbacks and
+data flush).  This layer implements the design SURVEY prescribes for the
+TPU build: quiesce (pools complete, device pipelines drained, comm
+settled), flush every authoritative device copy home, then snapshot the
+collections' local tiles — one file per rank, restorable into freshly
+built collections of the same shape/distribution.
+
+Usage::
+
+    ctx.wait()                                   # quiesce the DAGs
+    checkpoint(ctx, [A, B, C], "/path/ckpt")     # rank-local snapshot
+    ...
+    restore(ctx, [A, B, C], "/path/ckpt")        # tiles + versions back
+
+Checkpoint/restore are collective when a comm engine is attached: every
+rank writes/reads its own shard and a barrier delimits the snapshot so
+no rank can race ahead into mutating state another rank still saves.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List
+
+import numpy as np
+
+from parsec_tpu.utils.output import debug_verbose
+
+FORMAT_VERSION = 1
+
+
+def _rank_path(context, path: str) -> str:
+    rank = context.rank if context is not None else 0
+    return f"{path}.r{rank}.npz"
+
+
+def checkpoint(context, collections: Iterable, path: str) -> str:
+    """Snapshot every local tile of ``collections`` (host-authoritative:
+    device copies are flushed home first).  Returns the rank-local file.
+    Call after ``context.wait()`` — a checkpoint of a running DAG is a
+    torn checkpoint."""
+    # drain device pipelines and push authoritative copies home
+    for d in context.device_registry.accelerators:
+        dsync = getattr(d, "sync", None)
+        if dsync is not None:
+            dsync()
+    context.device_registry.flush_all()
+    arrays = {}
+    meta = {"format": FORMAT_VERSION, "rank": context.rank,
+            "nranks": context.nranks}
+    for dc in collections:
+        for idx in dc.local_tiles():
+            datum = dc.data_of(*idx)
+            copy = datum.pull_to_host()
+            key = ":".join([dc.name] + [str(i) for i in idx])
+            arrays[key] = np.asarray(copy.payload)
+    out = _rank_path(context, path)
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    np.savez(out, __meta__=np.array([meta["format"], meta["rank"],
+                                     meta["nranks"]]), **arrays)
+    if context.comm is not None:
+        context.comm.ce.barrier()    # the snapshot is collective
+    debug_verbose(3, "checkpoint: %d tiles -> %s", len(arrays), out)
+    return out
+
+
+def restore(context, collections: Iterable, path: str) -> int:
+    """Load a snapshot back into ``collections`` (same shapes and
+    distribution as at checkpoint time).  Host copies become the newest
+    authoritative version; stale device copies invalidate.  Returns the
+    number of tiles restored."""
+    src = _rank_path(context, path)
+    with np.load(src, allow_pickle=False) as zf:
+        meta = zf["__meta__"]
+        if int(meta[0]) != FORMAT_VERSION:
+            raise ValueError(f"{src}: unsupported checkpoint format "
+                             f"{int(meta[0])}")
+        if int(meta[2]) != context.nranks:
+            raise ValueError(
+                f"{src}: checkpoint was taken on {int(meta[2])} ranks, "
+                f"restoring on {context.nranks} (elastic restore is not "
+                "supported — match the layout)")
+        n = 0
+        for dc in collections:
+            for idx in dc.local_tiles():
+                key = ":".join([dc.name] + [str(i) for i in idx])
+                if key not in zf:
+                    raise KeyError(f"{src}: missing tile {key}")
+                datum = dc.data_of(*idx)
+                datum.overwrite_host(zf[key])
+                n += 1
+    if context.comm is not None:
+        context.comm.ce.barrier()
+    debug_verbose(3, "restore: %d tiles <- %s", n, src)
+    return n

@@ -1,0 +1,112 @@
+"""Checkpoint/restore tests (SURVEY §5.4: the reference has none; the
+TPU build snapshots collections after a quiesce — flush + termdet — and
+restores them byte-exact, single-rank and collectively)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.launch import run_distributed
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TwoDimBlockCyclic, VectorTwoDimCyclic
+from parsec_tpu.utils.checkpoint import checkpoint, restore
+
+
+def _inc_pool(V, NT):
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range
+    p = PTG("inc", NT=NT)
+    p.task("T", k=Range(0, NT - 1)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("X", "RW",
+              IN(DATA(lambda k, V=V: V(k))),
+              OUT(DATA(lambda k, V=V: V(k)))) \
+        .body(lambda X: X + 1.0)
+    return p.build()
+
+
+def test_checkpoint_roundtrip_mid_computation(tmp_path):
+    """Run a step, checkpoint, run more steps, restore — the state is
+    byte-exact back at the checkpoint and the DAG resumes from there."""
+    NT = 4
+    V = VectorTwoDimCyclic(mb=8, lm=8 * NT)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = float(m)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(_inc_pool(V, NT))
+        ctx.wait(timeout=60)
+        path = checkpoint(ctx, [V], str(tmp_path / "ck"))
+        # diverge: two more steps
+        for _ in range(2):
+            ctx.add_taskpool(_inc_pool(V, NT))
+            ctx.wait(timeout=60)
+        for m in range(NT):
+            np.testing.assert_allclose(
+                np.asarray(V.data_of(m).pull_to_host().payload), m + 3.0)
+        # rewind and resume
+        assert restore(ctx, [V], str(tmp_path / "ck")) == NT
+        for m in range(NT):
+            np.testing.assert_allclose(
+                np.asarray(V.data_of(m).pull_to_host().payload), m + 1.0)
+        ctx.add_taskpool(_inc_pool(V, NT))
+        ctx.wait(timeout=60)
+    for m in range(NT):
+        np.testing.assert_allclose(
+            np.asarray(V.data_of(m).pull_to_host().payload), m + 2.0)
+    assert path.endswith(".r0.npz")
+
+
+def test_checkpoint_device_state_flushes_home(tmp_path):
+    """Tiles resident on the accelerator at checkpoint time land in the
+    snapshot (the flush half of the quiesce contract)."""
+    from parsec_tpu.apps.gemm import gemm_taskpool
+    rng = np.random.default_rng(4)
+    n, mb = 64, 32
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="A").from_array(a)
+    B = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="B").from_array(b)
+    C = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="C").from_array(
+        np.zeros((n, n), np.float32))
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(gemm_taskpool(A, B, C, device="tpu"))
+        ctx.wait(timeout=120)
+        checkpoint(ctx, [C], str(tmp_path / "gemm"))
+        # wreck the host state, restore, verify
+        for m, nn in C.local_tiles():
+            np.asarray(C.data_of(m, nn).pull_to_host().payload)[:] = -1.0
+        restore(ctx, [C], str(tmp_path / "gemm"))
+    np.testing.assert_allclose(C.to_array(), a @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_restore_rejects_mismatched_layout(tmp_path):
+    V = VectorTwoDimCyclic(mb=4, lm=8)
+    with Context(nb_cores=1) as ctx:
+        checkpoint(ctx, [V], str(tmp_path / "x"))
+        import numpy as np_
+        # forge a wrong-nranks meta
+        src = str(tmp_path / "x") + ".r0.npz"
+        data = dict(np_.load(src, allow_pickle=False))
+        data["__meta__"] = np_.array([1, 0, 4])
+        np_.savez(src.replace(".npz", ""), **data)
+        with pytest.raises(ValueError, match="4 ranks"):
+            restore(ctx, [V], str(tmp_path / "x"))
+
+
+def _dist_ckpt(ctx, rank, nranks, path):
+    V = VectorTwoDimCyclic(mb=4, lm=4 * nranks * 2, nodes=nranks,
+                           myrank=rank)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 10.0 * rank + m
+    checkpoint(ctx, [V], path)
+    for m, _ in V.local_tiles():
+        np.asarray(V.data_of(m).pull_to_host().payload)[:] = -5.0
+    restore(ctx, [V], path)
+    for m, _ in V.local_tiles():
+        np.testing.assert_allclose(
+            np.asarray(V.data_of(m).pull_to_host().payload),
+            10.0 * rank + m)
+    return "ok"
+
+
+def test_checkpoint_distributed(tmp_path):
+    path = str(tmp_path / "dck")
+    assert run_distributed(_dist_ckpt, 3, args=(path,)) == ["ok"] * 3

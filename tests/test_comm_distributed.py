@@ -329,3 +329,60 @@ def _ce_onesided(ctx, rank, nranks):
 
 def test_ce_onesided_put_get():
     assert run_distributed(_ce_onesided, 3) == ["ok"] * 3
+
+
+# -- remote reshape: the pre-send conversion path (reference:
+# parsec_reshape.c remote paths; tests/collections/reshape/) ---------------
+
+def _remote_reshape(ctx, rank, nranks):
+    import ml_dtypes
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.data.reshape import Dtt
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+
+    NT = 4
+    V = VectorTwoDimCyclic(mb=8, lm=8 * NT, nodes=nranks, myrank=rank)
+    W = VectorTwoDimCyclic(mb=8, lm=8 * NT, nodes=nranks, myrank=rank,
+                           name="W")
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 1.5 + m
+    for m, _ in W.local_tiles():
+        W.data_of(m).copy_on(0).payload[:] = 0.0
+    seen = {}
+
+    bf16 = Dtt(dtype=ml_dtypes.bfloat16, name="bf16")
+    p = PTG("rrs", NT=NT)
+    # P(k) on V(k)'s rank ships its tile to C(k) on W(k+1 mod NT)'s rank
+    # with a bf16 edge dtt: the CONVERTED payload travels (half the
+    # bytes), and the consumer observes bf16
+    p.task("P", k=Range(0, NT - 1)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("T", "READ",
+              IN(DATA(lambda k, V=V: V(k))),
+              OUT(TASK("C", "T", lambda k: dict(k=k)), dtt=bf16)) \
+        .body(lambda: None)
+    p.task("C", k=Range(0, NT - 1)) \
+        .affinity(lambda k, W=W, NT=NT: W((k + 1) % NT)) \
+        .flow("T", "READ", IN(TASK("P", "T", lambda k: dict(k=k)))) \
+        .flow("O", "RW",
+              IN(DATA(lambda k, W=W, NT=NT: W((k + 1) % NT))),
+              OUT(DATA(lambda k, W=W, NT=NT: W((k + 1) % NT)))) \
+        .body(lambda T, O, k, seen=seen: (
+            seen.__setitem__(k, str(np.asarray(T).dtype)),
+            np.asarray(T).astype(np.float32) * 2.0)[1])
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=120)
+    for m, _ in W.local_tiles():
+        k = (m - 1) % NT
+        got = np.asarray(W.data_of(m).pull_to_host().payload)
+        expect = 2.0 * np.asarray(
+            np.full(8, 1.5 + k, np.float32).astype(ml_dtypes.bfloat16),
+            dtype=np.float32)
+        np.testing.assert_allclose(got, expect)
+    # every consumer this rank ran saw a bf16 payload
+    assert all(dt == "bfloat16" for dt in seen.values()), seen
+    return "ok"
+
+
+def test_remote_presend_reshape():
+    assert run_distributed(_remote_reshape, 2) == ["ok"] * 2

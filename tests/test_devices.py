@@ -194,3 +194,45 @@ def test_best_device_load_balance():
         ctx.wait()
         used = sum(1 for d in accs if d.stats.executed_tasks > 0)
         assert used >= 2
+
+
+def test_device_fault_degrades_to_cpu():
+    """Degraded mode (reference: device_cuda_module.c:2757-2762 — GPU
+    errors disable the device and tasks fall back to the CPU
+    incarnation, the reference's only fault tolerance)."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range
+    from parsec_tpu.utils.mca import params
+
+    NT = 6
+    V = VectorTwoDimCyclic(mb=4, lm=4 * NT)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 1.0
+
+    def bad_kernel(X):
+        raise RuntimeError("injected device fault")
+
+    params.set("device_max_faults", 2)
+    try:
+        with Context(nb_cores=2) as ctx:
+            if not ctx.device_registry.accelerators:
+                pytest.skip("no accelerator attached")
+            p = PTG("faulty", NT=NT)
+            tb = p.task("T", k=Range(0, NT - 1)) \
+                .affinity(lambda k, V=V: V(k)) \
+                .flow("X", "RW",
+                      IN(DATA(lambda k, V=V: V(k))),
+                      OUT(DATA(lambda k, V=V: V(k))))
+            tb.body(bad_kernel, device="tpu")
+            tb.body(lambda X: X + 1.0)          # the CPU fallback
+            ctx.add_taskpool(p.build())
+            ctx.wait(timeout=120)
+            dev = ctx.device_registry.devices[1]
+            assert not dev.enabled
+            assert dev.stats.faults >= 2
+    finally:
+        params.unset("device_max_faults")
+    for m in range(NT):
+        np.testing.assert_allclose(
+            np.asarray(V.data_of(m).pull_to_host().payload), 2.0)
