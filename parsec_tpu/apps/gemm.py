@@ -31,7 +31,20 @@ _kernels = {}
 
 def _tile_kernel(alpha: float, precision=None):
     """Accumulation step of the k-chain: Ci += alpha * Ai@Bi.
-    (beta is applied once by the SCALE task class, not per step.)"""
+    (beta is applied once by the SCALE task class, not per step.)
+
+    ``--mca gemm_pallas 1`` swaps in the hand-written Pallas blocked
+    kernel (apps/pallas_kernels.py) — the user-kernel seam the reference
+    fills with BODY [type=CUDA] bodies."""
+    from parsec_tpu.apps.pallas_kernels import (pallas_gemm_tile,
+                                                use_pallas_gemm)
+    if use_pallas_gemm():
+        key = ("pallas", alpha, precision)
+        fn = _kernels.get(key)
+        if fn is None:
+            fn = _kernels[key] = pallas_gemm_tile(alpha,
+                                                  precision=precision)
+        return fn
     key = (alpha, precision)
     fn = _kernels.get(key)
     if fn is None:

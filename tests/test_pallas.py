@@ -1,0 +1,79 @@
+"""Pallas tile-kernel tests (the user-kernel seam; reference: the BODY
+[type=CUDA] incarnations + tests/dsl/ptg/cuda/stress.jdf pattern).
+Off-TPU the kernels run in interpreter mode via the same entry points."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.apps.pallas_kernels import pallas_gemm_tile
+from parsec_tpu.utils.mca import params
+
+
+def _rel_err(got, ref):
+    return np.abs(got - ref).max() / max(1.0, np.abs(ref).max())
+
+
+def test_pallas_blocked_matmul_matches():
+    """bf16 panels + f32 accumulator through the blocked Pallas program."""
+    import jax
+    import ml_dtypes
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((256, 256)).astype(ml_dtypes.bfloat16)
+    b = rng.standard_normal((256, 256)).astype(ml_dtypes.bfloat16)
+    c = rng.standard_normal((256, 256)).astype(np.float32)
+    fn = pallas_gemm_tile(1.0, bm=128, bn=128, bk=128)
+    got = np.asarray(jax.jit(fn)(a, b, c))
+    ref = c + np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    assert _rel_err(got, ref) < 1e-3
+
+
+def test_pallas_alpha_and_fallback():
+    """Unaligned shapes (not multiples of 128) must take the fused-XLA
+    fallback — Mosaic rejects such blocks — with alpha honored (TPU's
+    default matmul precision is bf16, hence the tolerance)."""
+    import jax
+    rng = np.random.default_rng(1)
+    for n in (100, 640 + 8):     # sub-block unaligned; super-block too
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        got = np.asarray(jax.jit(pallas_gemm_tile(2.0))(a, a, a))
+        ref = a + 2.0 * a @ a
+        assert _rel_err(got, ref) < 5e-2
+    # precision='highest' on the fallback forces f32 multiplies
+    a = rng.standard_normal((100, 100)).astype(np.float32)
+    got = np.asarray(jax.jit(
+        pallas_gemm_tile(1.0, precision="highest"))(a, a, a))
+    assert _rel_err(got, a + a @ a) < 1e-5
+
+
+def test_gemm_taskpool_with_pallas_kernel():
+    """The full runtime path with --mca gemm_pallas 1: every device GEMM
+    task runs the hand-written kernel."""
+    from parsec_tpu.apps import gemm as gemm_mod
+    from parsec_tpu.apps.gemm import gemm_taskpool
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    rng = np.random.default_rng(2)
+    n, mb = 256, 128
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="A").from_array(a)
+    B = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="B").from_array(b)
+    C = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="C").from_array(
+        np.zeros((n, n), np.float32))
+    params.set("gemm_pallas", 1)
+    gemm_mod._kernels.clear()      # force kernel re-selection
+    try:
+        with Context(nb_cores=2) as ctx:
+            if not ctx.device_registry.accelerators:
+                pytest.skip("no accelerator attached")
+            ctx.add_taskpool(gemm_taskpool(A, B, C, device="tpu"))
+            ctx.wait(timeout=300)
+        # the switch actually selected the Pallas kernel (a silently
+        # broken param would still produce correct numerics via XLA)
+        assert any(isinstance(k, tuple) and k and k[0] == "pallas"
+                   for k in gemm_mod._kernels), gemm_mod._kernels.keys()
+    finally:
+        params.unset("gemm_pallas")
+        gemm_mod._kernels.clear()
+    assert _rel_err(C.to_array(), a @ b) < 5e-2
