@@ -37,7 +37,8 @@ params.register("gemm_pallas", 0,
 
 def _interpret() -> bool:
     import jax
-    return jax.devices()[0].platform not in ("tpu",)
+    # "axon" is the tunneled-TPU PJRT platform name (devices/xla.py)
+    return jax.devices()[0].platform not in ("tpu", "axon")
 
 
 @functools.lru_cache(maxsize=None)

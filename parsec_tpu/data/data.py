@@ -191,6 +191,26 @@ class Data:
             self._version_clock += 1
             c.version = self._version_clock
 
+    def overwrite_on(self, space: int, payload) -> "DataCopy":
+        """Land ``payload`` (an already-materialized buffer — e.g. a
+        device array) as the NEW authoritative copy on ``space``: every
+        other copy invalidates, the version clock bumps.  The device-
+        space sibling of :meth:`overwrite_host`, keeping the write
+        transition in Data rather than in every caller."""
+        with self._lock:
+            dc = self._copies.get(space)
+            if dc is None:
+                dc = self.create_copy(space, payload=payload)
+            else:
+                dc.payload = payload
+            for c in self._copies.values():
+                if c is not dc:
+                    c.coherency = Coherency.INVALID
+            self._version_clock += 1
+            dc.version = self._version_clock
+            dc.coherency = Coherency.EXCLUSIVE
+            return dc
+
     def overwrite_host(self, arr) -> "DataCopy":
         """Land ``arr`` as the NEW authoritative host value: write in
         place when the host buffer matches (collection backing views
