@@ -110,6 +110,9 @@ class CommEngine:
         #: set by the remote-dep layer: fatal handler errors fail the rank
         #: fast instead of silently dropping the message
         self.on_error: Optional[Callable[[Exception], None]] = None
+        #: ranks whose connection died mid-run (failure detection);
+        #: barrier and quiescence waiters observe this and fail fast
+        self.dead_peers: set = set()
 
     def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
         """cb(src_rank, payload) runs on the comm receive thread."""
@@ -380,10 +383,12 @@ class SocketCE(CommEngine):
         while not self._stop:
             hdr = self._recv_exact(conn, _LEN.size)
             if hdr is None:
+                self._peer_lost(src)
                 return
             tag, ln = _LEN.unpack(hdr)
             data = self._recv_exact(conn, ln) if ln else b""
             if data is None:
+                self._peer_lost(src)
                 return
             self.recv_msgs += 1
             try:
@@ -394,6 +399,25 @@ class SocketCE(CommEngine):
                         self.rank, tag, exc)
                 if self.on_error is not None:   # ...but must fail the rank
                     self.on_error(exc)
+
+    def _peer_lost(self, src: int) -> None:
+        """Failure detection: a peer's socket closed while we are still
+        running (the reference has NO fault tolerance — it aborts; here
+        the loss surfaces as a context error AND wakes barrier/
+        quiescence waiters so they fail fast with a cause instead of
+        hanging to their timeouts)."""
+        if self._stop:
+            return             # orderly shutdown closes sockets
+        warning("rank %d: lost connection to rank %d", self.rank, src)
+        self.dead_peers.add(src)
+        cond = getattr(self, "_bar_cond", None)   # SocketCE's barrier
+        if cond is not None:
+            with cond:
+                cond.notify_all()
+        if self.on_error is not None:
+            self.on_error(ConnectionError(
+                f"rank {self.rank}: peer rank {src} disconnected "
+                "mid-run"))
 
     def send_am(self, tag: int, dst: int, payload: Any = None) -> None:
         mark("send_am tag=%d dst=%d", tag, dst)
@@ -430,8 +454,14 @@ class SocketCE(CommEngine):
         if self.rank == 0:
             with self._bar_cond:
                 ok = self._bar_cond.wait_for(
-                    lambda: self._bar_arrived.get(gen, 0) == self.nranks - 1,
+                    lambda: self._bar_arrived.get(gen, 0) == self.nranks - 1
+                    or self.dead_peers,
                     timeout=timeout)
+                if self.dead_peers and \
+                        self._bar_arrived.get(gen, 0) != self.nranks - 1:
+                    raise ConnectionError(
+                        f"rank 0: barrier with dead peer(s) "
+                        f"{sorted(self.dead_peers)}")
                 if not ok:
                     raise TimeoutError("rank 0: barrier timeout")
                 del self._bar_arrived[gen]
@@ -441,7 +471,12 @@ class SocketCE(CommEngine):
             self.send_am(TAG_BARRIER, 0, ("arrive", gen))
             with self._bar_cond:
                 ok = self._bar_cond.wait_for(
-                    lambda: gen in self._bar_released, timeout=timeout)
+                    lambda: gen in self._bar_released or self.dead_peers,
+                    timeout=timeout)
+                if self.dead_peers and gen not in self._bar_released:
+                    raise ConnectionError(
+                        f"rank {self.rank}: barrier with dead peer(s) "
+                        f"{sorted(self.dead_peers)}")
                 if not ok:
                     raise TimeoutError(f"rank {self.rank}: barrier timeout")
                 self._bar_released.discard(gen)

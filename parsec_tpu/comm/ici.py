@@ -258,6 +258,7 @@ class IciEngine:
             return 0
         replicas = self.bcast(copy.payload, missing)
         attached = 0
+        adopt = []
         with datum._lock:
             for sp, arr in replicas.items():
                 existing = datum.copy_on(sp)
@@ -266,16 +267,69 @@ class IciEngine:
                                   coherency=Coherency.SHARED,
                                   version=copy.version)
                     datum.attach_copy(dc)
+                    adopt.append((sp, dc))
                     attached += 1
                 elif existing.coherency == Coherency.INVALID or \
                         existing.version < copy.version:
                     existing.payload = arr
                     existing.coherency = Coherency.SHARED
                     existing.version = copy.version
+                    adopt.append((sp, existing))
                     attached += 1
+        self._adopt(datum, adopt)
         debug_verbose(7, "ici prebroadcast: %d replicas of %s", attached,
                       datum)
         return attached
+
+    def preplace(self, copy: DataCopy, space: int) -> bool:
+        """Single-consumer counterpart of :meth:`prebroadcast`: move one
+        produced device-resident tile onto the consumer's device NOW —
+        overlapping the transfer with scheduling — instead of lazily
+        inside the consumer's stage-in (reference: the CE put of a
+        point-to-point dep edge, parsec_mpi_funnelled.c:793; on TPU a
+        device-to-device ICI hop)."""
+        datum = copy.data
+        if datum is None or copy.payload is None or space not in self._jdev:
+            return False
+        if copy.device == space or copy.device not in self._jdev:
+            return False      # host-resident payloads stage in normally
+        with datum._lock:
+            existing = datum.copy_on(space)
+            if existing is not None and \
+                    existing.coherency != Coherency.INVALID and \
+                    existing.version >= copy.version:
+                return False  # already resident
+        arr = self.put(copy.payload, space)
+        placed = None
+        with datum._lock:
+            existing = datum.copy_on(space)
+            if existing is None:
+                placed = DataCopy(datum, space, payload=arr,
+                                  coherency=Coherency.SHARED,
+                                  version=copy.version)
+                datum.attach_copy(placed)
+            elif existing.version <= copy.version:
+                existing.payload = arr
+                existing.coherency = Coherency.SHARED
+                existing.version = copy.version
+                placed = existing
+        if placed is not None:
+            self._adopt(datum, [(space, placed)])
+        return True
+
+    def device_resident(self, copy: DataCopy) -> bool:
+        """Cheap hot-path gate: only device-resident produced copies are
+        candidates for collective placement."""
+        return copy.device in self._jdev and copy.payload is not None
+
+    def _adopt(self, datum, placed) -> None:
+        """Register externally-attached copies with their device's HBM
+        ledger so eviction/budget accounting can see them."""
+        by_space = {d.space: d for d in self.xla_devices}
+        for sp, dc in placed:
+            dev = by_space.get(sp)
+            if dev is not None and hasattr(dev, "adopt"):
+                dev.adopt(datum, dc)
 
     def consumer_spaces(self, taskpool, deliveries) -> List[int]:
         """Best-effort device targets for a list of local deliveries:

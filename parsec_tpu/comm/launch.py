@@ -67,6 +67,9 @@ def _worker(rank: int, nranks: int, port_base: int, nb_cores: int,
             ce.barrier()
             outq.put((rank, None, result))
         finally:
+            # past the final barrier every rank is done: peers closing
+            # their sockets now is orderly shutdown, not a failure
+            ce._stop = True
             ctx.fini()
             rde.fini()
     except Exception:

@@ -599,9 +599,16 @@ class RemoteDepEngine:
                     "kind": "token", "black": False, "balance": 0,
                     "rounds": 0})
             threading.Thread(target=kick, daemon=True).start()
-        if not self._terminated.wait(timeout):
-            raise TimeoutError(
-                f"rank {self.rank}: global termination not reached")
+        import time
+        deadline = time.monotonic() + timeout
+        while not self._terminated.wait(0.05):
+            if self.ce.dead_peers:
+                raise ConnectionError(
+                    f"rank {self.rank}: quiescence with dead peer(s) "
+                    f"{sorted(self.ce.dead_peers)}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rank {self.rank}: global termination not reached")
         self._terminated.clear()
 
     def fini(self) -> None:

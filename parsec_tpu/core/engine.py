@@ -338,16 +338,26 @@ def release_deps(es, task: Task) -> List[Task]:
         if remote_count and not local_deliveries and copy is not None \
                 and copy.arena is not None:
             remote_only_arena.append(copy)
-        if copy is not None and len(local_deliveries) > 1 \
-                and tp.context is not None and tp.context.ici is not None:
-            # panel fan-out: replicate the tile onto every consumer device
-            # in ONE collective instead of N separate stage-in transfers
-            # (reference: dataflow bcast trees, remote_dep.c:334-357;
-            # SURVEY §5.8 ICI lowering)
-            spaces = tp.context.ici.consumer_spaces(
-                tp, [d[:3] for d in local_deliveries])
-            if spaces:
-                tp.context.ici.prebroadcast(copy, spaces)
+        ici = tp.context.ici if tp.context is not None else None
+        if copy is not None and ici is not None and local_deliveries \
+                and (len(local_deliveries) > 1
+                     or ici.device_resident(copy)):
+            # Fan-out onto DISTINCT consumer devices: one collective
+            # replication; a single distinct target (one consumer, or
+            # several sharing a device): one proactive d2d put that
+            # overlaps with scheduling (reference: dataflow bcast trees
+            # remote_dep.c:334-357 and the CE put; SURVEY §5.8 ICI
+            # lowering).  Host-resident single-consumer edges — the
+            # dominant same-device case — skip the affinity resolution
+            # entirely; multi-consumer fan-outs qualify even from host
+            # (one replication beats N separate stage-ins).
+            uniq = set(ici.consumer_spaces(
+                tp, [d[:3] for d in local_deliveries]))
+            uniq.discard(copy.device)
+            if len(uniq) > 1:
+                ici.prebroadcast(copy, sorted(uniq))
+            elif len(uniq) == 1:
+                ici.preplace(copy, uniq.pop())
         for succ_tc, succ_locals, dflow, odep in local_deliveries:
             dcopy = copy
             if copy is not None:

@@ -552,6 +552,20 @@ class XlaDevice(Device):
             for copy in inf.release_after:
                 copy.arena.release_copy(copy)
 
+    def adopt(self, datum, dc: DataCopy) -> None:
+        """Account a device copy attached by an EXTERNAL placer (the ICI
+        engine's prebroadcast/preplace): claim its bytes against the HBM
+        budget and enter it in the LRU so eviction can see it — an
+        unaccounted attach would let collective placement overcommit the
+        budget invisibly."""
+        nbytes = getattr(dc.payload, "nbytes", 0)
+        with self._mem_lock:
+            if id(datum) in self._lru:
+                return          # already accounted (payload refresh)
+        off = self._reserve(nbytes)
+        self._account(datum, dc, nbytes, off)
+        self.stats.bytes_in += nbytes
+
     def sync(self, timeout: Optional[float] = None) -> None:
         """Drain the device: block until every dispatched kernel has
         materialized its outputs (the stream-synchronize at pool

@@ -386,3 +386,168 @@ def _remote_reshape(ctx, rank, nranks):
 
 def test_remote_presend_reshape():
     assert run_distributed(_remote_reshape, 2) == ["ok"] * 2
+
+
+# -- 8-rank scale (the north-star scaling axis, SURVEY §6: 8 -> 256
+# chips; here 8 processes on one node per the reference's test strategy) ----
+
+def _scale8(ctx, rank, nranks):
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    NT = nranks * 3
+    V = VectorTwoDimCyclic(mb=4, lm=NT * 4, nodes=nranks, myrank=rank)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0.0
+    p = PTG("scale", NT=NT)
+    p.task("S", k=Range(0, NT - 1)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, V=V: V(k)), when=lambda k: k == 0),
+              IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("S", "T", lambda k: dict(k=k + 1)),
+                  when=lambda k, NT=NT: k < NT - 1),
+              OUT(DATA(lambda k, V=V: V(k)))) \
+        .body(lambda T: T + 1.0)
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=180)
+    out = {}
+    for m, _ in V.local_tiles():
+        out[m] = float(np.asarray(V.data_of(m).pull_to_host().payload)[0])
+    return out
+
+
+def test_chain_8_ranks():
+    results = run_distributed(_scale8, 8, timeout=300)
+    merged = {}
+    for r in results:
+        merged.update(r)
+    assert merged == {k: float(k + 1) for k in range(24)}
+
+
+# -- failure detection: a dying peer fails waiters fast ---------------------
+
+def _survivor_proc(rank, nranks, port_base, outq):
+    """Standalone 2-rank harness (not run_distributed: its epilogue
+    barrier would entangle the failure we are injecting)."""
+    import os
+    import time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    from parsec_tpu.comm.engine import SocketCE
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    ce = SocketCE(rank, nranks, port_base)
+    ctx = Context(nb_cores=1, rank=rank, nranks=nranks)
+    rde = RemoteDepEngine(ce, ctx)
+    ce.barrier()
+    if rank == 1:
+        os._exit(17)              # crash without goodbye
+    # rank 0: the loss must surface as a recorded ConnectionError AND
+    # fail a barrier fast (well under its 60s timeout)
+    t0 = time.monotonic()
+    deadline = t0 + 30
+    while not ctx._errors:
+        if time.monotonic() > deadline:
+            outq.put(("timeout", None))
+            return
+        time.sleep(0.02)
+    kind = type(ctx._errors[0][0]).__name__
+    try:
+        ce.barrier(timeout=60)
+        bar = "no-error"
+    except ConnectionError:
+        bar = "connection-error"
+    except TimeoutError:
+        bar = "timeout"
+    outq.put((kind, bar, time.monotonic() - t0))
+
+
+def test_peer_death_detection():
+    """_peer_lost records a ConnectionError on the survivor and wakes
+    barrier waiters with a cause — removing the detection makes this
+    time out, not pass vacuously."""
+    import multiprocessing as mp
+    from parsec_tpu.comm.launch import _probe_port_base
+    mpctx = mp.get_context("spawn")
+    outq = mpctx.Queue()
+    base = _probe_port_base(2)
+    procs = [mpctx.Process(target=_survivor_proc, args=(r, 2, base, outq),
+                           daemon=True)
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    kind, bar, dt = outq.get(timeout=120)
+    for p in procs:
+        p.join(timeout=10)
+        if p.is_alive():
+            p.terminate()
+    assert kind == "ConnectionError", kind
+    assert bar == "connection-error", bar
+    assert dt < 30, f"loss surfaced too slowly ({dt:.1f}s)"
+
+
+# -- 8-rank scale (the north-star scaling axis, SURVEY §6: 8 -> 256
+# chips; here 8 processes on one node per the reference's test strategy) ----
+
+def _scale8(ctx, rank, nranks):
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    NT = nranks * 3
+    V = VectorTwoDimCyclic(mb=4, lm=NT * 4, nodes=nranks, myrank=rank)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0.0
+    p = PTG("scale", NT=NT)
+    p.task("S", k=Range(0, NT - 1)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, V=V: V(k)), when=lambda k: k == 0),
+              IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("S", "T", lambda k: dict(k=k + 1)),
+                  when=lambda k, NT=NT: k < NT - 1),
+              OUT(DATA(lambda k, V=V: V(k)))) \
+        .body(lambda T: T + 1.0)
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=180)
+    out = {}
+    for m, _ in V.local_tiles():
+        out[m] = float(np.asarray(V.data_of(m).pull_to_host().payload)[0])
+    return out
+
+
+def test_chain_8_ranks():
+    results = run_distributed(_scale8, 8, timeout=300)
+    merged = {}
+    for r in results:
+        merged.update(r)
+    assert merged == {k: float(k + 1) for k in range(24)}
+
+
+# -- failure detection: a dying peer fails waiters fast ---------------------
+
+def _die_young(ctx, rank, nranks):
+    import os
+    import time
+    ce = ctx.comm.ce
+    ce.barrier()
+    if rank == 1:
+        os._exit(17)          # simulate a crashed rank
+    # the survivor must observe the loss as a context error, not hang
+    deadline = time.monotonic() + 60
+    while not ctx._errors:
+        if time.monotonic() > deadline:
+            raise TimeoutError("peer loss never surfaced")
+        time.sleep(0.02)
+    exc = ctx._errors[0][0]
+    assert isinstance(exc, ConnectionError), exc
+    ctx._errors.clear()       # let the launcher's epilogue finish clean
+    return "ok"
+
+
+def test_peer_death_detection():
+    with pytest.raises((RuntimeError, TimeoutError)) as ei:
+        run_distributed(_die_young, 2, timeout=120)
+    # rank 0 returned "ok" (loss detected); the run fails only because
+    # rank 1 vanished without reporting
+    assert "1" in str(ei.value)

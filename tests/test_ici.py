@@ -110,3 +110,62 @@ def test_multidevice_gemm_uses_collective_bcast():
         stats = ctx.ici.stats.as_dict()
     np.testing.assert_allclose(C.to_array(), a @ b, rtol=2e-3, atol=2e-3)
     assert stats["bcasts"] > 0, f"no collective broadcasts fired: {stats}"
+
+
+def test_preplace_single_consumer_edge(ctx):
+    """A produced device-resident copy moves proactively onto the single
+    consumer's device and attaches as a coherent SHARED copy (the CE-put
+    analog of prebroadcast); host-resident or already-resident copies
+    are left for the normal stage-in."""
+    import jax
+    from parsec_tpu.data.data import Coherency, new_data
+    ici = ctx.ici
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((8, 8)).astype(np.float32)
+    datum = new_data(np.zeros((8, 8), np.float32))
+    src, dst = ici.xla_devices[0].space, ici.xla_devices[1].space
+    dc = datum.overwrite_on(src, jax.device_put(a, ici.xla_devices[0].jdev))
+    assert ici.preplace(dc, dst)
+    placed = datum.copy_on(dst)
+    assert placed is not None and placed.coherency == Coherency.SHARED
+    assert placed.version == dc.version
+    np.testing.assert_array_equal(np.asarray(placed.payload), a)
+    # second call: already resident -> no-op
+    assert not ici.preplace(dc, dst)
+    # host-resident copies are not preplaced
+    host_datum = new_data(a.copy())
+    assert not ici.preplace(host_datum.copy_on(0), dst)
+
+
+def test_runtime_stencil_uses_preplace(ctx):
+    """A cross-device producer->consumer chain through the runtime fires
+    the proactive put (dryrun's owner-computes GEMM never does: its
+    chains stay on one device)."""
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    ndev = ctx.ici.ndev
+    V = VectorTwoDimCyclic(mb=8, lm=8 * ndev)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = float(m)
+    V.distribute_devices(ctx)   # tile k pinned to device k
+    p = PTG("zig", NT=ndev)
+    # S(k) runs on tile k's device and feeds S(k+1) on the NEXT device:
+    # every edge crosses devices with exactly one consumer
+    p.task("S", k=Range(0, ndev - 1)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, V=V: V(k)), when=lambda k: k == 0),
+              IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("S", "T", lambda k: dict(k=k + 1)),
+                  when=lambda k, ND=ndev: k < ND - 1),
+              OUT(DATA(lambda k, V=V: V(k)),
+                  when=lambda k, ND=ndev: k == ND - 1)) \
+        .body(lambda T: T + 1.0, device="tpu") \
+        .body(lambda T: T + 1.0)
+    before = ctx.ici.stats.puts
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=120)
+    got = np.asarray(V.data_of(ndev - 1).pull_to_host().payload)
+    np.testing.assert_allclose(got, float(ndev))
+    assert ctx.ici.stats.puts > before, "no proactive d2d placement fired"
