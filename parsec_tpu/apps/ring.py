@@ -1,0 +1,76 @@
+"""Ring pipeline: neighbor-exchange dataflow (the sequence-parallel
+primitive).
+
+The reference has no attention/sequence dimension (SURVEY §5.7), but its
+dataflow bcast trees are the primitive ring schedules are built from.
+This app expresses the canonical ring exchange — each of P parties holds
+a block, and over P rounds every block visits every party while a local
+accumulator combines it — as a plain PTG.  That is exactly the data
+movement of ring attention (KV blocks circulating past resident Q) and
+of ring allreduce; ``combine`` is the per-visit operator (attention
+scores, a sum, ...).
+
+Placement: party q's tasks run where ``A(q)`` lives, so on P ranks every
+edge is a neighbor hop on the interconnect (DCN via the comm engine;
+multi-device single-host hops ride the ICI preplace path).  After the
+pool completes, every party's accumulator has combined ALL blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from parsec_tpu.core.taskpool import ParameterizedTaskpool
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+
+
+def ring_pipeline_taskpool(V: TiledMatrix, A: TiledMatrix,
+                           combine: Optional[Callable] = None,
+                           device: str = "cpu") -> ParameterizedTaskpool:
+    """Build the P-party ring: ``V(q)`` are the circulating blocks,
+    ``A(q)`` the resident accumulators (initialized by the caller;
+    updated as ``A(q) = combine(A(q), block)`` once per visiting block).
+    Default ``combine`` is addition — the ring-allreduce instance."""
+    P = V.mt
+    if A.mt != P:
+        raise ValueError("one accumulator per party")
+    if combine is None:
+        def combine(acc, blk):
+            return np.asarray(acc) + np.asarray(blk)
+
+    def body(B, Acc):
+        return {"Acc": combine(Acc, B)}
+
+    p = PTG("ring", P=P)
+    # R(q, t): party q, round t.  Round 0 combines the party's OWN block
+    # and launches it around the ring; round t receives the block that
+    # started at party (q - t) mod P and forwards it until it has
+    # visited everyone.
+    tb = p.task("R", q=Range(0, P - 1), t=Range(0, P - 1)) \
+        .affinity(lambda q, A=A: A(q)) \
+        .priority(lambda t, P=P: P - t) \
+        .flow("B", "READ",
+              IN(DATA(lambda q, V=V: V(q)), when=lambda t: t == 0),
+              IN(TASK("R", "B",
+                      lambda q, t, P=P: dict(q=(q - 1) % P, t=t - 1)),
+                 when=lambda t: t > 0),
+              OUT(TASK("R", "B",
+                       lambda q, t, P=P: dict(q=(q + 1) % P, t=t + 1)),
+                  when=lambda t, P=P: t < P - 1)) \
+        .flow("Acc", "RW",
+              IN(DATA(lambda q, A=A: A(q)), when=lambda t: t == 0),
+              IN(TASK("R", "Acc", lambda q, t: dict(q=q, t=t - 1)),
+                 when=lambda t: t > 0),
+              OUT(TASK("R", "Acc", lambda q, t: dict(q=q, t=t + 1)),
+                  when=lambda t, P=P: t < P - 1),
+              OUT(DATA(lambda q, A=A: A(q)),
+                  when=lambda t, P=P: t == P - 1))
+    if device in ("tpu", "xla", "gpu"):
+        def kernel(B, Acc):
+            return combine(Acc, B)
+        tb.body(kernel, device=device)
+    tb.body(body)
+    return p.build()

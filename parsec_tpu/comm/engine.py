@@ -34,6 +34,11 @@ from parsec_tpu.utils.output import debug_verbose, warning
 params.register("comm_port_base", 0,
                 "TCP port base for the socket comm engine (0 = from env "
                 "PARSEC_COMM_PORT_BASE or 23500)")
+params.register("comm_hosts", "",
+                "comma-separated per-rank host list for multi-host (DCN) "
+                "runs — rank i listens on 0.0.0.0 and peers dial "
+                "hosts[i]; empty = single-node loopback (also read from "
+                "env PARSEC_COMM_HOSTS)")
 
 # AM tag space (reference: parsec_comm_engine.h:29-38)
 TAG_ACTIVATE = 1
@@ -277,6 +282,15 @@ class SocketCE(CommEngine):
             port_base = int(params.get("comm_port_base", 0)) or \
                 int(os.environ.get("PARSEC_COMM_PORT_BASE", 23500))
         self.port_base = port_base
+        # multi-host address book (the DCN story: one rank per host, the
+        # same engine; reference: the MPI module gets this from mpiexec)
+        hosts = str(params.get("comm_hosts", "") or
+                    os.environ.get("PARSEC_COMM_HOSTS", "")).strip()
+        self._hosts = [h.strip() for h in hosts.split(",")] if hosts else []
+        if self._hosts and len(self._hosts) != nranks:
+            raise ValueError(
+                f"comm_hosts names {len(self._hosts)} hosts for "
+                f"{nranks} ranks")
         self._peers: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
         self._plock = threading.Lock()
@@ -292,7 +306,8 @@ class SocketCE(CommEngine):
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("127.0.0.1", self.port_base + rank))
+        self._listener.bind(("0.0.0.0" if self._hosts else "127.0.0.1",
+                             self.port_base + rank))
         self._listener.listen(nranks)
         t = threading.Thread(target=self._accept_loop,
                              name=f"ce-accept-{rank}", daemon=True)
@@ -344,11 +359,12 @@ class SocketCE(CommEngine):
                     raise TimeoutError(
                         f"rank {self.rank}: no connection from {dst}")
                 time.sleep(0.01)
+        peer_host = self._hosts[dst] if self._hosts else "127.0.0.1"
         deadline = time.monotonic() + 30
         while True:
             try:
                 s = socket.create_connection(
-                    ("127.0.0.1", self.port_base + dst), timeout=5)
+                    (peer_host, self.port_base + dst), timeout=5)
                 break
             except OSError:
                 if time.monotonic() > deadline:
@@ -466,7 +482,13 @@ class SocketCE(CommEngine):
                     raise TimeoutError("rank 0: barrier timeout")
                 del self._bar_arrived[gen]
             for r in range(1, self.nranks):
-                self.send_am(TAG_BARRIER, r, ("release", gen))
+                try:
+                    self.send_am(TAG_BARRIER, r, ("release", gen))
+                except OSError:
+                    # a rank that arrived and then died must not strand
+                    # the release of later-ranked survivors
+                    warning("rank 0: barrier release to dead rank %d "
+                            "skipped", r)
         else:
             self.send_am(TAG_BARRIER, 0, ("arrive", gen))
             with self._bar_cond:

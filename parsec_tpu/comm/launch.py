@@ -65,10 +65,12 @@ def _worker(rank: int, nranks: int, port_base: int, nb_cores: int,
         try:
             result = fn(ctx, rank, nranks, *args)
             ce.barrier()
+            # past the final barrier every rank is done: peers closing
+            # their sockets now (possibly while we still serialize the
+            # result below) is orderly shutdown, not a failure
+            ce._stop = True
             outq.put((rank, None, result))
         finally:
-            # past the final barrier every rank is done: peers closing
-            # their sockets now is orderly shutdown, not a failure
             ce._stop = True
             ctx.fini()
             rde.fini()

@@ -210,3 +210,55 @@ def ring_from(items: Iterable[Any]) -> List[Any]:
     """The reference threads ready tasks into 'rings' (parsec_list_item_ring);
     here a plain list is the ring representation used across the engine."""
     return list(items)
+
+
+class HBBuffer:
+    """Hierarchical bounded buffer (reference: parsec/hbbuffer.{c,h} —
+    the scheduler building block: a fixed-capacity buffer whose pushes
+    overflow to a PARENT store, forming per-thread -> per-group ->
+    system chains; pops drain locally first, then pull from the
+    parent).  ``parent`` is any object with push_back/pop_front (another
+    HBBuffer, a Dequeue, ...)."""
+
+    def __init__(self, capacity: int, parent: Any = None):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self.parent = parent
+        self._lock = threading.Lock()
+        self._items: collections.deque = collections.deque()
+
+    def push_back(self, item: Any) -> None:
+        with self._lock:
+            if len(self._items) < self.capacity:
+                self._items.append(item)
+                return
+        if self.parent is None:
+            raise OverflowError("hbbuffer full and no parent store")
+        self.parent.push_back(item)        # overflow up the hierarchy
+
+    def chain_back(self, items: Iterable[Any]) -> None:
+        for it in items:
+            self.push_back(it)
+
+    def pop_front(self, local_only: bool = False) -> Optional[Any]:
+        """Drain locally, then walk up to the parent.  ``local_only``
+        stops at this level — schedulers use it so the walk to the
+        system store happens at ITS place in their fairness order
+        (local -> steal -> system), not before stealing."""
+        with self._lock:
+            if self._items:
+                return self._items.popleft()
+        if self.parent is not None and not local_only:
+            return self.parent.pop_front()
+        return None
+
+    def pop_back(self) -> Optional[Any]:
+        """Steal end: local cold end only — thieves must not drain the
+        victim's parent (the reference steals within one level)."""
+        with self._lock:
+            return self._items.pop() if self._items else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)

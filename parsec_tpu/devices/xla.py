@@ -558,12 +558,29 @@ class XlaDevice(Device):
         budget and enter it in the LRU so eviction can see it — an
         unaccounted attach would let collective placement overcommit the
         budget invisibly."""
+        key = id(datum)
         nbytes = getattr(dc.payload, "nbytes", 0)
         with self._mem_lock:
-            if id(datum) in self._lru:
+            if key in self._lru:
                 return          # already accounted (payload refresh)
-        off = self._reserve(nbytes)
-        self._account(datum, dc, nbytes, off)
+            # placeholder claims the key atomically with the check, so a
+            # concurrent adopt/stage-in of the same datum cannot double-
+            # account; pinned so eviction skips the 0-byte stub
+            self._lru[key] = (weakref.ref(dc), 0, None)
+            self._pins[key] = self._pins.get(key, 0) + 1
+        try:
+            off = self._reserve(nbytes)
+        finally:
+            with self._mem_lock:
+                n = self._pins.get(key, 0) - 1
+                if n <= 0:
+                    self._pins.pop(key, None)
+                else:
+                    self._pins[key] = n
+        with self._mem_lock:
+            self._lru[key] = (weakref.ref(dc), nbytes, off)
+            self._bytes_used += nbytes
+        weakref.finalize(dc, self._forget, key, nbytes)
         self.stats.bytes_in += nbytes
 
     def sync(self, timeout: Optional[float] = None) -> None:

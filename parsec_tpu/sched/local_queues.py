@@ -16,8 +16,8 @@ import itertools
 import threading
 from typing import List, Optional
 
-from parsec_tpu.containers.lists import (Dequeue, Lifo, OrderedList,
-                                          make_dequeue)
+from parsec_tpu.containers.lists import (Dequeue, HBBuffer, Lifo,
+                                          OrderedList, make_dequeue)
 from parsec_tpu.core.task import Task
 from parsec_tpu.sched import Scheduler, register
 from parsec_tpu.utils.mca import params
@@ -106,36 +106,37 @@ class LocalLifo(_PerStream):
 
 
 class LocalFlatQueues(_PerStream):
-    """lfq: bounded per-stream buffer, overflow to the system queue,
-    locality-aware steal (reference: sched_lfq_module.c + hbbuffer)."""
+    """lfq: bounded per-stream hbbuffer chained to the system queue,
+    locality-aware steal (reference: sched_lfq_module.c + hbbuffer —
+    pushes overflow UP the chain, pops walk DOWN it)."""
 
     def _make_local(self):
-        return Dequeue()
+        return HBBuffer(int(params.get("sched_lfq_queue_size", 16)),
+                        parent=self._system)
 
     def schedule(self, es, tasks, distance=0):
         if self._defer(tasks, distance):
             return
         q = self._locals.get(es.th_id)
-        cap = params.get("sched_lfq_queue_size", 16)
         if q is None:
             self._system.chain_back(tasks)
             return
-        for t in tasks:
-            if len(q) < cap:
-                q.push_back(t)
-            else:
-                self._n_overflow += 1
-                self._system.push_back(t)   # hbbuffer overflow to parent
+        before = len(self._system)
+        q.chain_back(tasks)                 # overflow rides the chain
+        self._n_overflow += max(0, len(self._system) - before)
 
     def select(self, es):
         q = self._locals.get(es.th_id)
         if q is not None:
-            t = q.pop_front()
+            # LOCAL only here: the system store must come AFTER stealing
+            # or a distance-deferred AGAIN task gets re-selected ahead of
+            # the work it waits on (the fairness contract)
+            t = q.pop_front(local_only=True)
             if t is not None:
                 self._n_local += 1
                 return t
         for other in self._steal_order(es):
-            t = other.pop_back()            # steal the cold end
+            t = other.pop_back()            # steal the cold LOCAL end
             if t is not None:
                 self._n_steal += 1
                 return t
@@ -238,31 +239,32 @@ params.register("sched_lhq_group_size", 2,
 class LocalHierQueues(_PerStream):
     """lhq: HIERARCHICAL local queues (reference: sched_lhq_module.c —
     hbbuffers chained per topology level).  Without hwloc the levels are
-    synthesized from stream ids: per-stream bounded buffer -> per-GROUP
-    shared buffer (``sched_lhq_group_size`` streams) -> system queue.
-    Overflow walks up the chain; selection walks it down before stealing
-    from sibling streams of the same group, then other groups."""
+    synthesized from stream ids: per-stream HBBuffer -> per-GROUP
+    HBBuffer (``sched_lhq_group_size`` streams, 4x capacity) -> system
+    queue.  Overflow walks UP the chain on push; selection walks DOWN it
+    on pop, then steals sibling streams of the same group, then other
+    groups' buffers, then any stream."""
 
     def install(self, context):
         super().install(context)
-        self._groups = {}   # group id -> shared Dequeue
-
-    def _make_local(self):
-        return Dequeue()
+        self._groups = {}   # group id -> shared mid-level HBBuffer
 
     def _gid(self, th_id: int) -> int:
         return th_id // max(1, int(params.get("sched_lhq_group_size", 2)))
 
-    def _group(self, th_id: int) -> Dequeue:
+    def _group(self, th_id: int) -> HBBuffer:
         g = self._gid(th_id)
         q = self._groups.get(g)
         if q is None:
-            q = self._groups.setdefault(g, Dequeue())
+            cap = int(params.get("sched_lfq_queue_size", 16))
+            q = self._groups.setdefault(
+                g, HBBuffer(cap * 4, parent=self._system))
         return q
 
     def flow_init(self, es):
-        super().flow_init(es)
-        self._group(es.th_id)
+        cap = int(params.get("sched_lfq_queue_size", 16))
+        self._locals[es.th_id] = HBBuffer(cap,
+                                          parent=self._group(es.th_id))
 
     def schedule(self, es, tasks, distance=0):
         if self._defer(tasks, distance):
@@ -271,28 +273,21 @@ class LocalHierQueues(_PerStream):
         if q is None:
             self._system.chain_back(tasks)
             return
-        cap = params.get("sched_lfq_queue_size", 16)
-        grp = self._group(es.th_id)
-        for t in tasks:
-            if len(q) < cap:
-                q.push_back(t)
-            elif len(grp) < cap * 4:        # next level up the hierarchy
-                grp.push_back(t)
-            else:
-                self._n_overflow += 1
-                self._system.push_back(t)
+        before = len(self._system)
+        q.chain_back(tasks)                 # overflow climbs the chain
+        self._n_overflow += max(0, len(self._system) - before)
 
     def select(self, es):
         q = self._locals.get(es.th_id)
         if q is not None:
-            t = q.pop_front()
+            t = q.pop_front(local_only=True)
             if t is not None:
                 self._n_local += 1
                 return t
         grp = self._group(es.th_id)
-        t = grp.pop_front()
-        if t is not None:
-            self._n_local += 1
+        t = grp.pop_front(local_only=True)  # my group's shared level;
+        if t is not None:                   # the system store waits its
+            self._n_local += 1              # turn AFTER stealing
             return t
         me = self._gid(es.th_id)
         # steal: sibling streams in my group first (cache locality),

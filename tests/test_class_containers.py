@@ -281,3 +281,29 @@ def test_ordered_list_mixed_modes_no_inversion():
     # sorted insertion lands before the first lower-priority item
     vals = [lst.pop_front().value for _ in range(3)]
     assert vals.index("p5") < vals.index("p1")
+
+
+def test_hbbuffer_overflow_chain():
+    """reference: parsec/hbbuffer.c — bounded pushes overflow to the
+    parent store; pops drain local then parent; steal stays local."""
+    from parsec_tpu.containers.lists import Dequeue, HBBuffer
+    system = Dequeue()
+    group = HBBuffer(capacity=2, parent=system)
+    local = HBBuffer(capacity=2, parent=group)
+    for i in range(7):
+        local.push_back(i)
+    assert len(local) == 2 and len(group) == 2 and len(system) == 3
+    # pop drains local first, then walks up
+    assert [local.pop_front() for _ in range(7)] == list(range(7))
+    assert local.pop_front() is None
+    # steal end never touches the parent
+    local.push_back("a")
+    group.push_back("g")
+    assert local.pop_back() == "a"
+    assert local.pop_back() is None and len(group) == 1
+    # no parent: overflow is an error
+    import pytest as _pytest
+    lone = HBBuffer(capacity=1)
+    lone.push_back(1)
+    with _pytest.raises(OverflowError):
+        lone.push_back(2)
