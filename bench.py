@@ -234,11 +234,11 @@ def main():
     # Big MXU-friendly tiles on TPU, small ones on CPU CI.  12288 tiles
     # carry ~3.7 TFLOP of MXU work each, amortizing the ~2.4ms/launch
     # tunnel overhead; bf16 panels run the systolic array at full rate
-    # with f32 accumulation in C (sweep: 2048->0.6, 4096->48, 8192->144,
-    # 12288->158 TFLOP/s on v5e).
+    # with f32 accumulation in C (sweep: mb 2048->0.6, 4096->48,
+    # 8192->144, 12288->158; deepening k to 4 -> 163 TFLOP/s on v5e).
     mb = int(os.environ.get("PARSEC_BENCH_MB", 12288 if on_tpu else 64))
     mt = nt = int(os.environ.get("PARSEC_BENCH_NT", 3 if on_tpu else 4))
-    kt = int(os.environ.get("PARSEC_BENCH_KT", 3 if on_tpu else 4))
+    kt = int(os.environ.get("PARSEC_BENCH_KT", 4))
     reps = int(os.environ.get("PARSEC_BENCH_REPS", 3))
     ab = os.environ.get("PARSEC_BENCH_AB_DTYPE", "bfloat16" if on_tpu
                         else "float32")
