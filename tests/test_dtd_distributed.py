@@ -222,3 +222,38 @@ def _affinity_placement(ctx, rank, nranks):
 
 def test_dtd_affinity_placement():
     assert run_distributed(_affinity_placement, 3) == ["ok"] * 3
+
+
+# -- distributed DTD with DEVICE tasks: surrogate payload pulls must
+# materialize eager device outputs before they ship ------------------------
+
+def _device_chain(ctx, rank, nranks):
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT
+
+    V = VectorTwoDimCyclic(mb=8, lm=8, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 1.0
+    tp = _make_pool(ctx, "dev-chain")
+    t = tp.tile_of(V, 0)
+    steps = 8
+
+    def bump(T):
+        # device incarnation: runs through the XLA module when a device
+        # is attached (spawned ranks run the CPU jax backend), else the
+        # DTD cpu fallback
+        return T * 2.0
+
+    for i in range(steps):
+        tp.insert_task(bump, (t, INOUT), (i % nranks, AFFINITY),
+                       device="tpu")
+    tp.wait(timeout=120)
+    ctx.wait(timeout=120)
+    if rank == 0:
+        got = np.asarray(V.data_of(0).pull_to_host().payload)
+        np.testing.assert_allclose(got, float(2 ** steps))
+    return "ok"
+
+
+def test_dtd_distributed_device_chain():
+    assert run_distributed(_device_chain, 2, timeout=240) == ["ok"] * 2
