@@ -138,6 +138,12 @@ class Context:
             for es in self.streams]
         for t in self._threads:
             t.start()
+
+        # MCA-selected PINS instrumentation modules (reference:
+        # pins_init + per-thread PINS THREAD_INIT, parsec.c bring-up)
+        from parsec_tpu.prof.pins import install_selected
+        self._pins_modules = install_selected(self)
+
         debug_verbose(3, "context up: %d streams, scheduler=%s",
                       self.nb_cores, self.scheduler.name)
 
@@ -280,6 +286,13 @@ class Context:
         stats = self.scheduler.display_stats(None)
         if stats:
             inform("scheduler stats: %s", stats)
+        for mod in getattr(self, "_pins_modules", []):
+            disp = getattr(mod, "display", None)
+            if disp is not None:
+                inform("pins %s: %s", type(mod).__name__, disp())
+            unins = getattr(mod, "uninstall", None)
+            if unins is not None:   # reference: pins_fini unregisters
+                unins(self)
 
     def __enter__(self):
         return self

@@ -267,7 +267,11 @@ class DTDTaskpool(Taskpool):
 
     def data_flush_all(self) -> None:
         """Push every tracked tile home to its host copy
-        (reference: parsec_dtd_data_flush_all)."""
+        (reference: parsec_dtd_data_flush_all).  Pulls device copies to
+        the LOCAL host; the cross-rank flush home to each tile's owner
+        happens at ``wait()`` (_flush_home), once no writer can still be
+        in flight — flushing a tile another rank is mid-writing would be
+        a torn flush."""
         with self._dep_lock:
             tiles = list(self._tiles.values())
         for t in tiles:

@@ -29,6 +29,11 @@ class Gauges:
         context.pins_register("select", self._select)
         context.pins_register("complete_exec", self._complete)
 
+    def uninstall(self, context) -> None:
+        context.pins_unregister("select", self._select)
+        context.pins_unregister("complete_exec", self._complete)
+        self.context = None
+
     def _select(self, es, event, task) -> None:
         with self._lock:
             self.tasks_enabled += 1

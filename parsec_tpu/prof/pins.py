@@ -86,5 +86,69 @@ class StealCounterPins:
     def install(self, context) -> None:
         context.pins_register("select", self._select)
 
+    def uninstall(self, context) -> None:
+        context.pins_unregister("select", self._select)
+
     def _select(self, es, event, task) -> None:
         self.selects[es.th_id] = self.selects.get(es.th_id, 0) + 1
+
+    def display(self) -> str:
+        total = sum(self.selects.values())
+        per = " ".join(f"es{t}={n}" for t, n in sorted(self.selects.items()))
+        return f"selects total={total} {per}"
+
+
+class GaugesPins:
+    """Bridge to the live gauges (reference: the alperf/papi_sde-style
+    modules exporting runtime counters)."""
+
+    def __init__(self):
+        from parsec_tpu.prof.gauges import Gauges
+        self.gauges = Gauges()
+
+    def install(self, context) -> None:
+        self.gauges.install(context)
+
+    def uninstall(self, context) -> None:
+        self.gauges.uninstall(context)
+
+    def display(self) -> str:
+        return str(self.gauges.snapshot())
+
+
+#: name -> zero-arg constructor; the MCA-selected modules of ``--mca
+#: pins a,b`` (reference: the pins framework's module list, pins_init.c)
+_MODULES = {
+    "print_steals": StealCounterPins,
+    "alperf": GaugesPins,
+}
+
+
+def install_selected(context) -> list:
+    """Install the PINS modules named by ``--mca pins`` (comma list) on
+    a context; returns the module instances (reference: pins_init
+    iterating the selected module list).  Unknown names warn rather than
+    fail — a missing instrumentation module must not kill the run."""
+    from parsec_tpu.utils.mca import params
+    from parsec_tpu.utils.output import warning
+    params.register("pins", "",
+                    "comma-separated PINS instrumentation modules to "
+                    "install at context init "
+                    f"(available: {', '.join(sorted(_MODULES))})")
+    spec = str(params.get("pins", "") or "").strip()
+    mods = []
+    if not spec:
+        return mods
+    for name in spec.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        ctor = _MODULES.get(name)
+        if ctor is None:
+            warning("unknown PINS module %r (available: %s)", name,
+                    ", ".join(sorted(_MODULES)))
+            continue
+        mod = ctor()
+        mod.install(context)
+        mods.append(mod)
+    return mods

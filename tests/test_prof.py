@@ -169,3 +169,38 @@ def test_trace_tools_cli(tmp_path):
     doc = json.load(open(out))
     assert doc["traceEvents"], "no events exported"
     assert all(e["ph"] == "X" and e["dur"] >= 0 for e in doc["traceEvents"])
+
+
+def test_pins_mca_selection(capfd):
+    """--mca pins installs named instrumentation modules at context init
+    (reference: the pins framework module list, pins_init.c); unknown
+    names warn instead of failing."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range
+    from parsec_tpu.prof.pins import StealCounterPins
+    from parsec_tpu.utils.mca import params
+
+    params.set("pins", "print_steals,nosuchmodule")
+    try:
+        V = VectorTwoDimCyclic(mb=2, lm=8)
+        for m, _ in V.local_tiles():
+            V.data_of(m).copy_on(0).payload[:] = 0.0
+        p = PTG("pinsrun", NT=4)
+        p.task("T", k=Range(0, 3)) \
+            .affinity(lambda k, V=V: V(k)) \
+            .flow("X", "RW", IN(DATA(lambda k, V=V: V(k))),
+                  OUT(DATA(lambda k, V=V: V(k)))) \
+            .body(lambda X: X + 1.0)
+        with Context(nb_cores=2) as ctx:
+            mods = ctx._pins_modules
+            assert len(mods) == 1 and isinstance(mods[0], StealCounterPins)
+            ctx.add_taskpool(p.build())
+            ctx.wait(timeout=60)
+            assert sum(mods[0].selects.values()) >= 4
+            assert "selects total=" in mods[0].display()
+    finally:
+        params.unset("pins")
+    err = capfd.readouterr().err
+    assert "nosuchmodule" in err          # warned, not failed
+    assert "StealCounterPins" in err      # stats displayed at fini
