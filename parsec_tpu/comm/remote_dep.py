@@ -127,6 +127,9 @@ class RemoteDepEngine:
         self._pending_gets: Dict[Tuple[int, int], dict] = {}
         #: DTD messages that raced their pool's registration on this rank
         self._dtd_backlog: Dict[int, List] = {}
+        #: outstanding DTD rendezvous pulls (Safra-visible in-flight work:
+        #: the one-sided GET itself rides uncounted CE messages)
+        self.dtd_refs_pending = 0
         self._recv_handlers = {
             "activate": self._activate_cb,
             "get_req": self._get_req_cb,
@@ -204,6 +207,8 @@ class RemoteDepEngine:
         for h in stale:
             warning("rank %d: dropping unclaimed rendezvous handle %d "
                     "after %.0fs", self.rank, h, ttl)
+        # the DTD's serve-once regions share the same abandonment GC
+        self.ce.purge_once_regions(ttl)
 
     def _progress_loop(self) -> None:
         import time
@@ -448,8 +453,22 @@ class RemoteDepEngine:
         """Counted application send for the DTD layer (Safra-visible)."""
         self._send_app(TAG_DTD, dst, msg)
 
+    def dtd_ref_done(self) -> None:
+        """One rendezvous pull completed (locked: the counter is shared
+        between the progress thread and socket recv threads)."""
+        with self._term_lock:
+            self.dtd_refs_pending -= 1
+
     def _dtd_cb(self, src: int, msg: dict) -> None:
-        self._on_app_recv()
+        # For rendezvous refs the pending-pull count must become visible
+        # ATOMICALLY with the message credit: crediting first opens a
+        # window where the Safra token sees an even balance and empty
+        # queues while the pull hasn't been registered yet
+        with self._term_lock:
+            self._color_black = True
+            self._app_recv += 1
+            if isinstance(msg, dict) and "ref" in msg:
+                self.dtd_refs_pending += 1
         tp = self.context.taskpools.get(msg["tp"])
         incoming = getattr(tp, "_dtd_incoming", None)
         if incoming is not None:
@@ -531,7 +550,8 @@ class RemoteDepEngine:
         with self._dlock:
             if self._delayed or self._dtd_backlog:
                 return False
-        if self._pending_gets or not self._cmdq.empty():
+        if self._pending_gets or self.dtd_refs_pending or \
+                not self._cmdq.empty():
             return False
         with ctx._lock:
             return ctx._active_taskpools == 0

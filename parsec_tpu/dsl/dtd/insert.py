@@ -584,12 +584,26 @@ class DTDTaskpool(Taskpool):
         return self._recv_tc
 
     def _wire_msg(self, kind: str, tile: DTDTile, ver: int) -> dict:
-        """Encode a tile payload message (pulls the tile home first)."""
+        """Encode a tile payload message (pulls the tile home first).
+
+        Payloads over the eager limit travel by RENDEZVOUS: a snapshot
+        registers as a serve-once region and only its handle rides the
+        message; the consumer pulls via the CE's one-sided get
+        (reference: the eager/rendezvous split of the remote-dep
+        protocol applied to DTD traffic)."""
         from parsec_tpu.comm.engine import CommEngine
         copy = tile.data.pull_to_host()
-        return {"tp": self.taskpool_id, "kind": kind,
-                "tile": tile.wire_key, "ver": ver,
-                **CommEngine.pack(copy.payload)}
+        arr = np.asarray(copy.payload)
+        base = {"tp": self.taskpool_id, "kind": kind,
+                "tile": tile.wire_key, "ver": ver}
+        eager = int(params.get("comm_eager_limit", 65536))
+        comm = self.context.comm if self.context is not None else None
+        if comm is not None and arr.nbytes > eager:
+            # snapshot: the datum may be rewritten by later local
+            # writers before the consumer pulls
+            rid = comm.ce.mem_register(arr.copy(), once=True)
+            return {**base, "ref": rid, "from": self.myrank}
+        return {**base, **CommEngine.pack(arr)}
 
     def _send_payload(self, dst: int, tile: DTDTile, ver: int) -> None:
         self.context.comm.dtd_send(dst, self._wire_msg("data", tile, ver))
@@ -597,7 +611,29 @@ class DTDTaskpool(Taskpool):
     def _dtd_incoming(self, src: int, msg: dict) -> None:
         """Comm-thread entry for DTD payload/flush messages."""
         from parsec_tpu.comm.engine import CommEngine
-        arr = CommEngine.unpack(msg)
+        if "ref" in msg:
+            # rendezvous: pull the registered snapshot from the producer
+            # (the pending-pull count was taken atomically with the
+            # message credit in RemoteDepEngine._dtd_cb)
+            comm = self.context.comm
+
+            def on_data(arr, msg=msg, comm=comm):
+                try:
+                    if arr is None:
+                        self.context.record_error(RuntimeError(
+                            f"DTD rendezvous pull of {msg['tile']} "
+                            f"v{msg['ver']} from rank {msg['from']} "
+                            "failed"), None)
+                        return
+                    self._dtd_payload(msg, arr)
+                finally:
+                    comm.dtd_ref_done()
+
+            comm.ce.get(msg["from"], msg["ref"], on_data)
+            return
+        self._dtd_payload(msg, CommEngine.unpack(msg))
+
+    def _dtd_payload(self, msg: dict, arr: np.ndarray) -> None:
         wire = tuple(msg["tile"])
         if msg["kind"] == "data":
             key = (wire, msg["ver"])

@@ -257,3 +257,43 @@ def _device_chain(ctx, rank, nranks):
 
 def test_dtd_distributed_device_chain():
     assert run_distributed(_device_chain, 2, timeout=240) == ["ok"] * 2
+
+
+# -- rendezvous path for large DTD payloads ---------------------------------
+
+def _rdv_chain(ctx, rank, nranks):
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT
+    from parsec_tpu.utils.mca import params
+
+    params.set("comm_eager_limit", 64)    # force every tile over the limit
+    try:
+        V = VectorTwoDimCyclic(mb=256, lm=256, nodes=nranks, myrank=rank)
+        if rank == 0:
+            V.data_of(0).copy_on(0).payload[:] = 0.0
+        tp = _make_pool(ctx, "rdv")
+        t = tp.tile_of(V, 0)
+        steps = 6
+        for i in range(steps):
+            tp.insert_task(lambda T: T + 1.0, (t, INOUT),
+                           (i % nranks, AFFINITY))
+        tp.wait(timeout=120)
+        ctx.wait(timeout=120)
+        # the serve-once regions drain as the last GETs are served — a
+        # peer's pull may complete a beat after our quiescence returns
+        import time
+        deadline = time.monotonic() + 15
+        while ctx.comm.ce._regions and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not ctx.comm.ce._regions, dict(ctx.comm.ce._regions)
+        assert ctx.comm.dtd_refs_pending == 0
+        if rank == 0:
+            val = np.asarray(V.data_of(0).pull_to_host().payload)
+            np.testing.assert_allclose(val, float(steps))
+    finally:
+        params.unset("comm_eager_limit")
+    return "ok"
+
+
+def test_dtd_rendezvous_large_payloads():
+    assert run_distributed(_rdv_chain, 2, timeout=240) == ["ok"] * 2
