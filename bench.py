@@ -18,6 +18,7 @@ import json
 import os
 import sys
 import time
+from typing import Tuple
 
 import numpy as np
 
@@ -77,12 +78,15 @@ _CSUM = {}
 
 
 def _fence(C) -> float:
-    """True execution fence: an on-device checksum of every written C
-    tile, fetched to host.  Over the axon tunnel ``block_until_ready``
-    acks the RPC enqueue, NOT completion — only a device->host transfer
-    observes the finished computation, so the timed region must end with
-    one (the insert+wait contract of dtd_test_simple_gemm.c:659-666
-    assumes synchronous completion; this restores it)."""
+    """Execution fence + dedup guard: an on-device checksum of every
+    written C tile, fetched to host.  Context.wait already ends in
+    ``block_until_ready`` on the last dispatched outputs, which measures
+    honestly on fresh work over the axon tunnel (verified: wait time
+    scales with compute) — but identical repeated computations can be
+    deduped server-side, so each rep ALSO fences with a D2H readback and
+    the rep's wall time is trusted only when that fence returns within
+    the idle-RTT noise bound (see the rep loops); otherwise the fence
+    time is folded into the timed region (ADVICE r2 medium)."""
     import jax
     import jax.numpy as jnp
     outs = []
@@ -103,8 +107,76 @@ def _fence(C) -> float:
     return float(np.asarray(f(*outs)))
 
 
+def _fence_rtt(M) -> float:
+    """Idle fence round-trip: the checksum fence timed when the device
+    has no outstanding work.  The per-rep noise bound everything above
+    idle-RTT is charged against."""
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        _fence(M)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _honest_dt(dt: float, fence_dt: float, rtt0: float,
+               floor: float = 0.0) -> Tuple[float, bool]:
+    """The rep's accountable wall time: ``dt`` when the post-wait fence
+    returned within noise of the idle RTT (wait()'s device sync covered
+    completion) AND the rep is physically plausible (>= the time the
+    chip's peak rate needs for the useful flops), else ``dt + fence_dt``
+    (the sync under-reported; the fence observed the real completion)."""
+    if fence_dt > 2.0 * rtt0 + 0.05 or dt < floor:
+        if dt + fence_dt < floor:
+            # even fence-inclusive the rep is physically impossible
+            # (server-side dedup slipped through): it must not publish
+            return -1.0, False
+        return dt + fence_dt, False
+    return dt, True
+
+
+_PERT = {}
+
+
+def _perturb(M, r: int) -> None:
+    """Distinct inputs per rep: bump the first local tile of ``M`` by a
+    rep-dependent scalar (on device when resident).  Identical repeated
+    computations can be deduped/cached server-side over the tunnel —
+    a deduped rep would pass both wait() and the fence within noise and
+    publish an impossible number; perturbation makes every rep fresh
+    work, which is what the honest-fence methodology is calibrated for."""
+    try:
+        first = next(iter(M.local_tiles()))
+    except StopIteration:
+        log("WARNING: _perturb no-op (no local tiles) — dedup-proofing "
+            "disabled for this rep")
+        return
+    d = M.data_of(*first)
+    v = d.newest_version()
+    for sp, c in list(d.copies().items()):
+        p = c.payload
+        if c.version == v and p is not None \
+                and not isinstance(p, np.ndarray):
+            import jax
+            import jax.numpy as jnp
+            f = _PERT.get("f")
+            if f is None:
+                f = _PERT["f"] = jax.jit(
+                    lambda x, s: x + s.astype(x.dtype))
+            d.overwrite_on(sp, f(p, jnp.float32(1e-3 * (r + 1))))
+            return
+    c = d.pull_to_host()
+    if c is not None and c.payload is not None:
+        arr = np.asarray(c.payload).copy()
+        arr.flat[0] += 1e-3 * (r + 1)
+        d.overwrite_host(arr)
+    else:
+        log("WARNING: _perturb no-op (no materialized copy) — "
+            "dedup-proofing disabled for this rep")
+
+
 def run_gemm_bench(mb: int, mt: int, nt: int, kt: int, reps: int = 3,
-                   ab_dtype=np.float32):
+                   ab_dtype=np.float32, peak_gflops: float = 0.0):
     from parsec_tpu.apps.gemm import gemm_taskpool, total_flops
     from parsec_tpu.core.context import Context
     from parsec_tpu.data.matrix import TwoDimBlockCyclic
@@ -133,35 +205,46 @@ def run_gemm_bench(mb: int, mt: int, nt: int, kt: int, reps: int = 3,
                 blk = block.astype(M.dtype)
                 for m, n in M.local_tiles():
                     M.data_of(m, n).copy_on(0).payload[:] = blk
-        # warmup: jit-compiles the tile kernel (first TPU compile 20-40s);
-        # the checksum fence proves true completion once, and per-rep
-        # fences run OUTSIDE the timed region (the insert+wait contract
-        # measures runtime quiescence — Context.wait's device sync blocks
-        # on the last dispatched outputs — not a D2H readback; data stays
-        # device-resident exactly like the reference leaves tiles on GPU)
+        # warmup: jit-compiles the tile kernel (first TPU compile 20-40s).
+        # Per-rep accounting: Context.wait's device sync ends in
+        # block_until_ready on the last outputs — honest on fresh work —
+        # and each rep's post-wait checksum fence must return within the
+        # idle-RTT noise bound or its time is charged to the rep
+        # (insert+wait contract of dtd_test_simple_gemm.c:659-666).
         t0 = time.perf_counter()
         ctx.add_taskpool(gemm_taskpool(A, B, C))
         ctx.wait()
         _fence(C)
         log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
+        rtt0 = _fence_rtt(C)
+        log(f"idle fence RTT: {rtt0 * 1e3:.0f} ms")
+        floor = flops / (peak_gflops * 1e9) if peak_gflops else 0.0
         for r in range(reps):
+            _perturb(A, r)   # fresh work every rep: dedup-proof
             t0 = time.perf_counter()
             ctx.add_taskpool(gemm_taskpool(A, B, C))
             ctx.wait()
             dt = time.perf_counter() - t0
             fs = _fence(C)
             fence_dt = time.perf_counter() - t0 - dt
+            dt, in_noise = _honest_dt(dt, fence_dt, rtt0, floor)
+            if dt < 0:
+                log(f"rep {r}: DISCARDED (physically implausible even "
+                    f"fence-inclusive — dedup suspected)")
+                continue
             gf = flops / dt / 1e9
             best = max(best, gf)
             log(f"rep {r}: {dt * 1e3:.1f} ms -> {gf:.1f} GFLOP/s "
-                f"(post-fence +{fence_dt * 1e3:.0f} ms, csum={fs:.3e})")
+                f"(post-fence +{fence_dt * 1e3:.0f} ms"
+                f"{'' if in_noise else ' COUNTED'}, csum={fs:.3e})")
         for d in ctx.device_registry.accelerators:
             if d.stats.executed_tasks:
                 log(f"{d.name}: {d.stats.as_dict()}")
     return best
 
 
-def run_potrf_bench(mb: int, nt: int, reps: int = 3):
+def run_potrf_bench(mb: int, nt: int, reps: int = 3,
+                    peak_gflops: float = 0.0):
     """North-star metric: tiled Cholesky (BASELINE.json names DPLASMA
     dpotrf as the headline; contract like dtd_test_simple_gemm — wall
     time over insert+wait, n^3/3 useful flops)."""
@@ -197,17 +280,28 @@ def run_potrf_bench(mb: int, nt: int, reps: int = 3):
         ctx.wait()
         _fence(A)
         log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
+        rtt0 = _fence_rtt(A)
+        log(f"idle fence RTT: {rtt0 * 1e3:.0f} ms")
+        floor = flops / (peak_gflops * 1e9) if peak_gflops else 0.0
         for r in range(reps):
             reset()
-            t0 = time.perf_counter()
+            _perturb(A, r)   # reset() regenerates IDENTICAL data: make
+            t0 = time.perf_counter()   # each rep fresh work (dedup-proof)
             ctx.add_taskpool(potrf_taskpool(A, device="tpu"))
             ctx.wait()
             dt = time.perf_counter() - t0
             fs = _fence(A)
+            fence_dt = time.perf_counter() - t0 - dt
+            dt, in_noise = _honest_dt(dt, fence_dt, rtt0, floor)
+            if dt < 0:
+                log(f"rep {r}: DISCARDED (physically implausible even "
+                    f"fence-inclusive — dedup suspected)")
+                continue
             gf = flops / dt / 1e9
             best = max(best, gf)
             log(f"rep {r}: {dt * 1e3:.1f} ms -> {gf:.1f} GFLOP/s "
-                f"(csum={fs:.3e})")
+                f"(post-fence +{fence_dt * 1e3:.0f} ms"
+                f"{'' if in_noise else ' COUNTED'}, csum={fs:.3e})")
     return best
 
 
@@ -221,9 +315,10 @@ def main():
         # panel chain serializes against ~2.4ms/launch tunnel latency)
         mb = int(os.environ.get("PARSEC_BENCH_MB", 6144 if on_tpu else 32))
         nt = int(os.environ.get("PARSEC_BENCH_NT", 8 if on_tpu else 4))
-        value = run_potrf_bench(
-            mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 3)))
         peak = _PEAKS.get(platform, 100.0)
+        value = run_potrf_bench(
+            mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 3)),
+            peak_gflops=peak)
         print(json.dumps({
             "metric": "tiled_potrf_gflops",
             "value": round(value, 1),
@@ -242,10 +337,11 @@ def main():
     reps = int(os.environ.get("PARSEC_BENCH_REPS", 3))
     ab = os.environ.get("PARSEC_BENCH_AB_DTYPE", "bfloat16" if on_tpu
                         else "float32")
+    peak = _PEAKS.get(platform, 100.0)
     value = run_gemm_bench(mb, mt, nt, kt, reps=reps,
                            ab_dtype=np.dtype(ab) if ab != "bfloat16"
-                           else __import__("ml_dtypes").bfloat16)
-    peak = _PEAKS.get(platform, 100.0)
+                           else __import__("ml_dtypes").bfloat16,
+                           peak_gflops=peak)
     target = 0.55 * peak
     print(json.dumps({
         "metric": "tiled_gemm_gflops",

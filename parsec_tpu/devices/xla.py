@@ -123,6 +123,11 @@ class XlaKernel:
         return normalize_body_outputs(result, self.writable, what="kernel")
 
 
+#: marks an LRU entry as an in-progress adopt claim (distinguishable from
+#: a real accounted entry even at nbytes == 0)
+_PLACEHOLDER = object()
+
+
 class _Inflight:
     __slots__ = ("es", "task", "spec", "outputs", "pinned", "load",
                  "release_after")
@@ -183,7 +188,10 @@ class XlaDevice(Device):
         #: drops the accounting when the copy dies with its datum.
         self._lru: "OrderedDict[int, Tuple[Any, int, Any]]" = OrderedDict()
         self._pins: Dict[int, int] = {}
-        self._mem_lock = threading.Lock()
+        # a Condition so adopt() can WAIT for a concurrent claim on the
+        # same datum to resolve instead of polling (notified whenever a
+        # placeholder resolves); plain `with self._mem_lock:` still works
+        self._mem_lock = threading.Condition()
 
         self._pending: deque = deque()
         self._inflight: deque = deque()
@@ -561,25 +569,50 @@ class XlaDevice(Device):
         key = id(datum)
         nbytes = getattr(dc.payload, "nbytes", 0)
         with self._mem_lock:
-            if key in self._lru:
-                return          # already accounted (payload refresh)
-            # placeholder claims the key atomically with the check, so a
-            # concurrent adopt/stage-in of the same datum cannot double-
-            # account; pinned so eviction skips the 0-byte stub
-            self._lru[key] = (weakref.ref(dc), 0, None)
-            self._pins[key] = self._pins.get(key, 0) + 1
+            while True:
+                ent = self._lru.get(key)
+                if ent is None:
+                    # placeholder claims the key atomically with the
+                    # check, so a concurrent adopt/stage-in of the same
+                    # datum cannot double-account; pinned so eviction
+                    # skips the stub
+                    self._lru[key] = (weakref.ref(dc), 0, _PLACEHOLDER)
+                    self._pins[key] = self._pins.get(key, 0) + 1
+                    break
+                if ent[2] is not _PLACEHOLDER:
+                    return      # already accounted (payload refresh)
+                # another adopt of this datum is mid-reserve: wait for it
+                # to resolve (account or fail) rather than piggy-backing
+                # on a claim that may yet be rolled back (ADVICE r2 low)
+                self._mem_lock.wait(0.05)
+        def _drop_pin_locked():
+            n = self._pins.get(key, 0) - 1
+            if n <= 0:
+                self._pins.pop(key, None)
+            else:
+                self._pins[key] = n
+
         try:
             off = self._reserve(nbytes)
-        finally:
+        except BaseException:
+            # roll the placeholder back, or every later adopt of this
+            # datum early-returns "already accounted" and its bytes never
+            # hit the budget (ADVICE r2 low)
             with self._mem_lock:
-                n = self._pins.get(key, 0) - 1
-                if n <= 0:
-                    self._pins.pop(key, None)
-                else:
-                    self._pins[key] = n
+                ent = self._lru.get(key)
+                if ent is not None and ent[2] is _PLACEHOLDER:
+                    self._lru.pop(key)
+                _drop_pin_locked()
+                self._mem_lock.notify_all()
+            raise
         with self._mem_lock:
+            # entry lands and the claim pin drops under ONE lock hold: an
+            # unpinned placeholder must never be visible to a concurrent
+            # victim scan (it would _evict the just-adopted copy)
             self._lru[key] = (weakref.ref(dc), nbytes, off)
             self._bytes_used += nbytes
+            _drop_pin_locked()
+            self._mem_lock.notify_all()
         weakref.finalize(dc, self._forget, key, nbytes)
         self.stats.bytes_in += nbytes
 
@@ -653,7 +686,8 @@ class XlaDevice(Device):
                 self._zone_free(ent[2])
 
     def _zone_free(self, offset: Any) -> None:
-        if self._zone is not None and offset is not None:
+        if self._zone is not None and offset is not None \
+                and offset is not _PLACEHOLDER:
             self._zone.free(offset)
 
     def _reserve(self, nbytes: int) -> Any:

@@ -223,6 +223,12 @@ class DTDTaskpool(Taskpool):
         another rank) get a *shadow* datum: a local buffer of the tile's
         shape that receives forwarded versions and hosts locally-placed
         writes until the flush home."""
+        if self.context is None:
+            # home/shadow resolution needs the pool's rank: before attach
+            # it would silently classify every tile as local (myrank=0 /
+            # nranks=1) and skip the surrogate protocol (ADVICE r2 low)
+            raise RuntimeError(
+                "attach the DTD pool to a context before tile_of")
         key = (id(dc), dc.data_key(*indices))
         home = dc.rank_of(*indices)
         with self._dep_lock:
@@ -525,7 +531,14 @@ class DTDTaskpool(Taskpool):
 
     def _surrogate_write(self, tile: DTDTile) -> None:
         """Advance the tile's version past a remote write, leaving a
-        delivery surrogate as last writer (caller holds _dep_lock)."""
+        delivery surrogate as last writer (caller holds _dep_lock).
+
+        The WAW edge chains through EVERY surrogate — including unneeded
+        ones — so WAR edges from still-pending readers of older versions
+        survive skipped versions (the reference chains every fake remote
+        writer, insert_function.c:3014-3163; ADVICE r2 high).  A
+        surrogate whose ordering obligations are already met completes in
+        place (``done``) instead of dangling; _edge then skips it."""
         tile.version += 1
         d = _DTDState(None, rank=self.myrank)
         d.is_recv = True
@@ -534,8 +547,10 @@ class DTDTaskpool(Taskpool):
         for r in tile.readers:       # WAR: local readers finish first
             self._edge(r, d)
         lw = tile.last_writer        # WAW: order in-place datum writes
-        if lw is not None and (not lw.is_recv or lw.needed):
+        if lw is not None:
             self._edge(lw, d)
+        if d.remaining == 0:
+            d.done = True            # no pending obligations: pass-through
         tile.last_writer = d
         tile.readers = []
 
@@ -550,9 +565,16 @@ class DTDTaskpool(Taskpool):
                      to_schedule: List[Task]) -> None:
         """First local consumer of a surrogate's version: make it a real
         (counted, schedulable) task expecting the network payload (caller
-        holds _dep_lock)."""
-        if d.needed or d.done:
+        holds _dep_lock).
+
+        A surrogate that completed IN PLACE (unneeded pass-through whose
+        ordering obligations were already met — it is necessarily still
+        the tile's last writer, with no successors) is revived here: its
+        only remaining job is applying the payload before the new
+        consumer runs."""
+        if d.needed:
             return
+        d.done = False               # revive a pass-through completion
         d.needed = True
         task = Task(self._recv_class(), self, {"tid": next(_seq)})
         task.dtd = d
@@ -690,7 +712,9 @@ class DTDTaskpool(Taskpool):
                 d.is_recv, d.tile, d.version = True, tile, 0
                 tile.last_writer = lw = d
             if lw is not None:
-                if lw.is_recv and not lw.done:
+                if lw.is_recv:
+                    # revives an in-place-completed (pass-through)
+                    # surrogate; a needed one that already ran is kept
                     self._mark_needed(lw, to_schedule)
                 self._edge(lw, state)              # RAW
             tile.readers.append(state)
@@ -703,13 +727,14 @@ class DTDTaskpool(Taskpool):
                 d.is_recv, d.tile, d.version = True, tile, 0
                 tile.last_writer = lw = d
             if lw is not None:                     # WAW (+ RAW for INOUT)
-                if lw.is_recv:
-                    if mode is INOUT and not lw.done:
-                        self._mark_needed(lw, to_schedule)
-                    if lw.needed:   # unneeded surrogates never run: no
-                        self._edge(lw, state)      # in-place write to order
-                else:
-                    self._edge(lw, state)
+                if lw.is_recv and mode is INOUT:
+                    # INOUT reads the surrogate's version: needs payload
+                    self._mark_needed(lw, to_schedule)
+                # chain WAW through every writer, surrogates included —
+                # _edge skips only a DONE one, whose ordering obligations
+                # (WAR from pending readers, earlier WAW) are all met
+                # (ADVICE r2 high)
+                self._edge(lw, state)
             tile.version += 1
             tile.last_writer = state
             tile.readers = []
@@ -742,12 +767,26 @@ class DTDTaskpool(Taskpool):
                 outgoing.append((dst, self._wire_msg("data", tile, ver)))
                 encoded.add((dst, tile, ver))
         with self._window:
-            for succ in state.successors:
-                if grapher is not None and succ.task is not None:
-                    grapher.edge(task, succ.task.key, "dtd")
+            # worklist: an unneeded surrogate whose last obligation clears
+            # completes IN PLACE (no task to run) and propagates to its
+            # own successors immediately — the ordering chain through
+            # skipped versions stays intact (ADVICE r2 high)
+            pending = [(state, s) for s in state.successors]
+            while pending:
+                pred, succ = pending.pop()
+                if grapher is not None and succ.task is not None \
+                        and pred.task is not None:
+                    # cascaded edges (pred = an in-place-completed
+                    # surrogate, task None) are not drawn: attributing
+                    # them to the outer task would fabricate DAG edges
+                    grapher.edge(pred.task, succ.task.key, "dtd")
                 succ.remaining -= 1
-                if succ.remaining == 0 and succ.task is not None \
-                        and (not succ.is_recv or succ.needed):
+                if succ.remaining != 0:
+                    continue
+                if succ.is_recv and not succ.needed:
+                    succ.done = True
+                    pending.extend((succ, s) for s in succ.successors)
+                elif succ.task is not None:
                     ready.append(succ.task)
             if self._inflight < self.threshold:
                 self._window.notify_all()

@@ -259,6 +259,106 @@ def test_dtd_distributed_device_chain():
     assert run_distributed(_device_chain, 2, timeout=240) == ["ok"] * 2
 
 
+# -- ordering must survive SKIPPED surrogate versions (ADVICE r2 high) ------
+
+def _skipped_version_reader(ctx, rank, nranks):
+    """Two consecutive remote writes whose intermediate version has no
+    local consumer: the recv-apply of the LATER version must still wait
+    for a pending local reader of an older version (WAR through the
+    skipped surrogate's WAW chain).  Pre-fix, the unneeded v2 surrogate
+    dead-ended the chain and v3's payload overwrote the host copy while
+    the slow reader was mid-body."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, INPUT, OUTPUT
+
+    V = VectorTwoDimCyclic(mb=4, lm=4, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 0.0
+    R = VectorTwoDimCyclic(mb=4, lm=8 * nranks, nodes=nranks, myrank=rank,
+                           name="R")
+    for m, _ in R.local_tiles():
+        R.data_of(m).copy_on(0).payload[:] = -1.0
+    tp = _make_pool(ctx, "skip-war")
+    t = tp.tile_of(V, 0)              # home: rank 0; every write there
+    res1 = tp.tile_of(R, 1)           # home: rank 1
+    res2 = tp.tile_of(R, nranks + 1)  # home: rank 1
+
+    def slow_read(s, out):
+        import time
+        time.sleep(1.0)               # v3's payload arrives mid-body
+        return np.asarray(s).copy()
+
+    tp.insert_task(lambda T: T + 1.0, (t, INOUT), (0, AFFINITY))  # v1
+    tp.insert_task(slow_read, (t, INPUT), (res1, OUTPUT), (1, AFFINITY))
+    tp.insert_task(lambda T: T + 1.0, (t, INOUT), (0, AFFINITY))  # v2: no
+    tp.insert_task(lambda T: T + 1.0, (t, INOUT), (0, AFFINITY))  # reader
+    tp.insert_task(lambda s, out: np.asarray(s).copy(),           # needs v3
+                   (t, INPUT), (res2, OUTPUT), (1, AFFINITY))
+    tp.wait(timeout=120)
+    ctx.wait(timeout=120)
+    if rank == 1:
+        got1 = np.asarray(R.data_of(1).pull_to_host().payload)
+        np.testing.assert_allclose(got1, 1.0)   # the slow reader saw v1
+        got2 = np.asarray(R.data_of(nranks + 1).pull_to_host().payload)
+        np.testing.assert_allclose(got2, 3.0)   # the late reader saw v3
+    if rank == 0:
+        final = np.asarray(V.data_of(0).pull_to_host().payload)
+        np.testing.assert_allclose(final, 3.0)
+    return "ok"
+
+
+def test_dtd_skipped_surrogate_reader_order():
+    assert run_distributed(_skipped_version_reader, 2,
+                           timeout=240) == ["ok"] * 2
+
+
+def _skipped_version_local_writer(ctx, rank, nranks):
+    """A LOCAL writer after a skipped remote version must wait for the
+    pending reader of the older version (WAW through the unneeded
+    surrogate carries the WAR edge).  Pre-fix the local-OUTPUT path
+    skipped the edge and the overwrite raced the reader."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, INPUT, OUTPUT
+
+    V = VectorTwoDimCyclic(mb=4, lm=4, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 0.0
+    R = VectorTwoDimCyclic(mb=4, lm=4 * nranks, nodes=nranks, myrank=rank,
+                           name="R")
+    for m, _ in R.local_tiles():
+        R.data_of(m).copy_on(0).payload[:] = -1.0
+    tp = _make_pool(ctx, "skip-waw")
+    t = tp.tile_of(V, 0)
+    res = tp.tile_of(R, 1)
+
+    def slow_read(s, out):
+        import time
+        time.sleep(1.0)
+        return np.asarray(s).copy()
+
+    tp.insert_task(lambda T: T + 1.0, (t, INOUT), (0, AFFINITY))  # v1
+    tp.insert_task(slow_read, (t, INPUT), (res, OUTPUT), (1, AFFINITY))
+    tp.insert_task(lambda T: T + 1.0, (t, INOUT), (0, AFFINITY))  # v2: no
+    # pure OUTPUT on rank 1: overwrites without reading — but only after
+    # the slow reader of v1 is done                               # reader
+    tp.insert_task(lambda T: np.full((4,), 50.0, np.float32),
+                   (t, OUTPUT), (1, AFFINITY))
+    tp.wait(timeout=120)
+    ctx.wait(timeout=120)
+    if rank == 1:
+        got = np.asarray(R.data_of(1).pull_to_host().payload)
+        np.testing.assert_allclose(got, 1.0)    # reader saw v1, not 50
+    if rank == 0:
+        final = np.asarray(V.data_of(0).pull_to_host().payload)
+        np.testing.assert_allclose(final, 50.0)
+    return "ok"
+
+
+def test_dtd_skipped_surrogate_local_writer_order():
+    assert run_distributed(_skipped_version_local_writer, 2,
+                           timeout=240) == ["ok"] * 2
+
+
 # -- rendezvous path for large DTD payloads ---------------------------------
 
 def _rdv_chain(ctx, rank, nranks):
