@@ -302,6 +302,9 @@ def run_potrf_bench(mb: int, nt: int, reps: int = 3,
             log(f"rep {r}: {dt * 1e3:.1f} ms -> {gf:.1f} GFLOP/s "
                 f"(post-fence +{fence_dt * 1e3:.0f} ms"
                 f"{'' if in_noise else ' COUNTED'}, csum={fs:.3e})")
+        for d in ctx.device_registry.accelerators:
+            if d.stats.executed_tasks:
+                log(f"{d.name}: {d.stats.as_dict()}")
     return best
 
 
@@ -311,13 +314,19 @@ def main():
     log(f"platform: {platform}, devices: {len(jax.devices())}")
     on_tpu = platform in ("tpu", "axon")
     if os.environ.get("PARSEC_BENCH_APP", "gemm") == "potrf":
-        # sweep on v5e: 4096/8 -> 33.7, 6144/8 -> 40.0 TFLOP/s (the
-        # panel chain serializes against ~2.4ms/launch tunnel latency)
+        # r3: TRSM runs as matmul against the POTRF-emitted triangular
+        # inverse (apps/potrf.py tri_inv — jsl trsm measured ~18 TF/s vs
+        # matmul ~150 TF/s on v5e) and same-class waves ride fused
+        # launches (devices/xla.py device_fuse), so larger tile grids now
+        # pay off: the r2 sweep (4096/8 -> 33.7, 6144/8 -> 40.0 TFLOP/s)
+        # was launch-latency-bound on the serialized panel chain
         mb = int(os.environ.get("PARSEC_BENCH_MB", 6144 if on_tpu else 32))
-        nt = int(os.environ.get("PARSEC_BENCH_NT", 8 if on_tpu else 4))
+        nt = int(os.environ.get("PARSEC_BENCH_NT", 10 if on_tpu else 4))
         peak = _PEAKS.get(platform, 100.0)
+        # 4 reps: the first timed rep still hits a few fresh fused-width
+        # compiles; best-of converges by rep 2-3
         value = run_potrf_bench(
-            mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 3)),
+            mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 4)),
             peak_gflops=peak)
         print(json.dumps({
             "metric": "tiled_potrf_gflops",

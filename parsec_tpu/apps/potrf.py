@@ -6,51 +6,115 @@ follows the classic four-kernel tiled algorithm the reference's PTG model
 was built for — README.rst:22-27 "compact problem-size-independent
 representation"):
 
-    POTRF(k)    : L[k,k]  = chol(A[k,k])
-    TRSM(m,k)   : A[m,k]  = A[m,k] @ L[k,k]^-T          (m > k)
-    SYRK(k,m)   : A[m,m] -= A[m,k] @ A[m,k]^T           (k < m)
-    GEMM(m,n,k) : A[m,n] -= A[m,k] @ A[n,k]^T           (m > n > k)
+    POTRF(k)    : L[k,k]  = chol(A[k,k]);  W[k] = L[k,k]^-1
+    TRSM(m,k)   : A[m,k]  = A[m,k] @ W[k]^T                 (m > k)
+    SYRK(k,m)   : A[m,m] -= A[m,k] @ A[m,k]^T               (k < m)
+    GEMM(m,n,k) : A[m,n] -= A[m,k] @ A[n,k]^T               (m > n > k)
 
 Every flow is task-to-task except the first touch of each tile, so the
-same taskpool runs single-chip or distributed (TRSM panels broadcast down
-their block row/column through the comm layer's bcast trees).
+same taskpool runs single-chip or distributed (the W panel broadcasts down
+its block column through the comm layer's bcast trees).
 
-TPU notes: all four kernels are single fused XLA ops (cholesky,
-triangular solve, two matmuls) jitted once per tile shape; the priority
-schedule drives the critical path (POTRF > TRSM > SYRK > GEMM at equal
-k) exactly like DPLASMA's priority hints.
+TPU-first design of the solve step: XLA's ``triangular_solve`` runs an
+order of magnitude below matmul peak on TPU (it serializes block
+back-substitution), so POTRF additionally emits the tile inverse W =
+L^-1 — computed by recursive block inversion whose leaves use the Newton
+iteration X <- X(2I - LX).  For triangular L with X0 = diag(L)^-1 the
+residual I - LX0 is strictly lower triangular, i.e. NILPOTENT, and the
+iteration SQUARES it, so ceil(log2(n)) iterations reach the exact
+inverse — everything is matmuls on the MXU.  Each TRSM then becomes a
+single matmul A[m,k] @ W^T at full systolic-array rate instead of a
+triangular solve.  The extra mb^3/3 inverse flops per panel are ~1% of
+the factorization and buy back a >4x faster panel wave (measured on
+v5e: jsl trsm ~18 TF/s vs matmul ~150 TF/s).
+
+The priority schedule drives the critical path (POTRF > TRSM > SYRK >
+GEMM at equal k) exactly like DPLASMA's priority hints, and same-class
+waves (the TRSM panel, the SYRK/GEMM trailing updates) are fused into
+single XLA launches by the device layer's wavefront launch fusion
+(devices/xla.py) so the runtime amortizes per-launch latency.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import numpy as np
 
 from parsec_tpu.core.taskpool import ParameterizedTaskpool
 from parsec_tpu.data.matrix import TiledMatrix
-from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+from parsec_tpu.dsl.ptg.api import DATA, IN, NEW, OUT, PTG, Range, TASK
 
 _kernels = {}
+
+#: recursive-inversion leaf: below this order the Newton iteration runs
+#: directly (log2(leaf) matmuls of leaf x leaf — MXU noise)
+_INV_LEAF = 512
+
+
+def tri_inv(L, precision=None):
+    """Lower-triangular inverse as pure matmuls (jax-traceable).
+
+    Recursive 2x2 block inversion
+        [[L11, 0], [L21, L22]]^-1 =
+        [[X11, 0], [-X22 @ L21 @ X11, X22]]
+    with Newton--Schulz leaves: X <- X(2I - LX) starting from
+    X0 = diag(L)^-1 converges EXACTLY in ceil(log2(n)) steps because the
+    initial residual I - LX0 is strictly triangular (nilpotent) and each
+    step squares it.  No triangular solve anywhere: everything lowers to
+    the systolic array.
+    """
+    import jax.numpy as jnp
+    n = L.shape[0]
+    if n <= _INV_LEAF:
+        X = jnp.diag(1.0 / jnp.diag(L))
+        I = jnp.eye(n, dtype=L.dtype)
+        for _ in range(int(math.ceil(math.log2(max(n, 2)))) + 1):
+            X = jnp.matmul(X, 2.0 * I - jnp.matmul(L, X,
+                                                   precision=precision),
+                           precision=precision)
+        return X
+    h = n // 2
+    X11 = tri_inv(L[:h, :h], precision)
+    X22 = tri_inv(L[h:, h:], precision)
+    X21 = -jnp.matmul(X22, jnp.matmul(L[h:, :h], X11, precision=precision),
+                      precision=precision)
+    top = jnp.concatenate([X11, jnp.zeros((h, n - h), L.dtype)], axis=1)
+    return jnp.concatenate([top, jnp.concatenate([X21, X22], axis=1)],
+                           axis=0)
 
 
 def _k_potrf(precision):
     fn = _kernels.get(("potrf", precision))
     if fn is None:
+        def fn(T, W):
+            import jax.numpy as jnp
+            L = jnp.linalg.cholesky(T)
+            return {"T": L, "W": tri_inv(L, precision)}
+        _kernels[("potrf", precision)] = fn
+    return fn
+
+
+def _k_potrf_last(precision):
+    # the last diagonal tile has no TRSM consumers: plain cholesky, no
+    # inverse flops and no W scratch on the critical path's final task
+    fn = _kernels.get(("potrf_last", precision))
+    if fn is None:
         def fn(T):
             import jax.numpy as jnp
             return jnp.linalg.cholesky(T)
-        _kernels[("potrf", precision)] = fn
+        _kernels[("potrf_last", precision)] = fn
     return fn
 
 
 def _k_trsm(precision):
     fn = _kernels.get(("trsm", precision))
     if fn is None:
-        def fn(L, C):
-            import jax.scipy.linalg as jsl
-            # C <- C @ L^-T  ==  (L^-1 C^T)^T
-            return jsl.solve_triangular(L, C.T, lower=True).T
+        def fn(W, C):
+            import jax.numpy as jnp
+            # C <- C @ L^-T  ==  C @ W^T  (W = L^-1 from POTRF)
+            return jnp.matmul(C, W.T, precision=precision)
         _kernels[("trsm", precision)] = fn
     return fn
 
@@ -93,27 +157,48 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
         return tb
 
     p = PTG("potrf", NT=NT)
+    p.arena("w", (mb, mb), dtype=A.dtype)
 
-    tb = p.task("POTRF", k=Range(0, NT - 1)) \
+    tb = p.task("POTRF", k=Range(0, NT - 2)) \
         .affinity(lambda k, A=A: A(k, k)) \
         .priority(lambda k, NT=NT: 3 * NT - 3 * k + 3) \
         .flow("T", "RW",
               IN(DATA(lambda k, A=A: A(k, k)), when=lambda k: k == 0),
               IN(TASK("SYRK", "T", lambda k: dict(k=k - 1, m=k)),
                  when=lambda k: k > 0),
-              OUT(TASK("TRSM", "L",
+              OUT(DATA(lambda k, A=A: A(k, k)))) \
+        .flow("W", "RW",
+              IN(NEW("w")),
+              OUT(TASK("TRSM", "W",
                        lambda k, NT=NT: [dict(m=m, k=k)
-                                         for m in range(k + 1, NT)]),
-                  when=lambda k, NT=NT: k < NT - 1),
-              OUT(DATA(lambda k, A=A: A(k, k))))
-    add_bodies(tb, _k_potrf(precision),
+                                         for m in range(k + 1, NT)])))
+
+    def cpu_potrf(T, W):
+        import scipy.linalg as sl
+        L = np.linalg.cholesky(np.asarray(T))
+        Winv = sl.solve_triangular(L, np.eye(L.shape[0], dtype=L.dtype),
+                                   lower=True)
+        return {"T": L, "W": Winv}
+    add_bodies(tb, _k_potrf(precision), cpu_potrf)
+
+    # the final diagonal tile: no panel below it, so no inverse is needed
+    tb = p.task("POTRFL") \
+        .affinity(lambda A=A, NT=NT: A(NT - 1, NT - 1)) \
+        .priority(lambda NT=NT: 6) \
+        .flow("T", "RW",
+              IN(DATA(lambda A=A, NT=NT: A(NT - 1, NT - 1)),
+                 when=lambda NT=NT: NT == 1),
+              IN(TASK("SYRK", "T", lambda NT=NT: dict(k=NT - 2, m=NT - 1)),
+                 when=lambda NT=NT: NT > 1),
+              OUT(DATA(lambda A=A, NT=NT: A(NT - 1, NT - 1))))
+    add_bodies(tb, _k_potrf_last(precision),
                lambda T: np.linalg.cholesky(np.asarray(T)))
 
     tb = p.task("TRSM", k=Range(0, NT - 2),
                 m=Range(lambda k: k + 1, NT - 1)) \
         .affinity(lambda m, k, A=A: A(m, k)) \
         .priority(lambda k, NT=NT: 3 * NT - 3 * k + 2) \
-        .flow("L", "READ", IN(TASK("POTRF", "T", lambda k: dict(k=k)))) \
+        .flow("W", "READ", IN(TASK("POTRF", "W", lambda k: dict(k=k)))) \
         .flow("C", "RW",
               IN(DATA(lambda m, k, A=A: A(m, k)), when=lambda k: k == 0),
               IN(TASK("GEMM", "C", lambda m, k: dict(m=m, n=k, k=k - 1)),
@@ -129,10 +214,8 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
                   when=lambda m, NT=NT: m < NT - 1),
               OUT(DATA(lambda m, k, A=A: A(m, k))))
 
-    def cpu_trsm(L, C):
-        import scipy.linalg as sl
-        return sl.solve_triangular(np.asarray(L), np.asarray(C).T,
-                                   lower=True).T
+    def cpu_trsm(W, C):
+        return np.asarray(C) @ np.asarray(W).T
     add_bodies(tb, _k_trsm(precision), cpu_trsm)
 
     tb = p.task("SYRK", m=Range(1, NT - 1), k=Range(0, lambda m: m - 1)) \
@@ -143,7 +226,9 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
               IN(TASK("SYRK", "T", lambda m, k: dict(m=m, k=k - 1)),
                  when=lambda k: k > 0),
               OUT(TASK("POTRF", "T", lambda m: dict(k=m)),
-                  when=lambda m, k: k == m - 1),
+                  when=lambda m, k, NT=NT: k == m - 1 and m < NT - 1),
+              OUT(TASK("POTRFL", "T", lambda: dict()),
+                  when=lambda m, k, NT=NT: k == m - 1 and m == NT - 1),
               OUT(TASK("SYRK", "T", lambda m, k: dict(m=m, k=k + 1)),
                   when=lambda m, k: k < m - 1)) \
         .flow("R", "READ", IN(TASK("TRSM", "C", lambda m, k: dict(m=m,
@@ -175,9 +260,13 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
 
     tp = p.build()
     for name, tc in tp.task_classes.items():
-        tc.properties["flops"] = {"POTRF": mb ** 3 / 3.0,
-                                  "TRSM": mb ** 3,
-                                  "SYRK": mb ** 3,
+        # executed-flop weights for device load balancing (TRSM runs as a
+        # full matmul against W, so it carries 2mb^3, not the mb^3 of a
+        # true triangular solve)
+        tc.properties["flops"] = {"POTRF": mb ** 3,
+                                  "POTRFL": mb ** 3 / 3.0,
+                                  "TRSM": 2.0 * mb ** 3,
+                                  "SYRK": 2.0 * mb ** 3,
                                   "GEMM": 2.0 * mb ** 3}[name]
     return tp
 

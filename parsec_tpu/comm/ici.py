@@ -45,6 +45,13 @@ params.register("comm_ici_enabled", 1,
 params.register("comm_ici_bcast_min", 2,
                 "minimum distinct consumer devices to trigger a collective "
                 "panel broadcast")
+params.register("comm_ici_permute_window_ms", 2.0,
+                "how long a deferred point-to-point placement may wait for "
+                "same-wavefront siblings before an idle worker flushes the "
+                "batch as CollectivePermute rounds")
+params.register("comm_ici_permute_min", 2,
+                "minimum batched edges to lower a flush to ppermute; "
+                "smaller flushes fall back to per-edge puts")
 
 
 class IciStats:
@@ -85,6 +92,17 @@ class IciEngine:
         self._mesh = None
         self._prog_cache: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
+        #: deferred single-consumer placements awaiting same-wavefront
+        #: siblings: (produced copy, destination space, enqueue time).
+        #: Flushed as batched CollectivePermute rounds (SURVEY §5.8's
+        #: "batched per DAG wavefront" schedule) when a full round
+        #: accumulates or an idle worker drains the window.
+        self._pending_edges: List[Tuple[DataCopy, int, float]] = []
+        self._pending_lock = threading.Lock()
+        #: when the last single-consumer edge was seen: a fresh edge after
+        #: a quiet spell is treated as a chain hop (placed immediately),
+        #: one arriving inside the window as a wavefront sibling (batched)
+        self._last_edge = float("-inf")
 
     # ------------------------------------------------------------------
     @property
@@ -300,6 +318,13 @@ class IciEngine:
                     existing.version >= copy.version:
                 return False  # already resident
         arr = self.put(copy.payload, space)
+        return self._attach_placed(copy, space, arr)
+
+    def _attach_placed(self, copy: DataCopy, space: int, arr) -> bool:
+        """Attach a freshly-moved replica to the datum as a SHARED copy on
+        ``space`` (version-guarded: a consumer that already wrote a newer
+        version wins) and register it with the device's HBM ledger."""
+        datum = copy.data
         placed = None
         with datum._lock:
             existing = datum.copy_on(space)
@@ -316,6 +341,140 @@ class IciEngine:
         if placed is not None:
             self._adopt(datum, [(space, placed)])
         return True
+
+    # ------------------------------------------------------------------
+    # deferred placement: batch single-consumer edges per DAG wavefront
+    # into CollectivePermute rounds (SURVEY §5.8; reference counterpart:
+    # the per-peer aggregation of the comm thread, remote_dep_mpi.c —
+    # here aggregation happens across DEVICE edges of one wavefront)
+    # ------------------------------------------------------------------
+    def defer_place(self, copy: DataCopy, space: int) -> bool:
+        """Queue a device-resident single-consumer placement; when the
+        batch completes a permutation round (every device sends/receives
+        at most once) — or an idle worker drains the window
+        (:meth:`flush_placements`) — the whole wavefront rides one
+        ``lax.ppermute`` launch instead of N separate puts.  Placement is
+        purely a prefetch: consumers that stage in before the flush win
+        the version race and the late replica is dropped."""
+        datum = copy.data
+        if datum is None or not self.device_resident(copy) \
+                or space not in self._jdev or copy.device == space \
+                or self.ndev < 2:
+            return False
+        with datum._lock:
+            existing = datum.copy_on(space)
+            if existing is not None and \
+                    existing.coherency != Coherency.INVALID and \
+                    existing.version >= copy.version:
+                return False  # already resident
+        import time
+        now = time.monotonic()
+        window = float(params.get("comm_ici_permute_window_ms", 2.0)) / 1e3
+        immediate = False
+        flush_now = None
+        with self._pending_lock:
+            if not self._pending_edges and now - self._last_edge > window:
+                # a lone edge after a quiet spell is a serialized chain
+                # hop until proven otherwise: place it NOW so the
+                # transfer overlaps scheduling (a deferred chain hop
+                # always loses the race against its consumer's lazy
+                # stage-in and the flush would be pure waste).  It also
+                # opens the wave window: siblings arriving within it DO
+                # defer, so a k-edge wavefront costs one put plus one
+                # (k-1)-edge permute — within the "k edges ride <=2
+                # launches" contract.
+                immediate = True
+            else:
+                self._pending_edges.append((copy, space, now))
+                full_round = any(
+                    e[0].device == copy.device or e[1] == space
+                    for e in self._pending_edges[:-1]) \
+                    or len(self._pending_edges) >= self.ndev - 1
+                if full_round:
+                    flush_now, self._pending_edges = self._pending_edges, []
+            self._last_edge = now
+        if immediate:
+            return self.preplace(copy, space)
+        if flush_now:
+            self._flush_edges(flush_now)
+        return True
+
+    def flush_placements(self, force: bool = False) -> int:
+        """Drain deferred placements older than the batching window (all
+        of them when ``force``).  Called from idle workers and quiescence
+        points; failures are swallowed — placement is best-effort
+        prefetch and consumers fall back to lazy stage-in."""
+        if not self._pending_edges:
+            return 0
+        import time
+        window = float(params.get("comm_ici_permute_window_ms", 2.0)) / 1e3
+        take = None
+        with self._pending_lock:
+            if self._pending_edges and (
+                    force or time.monotonic() - self._pending_edges[0][2]
+                    >= window):
+                take, self._pending_edges = self._pending_edges, []
+        if not take:
+            return 0
+        try:
+            self._flush_edges(take)
+        except Exception as exc:
+            debug_verbose(3, "ici flush_placements dropped %d edges: %s",
+                          len(take), exc)
+        return len(take)
+
+    def _flush_edges(self, edges) -> None:
+        live = []
+        for copy, space, _t in edges:
+            p = copy.payload
+            if p is None or (hasattr(p, "is_deleted") and p.is_deleted()):
+                continue     # evicted/donated since: consumer stages lazily
+            datum = copy.data
+            with datum._lock:
+                existing = datum.copy_on(space)
+                if existing is not None and \
+                        existing.coherency != Coherency.INVALID and \
+                        existing.version >= copy.version:
+                    # the consumer staged in (or wrote) while the edge sat
+                    # in the window: a collective for it would move bytes
+                    # nobody reads
+                    continue
+            live.append((copy, space))
+        if not live:
+            return
+        if len(live) < int(params.get("comm_ici_permute_min", 2)):
+            for copy, space in live:
+                self.preplace(copy, space)
+            return
+        # unique (src, dst) keys per permute() call: duplicate pairs would
+        # collide in its result map, so they go in follow-up calls
+        calls: List[List[Tuple[DataCopy, int]]] = []
+        for item in live:
+            key = (item[0].device, item[1])
+            for c in calls:
+                if all((e[0].device, e[1]) != key for e in c):
+                    c.append(item)
+                    break
+            else:
+                calls.append([item])
+        for c in calls:
+            try:
+                results = self.permute(
+                    [(copy.device, space, copy.payload)
+                     for copy, space in c])
+            except Exception as exc:
+                debug_verbose(3, "ici permute batch failed (%s); "
+                              "falling back to puts", exc)
+                for copy, space in c:
+                    try:
+                        self.preplace(copy, space)
+                    except Exception:
+                        pass      # best-effort prefetch
+                continue
+            for copy, space in c:
+                arr = results.get((copy.device, space))
+                if arr is not None:
+                    self._attach_placed(copy, space, arr)
 
     def device_resident(self, copy: DataCopy) -> bool:
         """Cheap hot-path gate: only device-resident produced copies are

@@ -155,6 +155,19 @@ class Context:
         if event in self._pins and cb in self._pins[event]:
             self._pins[event].remove(cb)
 
+    def flush_ici(self) -> None:
+        """Drain deferred wavefront placements (comm/ici.py defer_place)
+        whose batching window expired.  Best-effort prefetch: failures
+        must not kill the calling worker — consumers fall back to lazy
+        stage-in."""
+        if self.ici is None:
+            return
+        try:
+            self.ici.flush_placements()
+        except Exception as exc:
+            from parsec_tpu.utils.output import debug_verbose
+            debug_verbose(3, "flush_ici: %s", exc)
+
     # -- doorbell ----------------------------------------------------------
     def ring_doorbell(self, n: int = 1) -> None:
         with self._cond:

@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from parsec_tpu.containers.hash_table import REMOVE
 from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency, Data,
-                                  DataCopy, FLAG_COW)
+                                  DataCopy, FLAG_COW, FLAG_SCRATCH)
 from parsec_tpu.data.reshape import as_dtt, convert, needs_reshape
 from parsec_tpu.core.task import (Dep, Flow, FromDesc, FromTask, New, Null,
                                   Task, TaskClass, ToDesc, ToTask)
@@ -142,7 +142,12 @@ def prepare_input(es, task: Task) -> None:
                 raise RuntimeError(
                     f"{task}: flow {flow.name} needs arena "
                     f"{end.arena_name!r} but the taskpool has none")
-            task.data[flow.name] = arena.get_copy()
+            copy = arena.get_copy()
+            # the buffer is np.empty scratch: nothing may read it before
+            # the first write, so a device incarnation can materialize it
+            # directly in device memory (see XlaDevice._stage_in)
+            copy.flags |= FLAG_SCRATCH
+            task.data[flow.name] = copy
         elif isinstance(end, FromTask):
             if dep.multiplicity(task.locals) == 0:
                 # empty JDF range at a boundary instance: no edge, no data
@@ -357,7 +362,14 @@ def release_deps(es, task: Task) -> List[Task]:
             if len(uniq) > 1:
                 ici.prebroadcast(copy, sorted(uniq))
             elif len(uniq) == 1:
-                ici.preplace(copy, uniq.pop())
+                # single-consumer edge: defer so the whole DAG wavefront
+                # (stencil halos, ring neighbor hops, panel sends) rides
+                # ONE batched CollectivePermute instead of N puts
+                # (SURVEY §5.8); host-resident copies fall through to
+                # lazy stage-in as before
+                sp = uniq.pop()
+                if not ici.defer_place(copy, sp):
+                    ici.preplace(copy, sp)
         for succ_tc, succ_locals, dflow, odep in local_deliveries:
             dcopy = copy
             if copy is not None:

@@ -128,6 +128,9 @@ def worker_loop(es) -> None:
         task = sched.select(es)
         if task is None:
             misses += 1
+            # idle moment: drain any deferred wavefront placements whose
+            # batching window expired (comm/ici.py defer_place)
+            ctx.flush_ici()
             # exponential backoff on miss (reference: scheduling.c:596-635)
             ctx.doorbell_wait(min(0.0002 * (1 << min(misses, 8)), 0.05))
             continue

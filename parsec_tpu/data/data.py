@@ -31,6 +31,9 @@ ACCESS_RW = ACCESS_READ | ACCESS_WRITE
 
 # DataCopy.flags bits
 FLAG_COW = 0x1   # payload is shared with readers: duplicate before writing
+FLAG_SCRATCH = 0x2   # NEW-flow arena buffer: content undefined until the
+                     # first writer runs (device stage-in may materialize
+                     # it on device instead of shipping host bytes)
 
 
 class Coherency(IntEnum):

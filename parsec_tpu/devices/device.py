@@ -25,7 +25,7 @@ class DeviceStats:
     """Per-device counters (reference: device.h:132-137)."""
 
     __slots__ = ("executed_tasks", "bytes_in", "bytes_out", "faults",
-                 "evictions")
+                 "evictions", "fused_launches", "fused_tasks")
 
     def __init__(self):
         self.executed_tasks = 0
@@ -33,6 +33,10 @@ class DeviceStats:
         self.bytes_out = 0
         self.faults = 0
         self.evictions = 0
+        #: wavefront launch fusion counters: launches that carried >1 task,
+        #: and how many tasks rode them (devices/xla.py manager batching)
+        self.fused_launches = 0
+        self.fused_tasks = 0
 
     def as_dict(self) -> Dict[str, int]:
         return {k: getattr(self, k) for k in self.__slots__}

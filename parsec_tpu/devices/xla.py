@@ -35,7 +35,7 @@ import numpy as np
 
 from parsec_tpu.core.task import HookReturn, Task
 from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency,
-                                  DataCopy, FLAG_COW)
+                                  DataCopy, FLAG_COW, FLAG_SCRATCH)
 from parsec_tpu.devices.device import Device
 from parsec_tpu.core.task import ToDesc
 from parsec_tpu.utils.mca import params
@@ -56,6 +56,12 @@ params.register("device_max_faults", 0,
                 "disable a device after this many launch faults and fall "
                 "back to other incarnations (0 = fail the context, like "
                 "an unguarded run; reference: HOOK_RETURN_DISABLE)")
+params.register("device_fuse", 8,
+                "max same-class ready device tasks fused into ONE XLA "
+                "launch (wavefront launch fusion: the TRSM panel or "
+                "SYRK/GEMM trailing-update wave of a dense factorization "
+                "rides a single dispatch, amortizing per-launch latency; "
+                "1 disables)")
 
 
 class XlaKernel:
@@ -91,18 +97,35 @@ class XlaKernel:
         self._fast[donate] = jf
         return jf
 
-    def _jitted_slow(self, donate: bool):
+    def jitted_fused(self, donate: bool, n: int):
+        """One XLA program applying the kernel to ``n`` independent task
+        instances (wavefront launch fusion).  The traced body unrolls the
+        n applications; XLA schedules them back-to-back on device, so a
+        whole same-class wave costs one dispatch round trip instead of n.
+        Compiled once per (n, donate) per shape signature."""
+        key = (donate, n)
+        jf = self._fast.get(key)
+        if jf is not None:
+            return jf
+        jf = self._jitted_slow(donate, n)
+        self._fast[key] = jf
+        return jf
+
+    def _jitted_slow(self, donate: bool, n: int = 1):
         # The jit cache lives ON the kernel function object, so its
         # lifetime is the function's: module-level kernels (apps memoize
         # theirs, e.g. gemm._kernels) share traced executables across
         # taskpool rebuilds, while per-build lambdas die with their pools
         # instead of pinning entries in a global table forever.
-        static = tuple(i for i, n in enumerate(self.arg_names)
-                       if n not in self.flow_names)
-        dn = tuple(i for i, n in enumerate(self.arg_names)
-                   if n in self.flow_names and n in self.writable) \
+        k = len(self.arg_names)
+        static1 = tuple(i for i, a in enumerate(self.arg_names)
+                        if a not in self.flow_names)
+        dn1 = tuple(i for i, a in enumerate(self.arg_names)
+                    if a in self.flow_names and a in self.writable) \
             if donate else ()
-        key = (static, dn)
+        static = tuple(t * k + i for t in range(n) for i in static1)
+        dn = tuple(t * k + i for t in range(n) for i in dn1)
+        key = (static, dn, n)
         with XlaKernel._jit_lock:
             cache = getattr(self.fn, "__parsec_jit_cache__", None)
             if cache is None:
@@ -114,7 +137,15 @@ class XlaKernel:
             jf = cache.get(key)
             if jf is None:
                 import jax
-                jf = jax.jit(self.fn, static_argnums=static, donate_argnums=dn)
+                if n == 1:
+                    target = self.fn
+                else:
+                    fn = self.fn
+
+                    def target(*flat):
+                        return tuple(fn(*flat[t * k:(t + 1) * k])
+                                     for t in range(n))
+                jf = jax.jit(target, static_argnums=static, donate_argnums=dn)
                 cache[key] = jf
             return jf
 
@@ -240,38 +271,107 @@ class XlaDevice(Device):
                     self._cond.wait(0.1)
                 if self._stop and not self._pending:
                     return
-                task, spec, load = self._pending.popleft()
+                batch = self._pop_wave_locked()
                 self._launching += 1
             try:
-                self._launch(task, spec, load)
+                self._launch(batch)
             except Exception as exc:   # stage-in/compile failure
                 from parsec_tpu.core import scheduling
                 self.stats.faults += 1
-                self.load_sub(load)
-                if self._degrade(task, exc):
-                    continue
-                self.es.context.record_error(exc, task)
-                scheduling.complete_execution(self.es, task, failed=True)
+                for _task, _spec, qload in batch:
+                    self.load_sub(qload)
+                rescued = self._degrade([t for t, _s, _l in batch], exc)
+                if not rescued:
+                    for t, _s, _l in batch:
+                        self.es.context.record_error(exc, t)
+                        scheduling.complete_execution(self.es, t, failed=True)
             finally:
                 with self._cond:
                     self._launching -= 1
                     self._cond.notify_all()
 
-    def _degrade(self, task: Task, exc: Exception) -> bool:
+    def _pop_wave_locked(self):
+        """Pop the next task plus every queued same-class sibling it can
+        fuse with (same kernel spec, equal non-flow args, matching
+        payload shapes), up to ``device_fuse`` (wavefront launch fusion;
+        reference analog: the GPU manager draining its pending FIFO into
+        the exec streams, device_cuda_module.c:2697 — here the drain
+        fuses the wave into one XLA program).  Non-matching entries keep
+        their queue order.  Caller holds ``_cond``."""
+        first = self._pending.popleft()
+        limit = int(params.get("device_fuse", 8))
+        if limit <= 1 or not self._pending:
+            return [first]
+        task, spec, _load = first
+        sig = self._fuse_sig(task, spec)
+        if sig is None:
+            return [first]
+        batch = [first]
+        rest = []
+        # bound the scan at a small multiple of the fuse width: the lock
+        # is shared with submit()/sync(), so an unbounded walk over a
+        # deep mixed-class queue would serialize workers behind it
+        scan_budget = 4 * limit
+        while self._pending and len(batch) < limit and scan_budget > 0:
+            scan_budget -= 1
+            cand = self._pending.popleft()
+            if cand[1] is spec and self._fuse_sig(cand[0], spec) == sig:
+                batch.append(cand)
+            else:
+                rest.append(cand)
+        # quantize to the largest power of two <= wave size: each distinct
+        # fused width is a separate XLA compile, so arbitrary widths would
+        # keep tripping fresh compiles mid-run; powers of two cap the
+        # variety at log2(device_fuse) per kernel
+        quant = 1 << (len(batch).bit_length() - 1)
+        # requeue order: skipped non-matching entries first (restoring
+        # their queue positions), then the quantization extras IN FRONT so
+        # they lead the next wave and can fuse with arriving siblings
+        for item in reversed(rest):
+            self._pending.appendleft(item)
+        for item in reversed(batch[quant:]):
+            self._pending.appendleft(item)
+        batch = batch[:quant]
+        return batch
+
+    @staticmethod
+    def _fuse_sig(task: Task, spec: XlaKernel):
+        """Fusion compatibility signature: the values of non-flow kernel
+        args (static argnums — they specialize the compile) and the
+        shape/dtype of each flow payload.  None = not fusable (unbound
+        or unhashable)."""
+        sig = []
+        try:
+            for a in spec.arg_names:
+                if a in spec.flow_names:
+                    copy = task.data.get(a)
+                    p = copy.payload if copy is not None else None
+                    if p is None:
+                        return None
+                    sig.append((a, tuple(p.shape), str(p.dtype)))
+                else:
+                    v = task.locals.get(a, task.taskpool.globals.get(a))
+                    hash(v)
+                    sig.append((a, v))
+        except Exception:
+            return None
+        return tuple(sig)
+
+    def _degrade(self, tasks: List[Task], exc: Exception) -> bool:
         """Degraded mode (the reference's ONLY fault tolerance: device
         errors disable the device and push tasks back to the CPU
         incarnation, PARSEC_HOOK_RETURN_DISABLE /
         device_cuda_module.c:2757-2762).  After ``device_max_faults``
-        launch failures the device disables itself and the failing task
-        — plus everything still queued here — reschedules to fall
-        through to the next incarnation.  Returns True when the task was
-        rescued."""
+        launch failures the device disables itself and the failing tasks
+        — plus everything still queued here — reschedule to fall
+        through to the next incarnation.  Returns True when the tasks
+        were rescued."""
         limit = int(params.get("device_max_faults", 0))
         if limit <= 0 or self.es is None:
             return False      # unguarded: the fault fails the context
         from parsec_tpu.core import scheduling
         from parsec_tpu.utils.output import warning
-        rescued = [task]
+        rescued = list(tasks)
         with self._cond:
             if self.stats.faults >= limit and self.enabled:
                 # past the limit: stop taking work and drain the queue
@@ -290,42 +390,57 @@ class XlaDevice(Device):
         scheduling.schedule(self.es, rescued)
         return True
 
-    def _launch(self, task: Task, spec: XlaKernel, load: float) -> None:
-        tc = task.task_class
+    def _launch(self, batch) -> None:
+        """Stage and dispatch one wave: a list of (task, spec, load) with
+        a shared kernel spec (len 1 = the plain single-task launch).  The
+        whole wave rides ONE jitted call (XlaKernel.jitted_fused), so a
+        k-wide TRSM/SYRK/GEMM wavefront costs one dispatch round trip."""
+        spec: XlaKernel = batch[0][1]
+        n = len(batch)
         pinned: List[Any] = []
-        staged: Dict[str, Any] = {}
         release_after: List[DataCopy] = []
-        # pin every datum this task touches before any eviction decision
-        for flow in tc.flows:
-            copy = task.data.get(flow.name)
-            if copy is not None and copy.data is not None:
-                self._pin(copy.data)
-                pinned.append(copy.data)
+        flat: List[Any] = []
         try:
-            for flow in tc.flows:
-                copy = task.data.get(flow.name)
-                if copy is None:
-                    continue
-                dc = self._stage_in(copy, flow.access,
-                                    pinned=flow.name in task.pinned_flows)
-                if dc is not copy and copy.device == 0 \
-                        and copy.arena is not None:
-                    # host arena temp fully superseded by the device copy:
-                    # return it to the freelist once the kernel completes
-                    # (the H2D transfer may still be reading it)
-                    copy.data.detach_copy(0)
-                    release_after.append(copy)
-                task.data[flow.name] = dc
-                staged[flow.name] = dc.payload
-            args = []
-            for n in spec.arg_names:
-                if n in staged:
-                    args.append(staged[n])
-                elif n in task.locals:
-                    args.append(task.locals[n])
-                else:
-                    args.append(task.taskpool.globals.get(n))
-            outs = spec.bind_outputs(spec.jitted(self._donate)(*args))
+            for task, _spec, _load in batch:
+                tc = task.task_class
+                staged: Dict[str, Any] = {}
+                # pin every datum this task touches before any eviction
+                # decision
+                for flow in tc.flows:
+                    copy = task.data.get(flow.name)
+                    if copy is not None and copy.data is not None:
+                        self._pin(copy.data)
+                        pinned.append(copy.data)
+                for flow in tc.flows:
+                    copy = task.data.get(flow.name)
+                    if copy is None:
+                        continue
+                    dc = self._stage_in(copy, flow.access,
+                                        pinned=flow.name in task.pinned_flows)
+                    if dc is not copy and copy.device == 0 \
+                            and copy.arena is not None:
+                        # host arena temp fully superseded by the device
+                        # copy: return it to the freelist once the kernel
+                        # completes (the H2D transfer may still read it)
+                        copy.data.detach_copy(0)
+                        release_after.append(copy)
+                    task.data[flow.name] = dc
+                    staged[flow.name] = dc.payload
+                for a in spec.arg_names:
+                    if a in staged:
+                        flat.append(staged[a])
+                    elif a in task.locals:
+                        flat.append(task.locals[a])
+                    else:
+                        flat.append(task.taskpool.globals.get(a))
+            donate = self._donate and not self._donation_hazard(spec, flat)
+            if n == 1:
+                results = [spec.jitted(donate)(*flat)]
+            else:
+                results = list(spec.jitted_fused(donate, n)(*flat))
+                self.stats.fused_launches += 1
+                self.stats.fused_tasks += n
+            outs_per_task = [spec.bind_outputs(r) for r in results]
         except Exception:
             for d in pinned:
                 self._unpin(d)
@@ -334,14 +449,37 @@ class XlaDevice(Device):
             for copy in release_after:
                 copy.arena.release_copy(copy)
             raise
-        self.stats.executed_tasks += 1
+        self.stats.executed_tasks += n
         with self._cond:
             while len(self._inflight) >= self._depth and not self._stop:
                 self._cond.wait(0.1)
-            self._inflight.append(
-                _Inflight(self.es, task, spec, outs, pinned, load,
-                          release_after))
+            for i, (task, _spec, load) in enumerate(batch):
+                self._inflight.append(
+                    _Inflight(self.es, task, spec, outs_per_task[i],
+                              pinned if i == 0 else [], load,
+                              release_after if i == 0 else []))
             self._cond.notify_all()
+
+    @staticmethod
+    def _donation_hazard(spec: XlaKernel, flat: List[Any]) -> bool:
+        """True when a to-be-donated buffer also appears as another
+        argument of the same (possibly fused) call: two wave tasks
+        sharing an operand where one donates it would hand XLA the same
+        buffer as both alias-donated and live input.  Falling back to
+        no-donation for the launch is always safe."""
+        k = len(spec.arg_names)
+        donatable = [i for i, a in enumerate(spec.arg_names)
+                     if a in spec.flow_names and a in spec.writable]
+        if not donatable:
+            return False
+        donated_ids = set()
+        for t in range(len(flat) // k):
+            for i in donatable:
+                donated_ids.add(id(flat[t * k + i]))
+        seen = {}
+        for j, v in enumerate(flat):
+            seen[id(v)] = seen.get(id(v), 0) + 1
+        return any(seen.get(d, 0) > 1 for d in donated_ids)
 
     def _stage_in(self, copy: DataCopy, access: int,
                   pinned: bool = False) -> DataCopy:
@@ -356,6 +494,28 @@ class XlaDevice(Device):
         and re-stages from the datum's newest valid copy below.)"""
         import jax
         datum = copy.data
+        if copy.flags & FLAG_SCRATCH and copy.version == 0 \
+                and access & ACCESS_WRITE and copy.arena is not None:
+            # NEW-flow scratch straight from the arena: the np.empty host
+            # buffer's content is undefined, so materialize the copy
+            # directly in device memory (zeros) instead of paying an H2D
+            # transfer for garbage bytes — on tunneled TPUs that transfer
+            # is the difference between noise and seconds per task
+            import jax.numpy as jnp
+            nbytes = getattr(copy.payload, "nbytes", 0)
+            off = self._reserve(nbytes)
+            dc = datum.copy_on(self.space)
+            if dc is None:
+                dc = datum.create_copy(self.space)
+            shape = copy.payload.shape
+            dtype = copy.payload.dtype
+            dc.payload = jax.device_put(
+                jnp.zeros(shape, dtype=dtype), self.jdev)
+            dc.version = copy.version
+            datum.transfer_ownership(self.space, access)
+            self._account(datum, dc, nbytes, off)
+            self._touch(datum)
+            return dc
         if (copy.flags & FLAG_COW) == 0 and copy.is_pinned_snapshot(pinned):
             from parsec_tpu.data.data import Data
             payload = copy.payload

@@ -236,3 +236,60 @@ def test_device_fault_degrades_to_cpu():
     for m in range(NT):
         np.testing.assert_allclose(
             np.asarray(V.data_of(m).pull_to_host().payload), 2.0)
+
+
+def test_wavefront_fusion_batches_same_class_waves():
+    """Wavefront launch fusion: when the device queue holds a wave of
+    same-class ready tasks, the manager dispatches them as ONE jitted
+    program (reference analog: the GPU manager draining its pending FIFO
+    into exec streams, device_cuda_module.c:2697 — here the drain fuses
+    the wave, amortizing per-launch latency on tunneled TPUs)."""
+    import time as _time
+
+    from parsec_tpu.core.context import Context
+
+    MT = 16
+    mb = 8
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=mb, ln=MT * mb)
+    rng = np.random.default_rng(3)
+    ref = {}
+    for _m, n in A.local_tiles():
+        t = rng.standard_normal((mb, mb)).astype(np.float32)
+        A.data_of(0, n).copy_on(0).payload[:] = t
+        ref[n] = t * 2.0
+
+    def mul_kernel(T):
+        # trace-time stall (runs ONCE per compile, not per task): the
+        # first launch traces while the rest of the wave queues behind
+        # it, making the fusion window deterministic for the test
+        _time.sleep(0.05)
+        return T * 2.0
+
+    params.set("device_fuse", 8)
+    params.set("device_max", 1)   # one device => the whole wave queues there
+    try:
+        with Context(nb_cores=2) as ctx:
+            if not ctx.device_registry.accelerators:
+                pytest.skip("no accelerator attached")
+            p = PTG("wave", MT=MT)
+            tb = p.task("MUL", n=Range(0, MT - 1)) \
+                .affinity(lambda n, A=A: A(0, n)) \
+                .flow("T", "RW",
+                      IN(DATA(lambda n, A=A: A(0, n))),
+                      OUT(DATA(lambda n, A=A: A(0, n))))
+            tb.body(mul_kernel, device="tpu")
+            tb.body(lambda T: np.asarray(T) * 2.0)
+            ctx.add_taskpool(p.build())
+            ctx.wait(timeout=120)
+            dev = ctx.device_registry.devices[1]
+            assert dev.stats.executed_tasks == MT
+            # the wave behind the first (tracing) launch must have fused
+            assert dev.stats.fused_launches >= 1
+            assert dev.stats.fused_tasks >= 2
+    finally:
+        params.unset("device_fuse")
+        params.unset("device_max")
+    for n in range(MT):
+        np.testing.assert_allclose(
+            np.asarray(A.data_of(0, n).pull_to_host().payload), ref[n],
+            rtol=1e-6)

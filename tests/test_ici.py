@@ -163,9 +163,55 @@ def test_runtime_stencil_uses_preplace(ctx):
                   when=lambda k, ND=ndev: k == ND - 1)) \
         .body(lambda T: T + 1.0, device="tpu") \
         .body(lambda T: T + 1.0)
-    before = ctx.ici.stats.puts
+    before = ctx.ici.stats.puts + ctx.ici.stats.permute_edges
     ctx.add_taskpool(p.build())
     ctx.wait(timeout=120)
+    ctx.flush_ici()   # drain any edges still inside the batching window
     got = np.asarray(V.data_of(ndev - 1).pull_to_host().payload)
     np.testing.assert_allclose(got, float(ndev))
-    assert ctx.ici.stats.puts > before, "no proactive d2d placement fired"
+    # serialized chain edges may flush singly (puts) or batched into
+    # ppermute rounds depending on window timing: either is proactive
+    after = ctx.ici.stats.puts + ctx.ici.stats.permute_edges
+    assert after > before, "no proactive d2d placement fired"
+
+
+def test_wavefront_edges_ride_batched_permute(ctx):
+    """k same-wavefront single-consumer cross-device edges ride ONE
+    CollectivePermute launch (SURVEY §5.8 "batched per DAG wavefront"):
+    P producers complete together, each feeding one consumer on the next
+    device; defer_place batches the full round and flushes it as a
+    single ppermute instead of P separate puts."""
+    from parsec_tpu.apps.wave import (expected_wave_result,
+                                      fill_wave_inputs,
+                                      permute_wave_taskpool)
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.utils.mca import params
+
+    ndev = ctx.ici.ndev
+    if ndev < 4:
+        pytest.skip("needs >=4 devices")
+    V = VectorTwoDimCyclic(mb=8, lm=8 * ndev)
+    W = VectorTwoDimCyclic(mb=8, lm=8 * ndev)
+    fill_wave_inputs(V, W)
+    V.distribute_devices(ctx)
+    W.distribute_devices(ctx)
+    # a huge batching window: only the full-round trigger may flush, so
+    # the assertion on launch count is deterministic
+    params.set("comm_ici_permute_window_ms", 1000.0)
+    try:
+        before_p = ctx.ici.stats.permutes
+        before_e = ctx.ici.stats.permute_edges
+        before_put = ctx.ici.stats.puts
+        ctx.add_taskpool(permute_wave_taskpool(V, W))
+        ctx.wait(timeout=120)
+    finally:
+        params.unset("comm_ici_permute_window_ms")
+    # every edge was cross-device single-consumer: the wave's first edge
+    # opens the window with one immediate put, the remaining k-1 ride
+    # ppermute rounds — k edges on <=2 launches
+    assert ctx.ici.stats.permute_edges - before_e >= ndev - 2
+    assert (ctx.ici.stats.permutes - before_p) \
+        + (ctx.ici.stats.puts - before_put) <= 2
+    for q in range(ndev):
+        got = np.asarray(W.data_of(q).pull_to_host().payload)
+        np.testing.assert_allclose(got, expected_wave_result(ndev, q))
