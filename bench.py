@@ -35,7 +35,8 @@ _PEAKS = {
 }
 
 
-def prestage(M, ctx, spd_diag: bool = False, keep=None) -> None:
+def prestage(M, ctx, spd_diag: bool = False, keep=None,
+             bump_all: float = 0.0) -> None:
     """Materialize every local tile directly in device HBM with a
     device-side generator (iota pattern, distinct buffer per tile) and
     attach the copies as coherent duplicates of the host tiles.
@@ -57,17 +58,21 @@ def prestage(M, ctx, spd_diag: bool = False, keep=None) -> None:
     def gen(seed, diag):
         shape = (M.mb, M.nb)
         x = jax.lax.broadcasted_iota(jnp.float32, shape, 1)
+        # row-constant iota tiles are rank 1 — fine for GEMM throughput,
+        # fatal for factorizations (a Cholesky-QR Gram matrix goes
+        # singular); ``bump_all`` adds a scaled identity to EVERY tile so
+        # per-tile rank is full, ``spd_diag`` makes diagonal tiles
+        # dominant so Cholesky stays well-posed
         out = (x * 1e-5 + seed * 1e-3) % 1.0
-        # SPD-friendly diagonal tiles: strongly diagonally dominant so
-        # Cholesky stays well-posed on generated data
         out = out + diag * jnp.eye(M.mb, M.nb, dtype=jnp.float32)
-        return out.astype(M.dtype) if M.dtype != np.float32 else out
+        return out.astype(M.dtype) if np.dtype(M.dtype) != np.float32 \
+            else out
 
     for i, (m, n) in enumerate(M.local_tiles()):
         if keep is not None and not keep(m, n):
             continue
         datum = M.data_of(m, n)
-        diag = float(M.lm) if (spd_diag and m == n) else 0.0
+        diag = float(M.lm) if (spd_diag and m == n) else bump_all
         arr = jax.device_put(gen(float(i), diag), dev.jdev)
         # the generated device value becomes the newest authoritative
         # copy (the write transition lives in Data, not here)
@@ -244,16 +249,30 @@ def run_gemm_bench(mb: int, mt: int, nt: int, kt: int, reps: int = 3,
 
 
 def run_potrf_bench(mb: int, nt: int, reps: int = 3,
-                    peak_gflops: float = 0.0):
+                    peak_gflops: float = 0.0, mp: bool = False):
     """North-star metric: tiled Cholesky (BASELINE.json names DPLASMA
     dpotrf as the headline; contract like dtd_test_simple_gemm — wall
-    time over insert+wait, n^3/3 useful flops)."""
+    time over insert+wait, n^3/3 useful flops).
+
+    ``mp``: bf16-STORAGE mixed precision (HPL-AI-style) — every tile is
+    stored bf16; products accumulate in f32 and the Cholesky itself runs
+    in f32 (upcast around the factor kernel), but results round to bf16
+    between steps.  Halves HBM footprint/traffic so larger tile grids
+    fit on chip, at ~3-digit tile storage precision.  The kernels are
+    dtype-following (apps/potrf.py), so this is purely a
+    storage-precision choice."""
     from parsec_tpu.apps.potrf import potrf_flops, potrf_taskpool
     from parsec_tpu.core.context import Context
     from parsec_tpu.data.matrix import TwoDimBlockCyclic
 
     n = nt * mb
-    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="A")
+    # mp: bf16 TILE STORAGE throughout (the collection dtype — a mixed
+    # f32 diagonal would make every panel writeback a dtype-converting
+    # D2H pull instead of staying device-resident); the factorization
+    # itself upcasts to f32 around the Cholesky and accumulates products
+    # in f32 (apps/potrf.py dtype-following kernels)
+    dtype = __import__("ml_dtypes").bfloat16 if mp else np.float32
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="A", dtype=dtype)
     flops = potrf_flops(n)
     best = 0.0
     with Context(nb_cores=4) as ctx:
@@ -308,11 +327,223 @@ def run_potrf_bench(mb: int, nt: int, reps: int = 3,
     return best
 
 
+# ---------------------------------------------------------------------------
+# §6 metric-table modes (SURVEY.md §6; reference harnesses:
+# tests/apps/pingpong/rtt.jdf, bandwidth.jdf, tests/apps/stencil/,
+# tests/profiling-standalone/sp-perf.c).  The reference publishes no
+# numbers (BASELINE.md), so vs_baseline for these secondary probes is
+# measured against the self-declared targets in BENCH.md.
+# ---------------------------------------------------------------------------
+
+def _pp_worker(ctx, rank, nranks, nbytes, hops):
+    from parsec_tpu.apps.pingpong import run_pingpong
+    run_pingpong(ctx, nbytes, 8)          # warm the link + code paths
+    return run_pingpong(ctx, nbytes, hops)
+
+
+def run_rtt_bench(hops: int = 400):
+    """2-rank task round-trip latency over loopback (rtt.jdf analog):
+    seconds per dataflow hop, reported in microseconds."""
+    from parsec_tpu.comm.launch import run_distributed
+    res = run_distributed(_pp_worker, 2, args=(8, hops), timeout=300)
+    return float(np.mean([r[0] for r in res])) * 1e6
+
+
+def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
+    """2-rank dataflow edge bandwidth (bandwidth.jdf analog), MB/s."""
+    from parsec_tpu.comm.launch import run_distributed
+    res = run_distributed(_pp_worker, 2, args=(nbytes, hops), timeout=300)
+    return float(np.mean([r[1] for r in res]))
+
+
+def _empty_pool(n):
+    from parsec_tpu.dsl.ptg.api import PTG, Range
+    p = PTG("empty", N=n)
+    p.task("E", i=Range(0, n - 1)).flow("x", "CTL").body(lambda: None)
+    return p.build()
+
+
+def run_tasks_bench(n: int = 20000):
+    """Empty-body task throughput, tasks/s — the DAG-scheduling
+    efficiency proxy (insert+wait over n no-op tasks; every runtime
+    layer except the body is on the clock)."""
+    from parsec_tpu.core.context import Context
+    with Context(nb_cores=int(os.environ.get("PARSEC_BENCH_CORES", 4))) \
+            as ctx:
+        ctx.add_taskpool(_empty_pool(n // 10))   # warm
+        ctx.wait()
+        t0 = time.perf_counter()
+        ctx.add_taskpool(_empty_pool(n))
+        ctx.wait()
+        dt = time.perf_counter() - t0
+    return n / dt
+
+
+def run_stencil_bench(mb: int = 1 << 20, nt: int = 8, steps: int = 16):
+    """Sustained 1D 3-point stencil throughput through the runtime,
+    points/s (testing_stencil_1D analog)."""
+    from parsec_tpu.apps.stencil import stencil_taskpool
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    V = VectorTwoDimCyclic(mb=mb, lm=mb * nt)
+    rng = np.random.default_rng(5)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = \
+            rng.standard_normal(mb).astype(np.float32)
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(stencil_taskpool(V, steps))
+        ctx.wait()                         # warm: stage-in + compiles
+        _fence(V)
+        rtt0 = _fence_rtt(V)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ctx.add_taskpool(stencil_taskpool(V, steps))
+            ctx.wait()
+            dt = time.perf_counter() - t0
+            _fence(V)
+            dt, _ = _honest_dt(dt, time.perf_counter() - t0 - dt, rtt0)
+            if dt > 0:
+                best = max(best, mb * nt * steps / dt)
+    return best
+
+
+def run_tracer_bench(n: int = 10000):
+    """Binary-tracer overhead per traced task, microseconds
+    (sp-perf.c analog): empty-task wall time with the task_profiler
+    PINS module installed minus without, over n tasks."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.prof.pins import install_task_profiler
+    from parsec_tpu.prof.profiling import Profile
+
+    def timed(with_tracer):
+        with Context(nb_cores=4) as ctx:
+            mod = None
+            if with_tracer:
+                mod = install_task_profiler(ctx, Profile())
+            ctx.add_taskpool(_empty_pool(n // 10))
+            ctx.wait()
+            t0 = time.perf_counter()
+            ctx.add_taskpool(_empty_pool(n))
+            ctx.wait()
+            dt = time.perf_counter() - t0
+            if mod is not None:
+                mod.uninstall(ctx)
+        return dt
+
+    base = min(timed(False) for _ in range(2))
+    traced = min(timed(True) for _ in range(2))
+    return max(0.0, (traced - base) / n * 1e6)
+
+
+#: secondary §6 probes: mode -> (runner, metric name, unit, self-declared
+#: target, "higher is better").  Targets documented in BENCH.md.
+_AUX_MODES = {
+    "rtt": (run_rtt_bench, "task_rtt", "us/hop", 1000.0, False),
+    "bw": (run_bw_bench, "dataflow_bandwidth", "MB/s", 1000.0, True),
+    "tasks": (run_tasks_bench, "task_throughput", "tasks/s", 10000.0, True),
+    "stencil": (run_stencil_bench, "stencil_throughput", "points/s",
+                1e8, True),
+    "tracer": (run_tracer_bench, "tracer_overhead", "us/task", 1.0, False),
+}
+
+
+def run_geqrf_bench(mb: int, nt: int, reps: int = 3,
+                    peak_gflops: float = 0.0):
+    """Tiled QR (BASELINE.md names dgeqrf-class drivers alongside
+    dpotrf; useful flops 2mn^2 - 2n^3/3, insert+wait contract)."""
+    from parsec_tpu.apps.qr import geqrf_flops, qr_taskpool
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    n = nt * mb
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, name="A")
+    flops = geqrf_flops(n, n)
+    best = 0.0
+    with Context(nb_cores=4) as ctx:
+        on_acc = bool(ctx.device_registry.accelerators)
+
+        def reset():
+            if on_acc:
+                # full-rank tiles: the Cholesky-QR TSQRT needs a
+                # nonsingular Gram matrix per stacked panel
+                prestage(A, ctx, bump_all=1.0)
+            else:
+                rng = np.random.default_rng(7)
+                for m, nn in A.local_tiles():
+                    arr = np.asarray(
+                        A.data_of(m, nn).pull_to_host().payload)
+                    arr[:] = rng.standard_normal((mb, mb)
+                                                 ).astype(np.float32)
+
+        reset()
+        t0 = time.perf_counter()
+        ctx.add_taskpool(qr_taskpool(A, device="tpu"))
+        ctx.wait()
+        _fence(A)
+        log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
+        rtt0 = _fence_rtt(A)
+        log(f"idle fence RTT: {rtt0 * 1e3:.0f} ms")
+        floor = flops / (peak_gflops * 1e9) if peak_gflops else 0.0
+        for r in range(reps):
+            reset()
+            _perturb(A, r)
+            t0 = time.perf_counter()
+            ctx.add_taskpool(qr_taskpool(A, device="tpu"))
+            ctx.wait()
+            dt = time.perf_counter() - t0
+            fs = _fence(A)
+            fence_dt = time.perf_counter() - t0 - dt
+            dt, in_noise = _honest_dt(dt, fence_dt, rtt0, floor)
+            if dt < 0:
+                log(f"rep {r}: DISCARDED (physically implausible even "
+                    f"fence-inclusive — dedup suspected)")
+                continue
+            gf = flops / dt / 1e9
+            best = max(best, gf)
+            log(f"rep {r}: {dt * 1e3:.1f} ms -> {gf:.1f} GFLOP/s "
+                f"(post-fence +{fence_dt * 1e3:.0f} ms"
+                f"{'' if in_noise else ' COUNTED'}, csum={fs:.3e})")
+        for d in ctx.device_registry.accelerators:
+            if d.stats.executed_tasks:
+                log(f"{d.name}: {d.stats.as_dict()}")
+    return best
+
+
 def main():
     import jax
     platform = jax.devices()[0].platform
     log(f"platform: {platform}, devices: {len(jax.devices())}")
     on_tpu = platform in ("tpu", "axon")
+    app = os.environ.get("PARSEC_BENCH_APP", "gemm")
+    if app in _AUX_MODES:
+        fn, metric, unit, target, higher = _AUX_MODES[app]
+        value = fn()
+        vs = (value / target) if higher else (target / value if value else 0)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(value, 3),
+            "unit": unit,
+            "vs_baseline": round(vs, 4),
+        }))
+        return
+    if app == "geqrf":
+        mb = int(os.environ.get("PARSEC_BENCH_MB", 6144 if on_tpu else 16))
+        nt = int(os.environ.get("PARSEC_BENCH_NT", 8 if on_tpu else 3))
+        from parsec_tpu.utils.mca import params as _params
+        _params.set("device_fuse",
+                    int(os.environ.get("PARSEC_BENCH_FUSE", 16)))
+        peak = _PEAKS.get(platform, 100.0)
+        value = run_geqrf_bench(
+            mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 3)),
+            peak_gflops=peak)
+        print(json.dumps({
+            "metric": "tiled_geqrf_gflops",
+            "value": round(value, 1),
+            "unit": "GFLOP/s",
+            "vs_baseline": round(value / (0.55 * peak), 4),
+        }))
+        return
     if os.environ.get("PARSEC_BENCH_APP", "gemm") == "potrf":
         # r3: TRSM runs as matmul against the POTRF-emitted triangular
         # inverse (apps/potrf.py tri_inv — jsl trsm measured ~18 TF/s vs
@@ -320,14 +551,37 @@ def main():
         # launches (devices/xla.py device_fuse), so larger tile grids now
         # pay off: the r2 sweep (4096/8 -> 33.7, 6144/8 -> 40.0 TFLOP/s)
         # was launch-latency-bound on the serialized panel chain
+        # bf16-panel mixed precision by default on TPU: fits nt=16 at
+        # mb=6144 in HBM, where the executed/useful flop ratio (the
+        # TRSM-by-inverse + full-SYRK tax) drops to ~1.2 and compute
+        # dominates the tunnel's per-launch latency
+        mp = on_tpu and os.environ.get("PARSEC_BENCH_POTRF_MP", "1") == "1"
         mb = int(os.environ.get("PARSEC_BENCH_MB", 6144 if on_tpu else 32))
-        nt = int(os.environ.get("PARSEC_BENCH_NT", 10 if on_tpu else 4))
+        # nt=16 mp: 10.3GB resident bf16 tiles + ~2.5GB fused-launch
+        # transients on a 16GB v5e
+        nt = int(os.environ.get("PARSEC_BENCH_NT",
+                                (16 if mp else 12) if on_tpu else 4))
+        from parsec_tpu.utils.mca import params as _params
+        _params.set("device_fuse",
+                    int(os.environ.get("PARSEC_BENCH_FUSE", 8)))
+        # a tight run-ahead window: eager completion would otherwise keep
+        # every unfinalized output (each panel inverse, every fused-wave
+        # operand set) referenced until the end of the pool — at nt=14
+        # that overflows the 16GB HBM; finalizing promptly lets donation
+        # and GC recycle chain buffers
+        _params.set("device_runahead",
+                    int(os.environ.get("PARSEC_BENCH_RUNAHEAD", 48)))
+        # one width-8 fused launch fills the default inflight depth of 8
+        # (entries are TASKS, not launches): deepen so dispatch pipelines
+        _params.set("device_inflight_depth",
+                    int(os.environ.get("PARSEC_BENCH_DEPTH", 32)))
+        log(f"potrf config: mb={mb} nt={nt} mixed-precision={mp}")
         peak = _PEAKS.get(platform, 100.0)
         # 4 reps: the first timed rep still hits a few fresh fused-width
         # compiles; best-of converges by rep 2-3
         value = run_potrf_bench(
             mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 4)),
-            peak_gflops=peak)
+            peak_gflops=peak, mp=mp)
         print(json.dumps({
             "metric": "tiled_potrf_gflops",
             "value": round(value, 1),

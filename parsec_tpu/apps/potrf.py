@@ -90,8 +90,10 @@ def _k_potrf(precision):
     if fn is None:
         def fn(T, W):
             import jax.numpy as jnp
-            L = jnp.linalg.cholesky(T)
-            return {"T": L, "W": tri_inv(L, precision)}
+            # factor in f32 even under bf16 tile storage (the mp mode):
+            # the inverse W always stays f32 — it multiplies every panel
+            L = jnp.linalg.cholesky(T.astype(jnp.float32))
+            return {"T": L.astype(T.dtype), "W": tri_inv(L, precision)}
         _kernels[("potrf", precision)] = fn
     return fn
 
@@ -103,18 +105,27 @@ def _k_potrf_last(precision):
     if fn is None:
         def fn(T):
             import jax.numpy as jnp
-            return jnp.linalg.cholesky(T)
+            return jnp.linalg.cholesky(T.astype(jnp.float32)).astype(T.dtype)
         _kernels[("potrf_last", precision)] = fn
     return fn
 
 
 def _k_trsm(precision):
+    # Kernels are dtype-FOLLOWING: products always accumulate in f32
+    # (preferred_element_type), the Cholesky itself runs in f32 (upcast
+    # in _k_potrf), and results land back in the tile's STORAGE dtype —
+    # so the same code path serves full-f32 tiles and the bf16-storage
+    # mixed-precision mode (HPL-AI-style: all tiles stored bf16, halving
+    # HBM footprint+traffic, results rounded to bf16 between steps; the
+    # panel inverse W alone stays f32; bench.py PARSEC_BENCH_POTRF_MP).
     fn = _kernels.get(("trsm", precision))
     if fn is None:
         def fn(W, C):
             import jax.numpy as jnp
             # C <- C @ L^-T  ==  C @ W^T  (W = L^-1 from POTRF)
-            return jnp.matmul(C, W.T, precision=precision)
+            acc = jnp.matmul(C, W.T, precision=precision,
+                             preferred_element_type=jnp.float32)
+            return acc.astype(C.dtype)
         _kernels[("trsm", precision)] = fn
     return fn
 
@@ -124,7 +135,9 @@ def _k_syrk(precision):
     if fn is None:
         def fn(T, R):
             import jax.numpy as jnp
-            return T - jnp.matmul(R, R.T, precision=precision)
+            acc = jnp.matmul(R, R.T, precision=precision,
+                             preferred_element_type=jnp.float32)
+            return (T.astype(jnp.float32) - acc).astype(T.dtype)
         _kernels[("syrk", precision)] = fn
     return fn
 
@@ -134,7 +147,9 @@ def _k_gemm(precision):
     if fn is None:
         def fn(C, L, R):
             import jax.numpy as jnp
-            return C - jnp.matmul(L, R.T, precision=precision)
+            acc = jnp.matmul(L, R.T, precision=precision,
+                             preferred_element_type=jnp.float32)
+            return (C.astype(jnp.float32) - acc).astype(C.dtype)
         _kernels[("gemm", precision)] = fn
     return fn
 
@@ -157,7 +172,8 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
         return tb
 
     p = PTG("potrf", NT=NT)
-    p.arena("w", (mb, mb), dtype=A.dtype)
+    # the panel inverse is always f32, even when tiles store bf16 (mp)
+    p.arena("w", (mb, mb), dtype=np.float32)
 
     tb = p.task("POTRF", k=Range(0, NT - 2)) \
         .affinity(lambda k, A=A: A(k, k)) \
@@ -175,10 +191,10 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
 
     def cpu_potrf(T, W):
         import scipy.linalg as sl
-        L = np.linalg.cholesky(np.asarray(T))
+        L = np.linalg.cholesky(np.asarray(T, dtype=np.float32))
         Winv = sl.solve_triangular(L, np.eye(L.shape[0], dtype=L.dtype),
                                    lower=True)
-        return {"T": L, "W": Winv}
+        return {"T": L.astype(np.asarray(T).dtype), "W": Winv}
     add_bodies(tb, _k_potrf(precision), cpu_potrf)
 
     # the final diagonal tile: no panel below it, so no inverse is needed
@@ -192,7 +208,9 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
                  when=lambda NT=NT: NT > 1),
               OUT(DATA(lambda A=A, NT=NT: A(NT - 1, NT - 1))))
     add_bodies(tb, _k_potrf_last(precision),
-               lambda T: np.linalg.cholesky(np.asarray(T)))
+               lambda T: np.linalg.cholesky(
+                   np.asarray(T, dtype=np.float32)
+               ).astype(np.asarray(T).dtype))
 
     tb = p.task("TRSM", k=Range(0, NT - 2),
                 m=Range(lambda k: k + 1, NT - 1)) \
@@ -215,7 +233,9 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
               OUT(DATA(lambda m, k, A=A: A(m, k))))
 
     def cpu_trsm(W, C):
-        return np.asarray(C) @ np.asarray(W).T
+        out = np.asarray(C, dtype=np.float32) @ \
+            np.asarray(W, dtype=np.float32).T
+        return out.astype(np.asarray(C).dtype)
     add_bodies(tb, _k_trsm(precision), cpu_trsm)
 
     tb = p.task("SYRK", m=Range(1, NT - 1), k=Range(0, lambda m: m - 1)) \
@@ -233,9 +253,11 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
                   when=lambda m, k: k < m - 1)) \
         .flow("R", "READ", IN(TASK("TRSM", "C", lambda m, k: dict(m=m,
                                                                   k=k))))
-    add_bodies(tb, _k_syrk(precision),
-               lambda T, R: np.asarray(T) -
-               np.asarray(R) @ np.asarray(R).T)
+    def cpu_syrk(T, R):
+        r = np.asarray(R, dtype=np.float32)
+        return (np.asarray(T, dtype=np.float32) -
+                r @ r.T).astype(np.asarray(T).dtype)
+    add_bodies(tb, _k_syrk(precision), cpu_syrk)
 
     tb = p.task("GEMM", n=Range(1, NT - 2),
                 m=Range(lambda n: n + 1, NT - 1),
@@ -254,9 +276,12 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
                                                                   k=k)))) \
         .flow("R", "READ", IN(TASK("TRSM", "C", lambda n, k: dict(m=n,
                                                                   k=k))))
-    add_bodies(tb, _k_gemm(precision),
-               lambda C, L, R: np.asarray(C) -
-               np.asarray(L) @ np.asarray(R).T)
+    def cpu_gemm(C, L, R):
+        acc = np.asarray(L, dtype=np.float32) @ \
+            np.asarray(R, dtype=np.float32).T
+        return (np.asarray(C, dtype=np.float32) -
+                acc).astype(np.asarray(C).dtype)
+    add_bodies(tb, _k_gemm(precision), cpu_gemm)
 
     tp = p.build()
     for name, tc in tp.task_classes.items():

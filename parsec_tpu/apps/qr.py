@@ -9,16 +9,34 @@ headline config).  Classic flat-tree tile algorithm:
                   edge.
     UNMQR(k,n)  : A[k,n] = Q1^T @ A[k,n]                     (n > k)
     TSQRT(m,k)  : QR of [R; A[m,k]] stacked — updates R in A[k,k] and
-                  zeroes A[m,k]; the stacked factor Q2 (2mb x mb)
+                  zeroes A[m,k]; the compact-WY pair (V, T^T)
                   travels on an edge.                         (m > k)
-    TSMQR(m,n,k): applies Q2^T to the stacked [A[k,n]; A[m,n]] pair.
+    TSMQR(m,n,k): applies the WY transform to [A(k,n); A(m,n)].
                   (m > k, n > k)
 
-Unlike the storage-compact Householder form, the Q factors ride dataflow
-edges as explicit matrices (NEW-arena temporaries) — the natural choice
-when every kernel is an XLA op (jnp.linalg.qr + matmuls) and edges are
-cheap HBM-resident tiles.  R ends in the upper triangle; tiles below are
-zeroed.
+TPU-first design of the tall-skinny kernels: XLA's QR expander (and
+especially ``mode="complete"`` — an extra (2mb)^3 of Q formation) runs
+far below matmul peak on TPU, so TSQRT computes the stacked QR by
+CHOLESKY-QR on the mb x mb Gram matrix and derives an EXACT compact-WY
+representation in closed form:
+
+    G  = R^T R + B^T B;   R' = +-chol(G)^T   (Householder sign choice:
+                                sign(R'_jj) = -sign(R_jj), no
+                                cancellation in S)
+    S  = R - R';   V = B S^-1;   T^T = I - R'^-T R^T
+
+so the 2mb x 2mb orthogonal transform is Phi^T = I - [I;V] T^T [I;V]^T
+(annihilation AND orthogonality hold identically — the general inverse
+in the textbook T^T = S (R + V^T B)^-1 collapses to triangular ones via
+M = -S^-T R'^T S).  TSQRT is then one mb-sized Cholesky + two
+triangular inverses (recursive Newton, apps/potrf.tri_inv) + matmuls,
+and TSMQR is five mb^3-class matmuls:
+
+    Z = T^T (C1 + V^T C2);   C1 -= Z;   C2 -= V Z
+
+Everything lowers to the systolic array; the Q edges shrink from
+(2mb)^2 dense factors to the (2mb x mb) [V; T^T] pair.  R ends in the
+upper triangle; tiles below are zeroed.
 """
 
 from __future__ import annotations
@@ -27,6 +45,7 @@ from typing import Optional
 
 import numpy as np
 
+from parsec_tpu.apps.potrf import tri_inv
 from parsec_tpu.core.taskpool import ParameterizedTaskpool
 from parsec_tpu.data.matrix import TiledMatrix
 from parsec_tpu.dsl.ptg.api import DATA, IN, NEW, OUT, PTG, Range, TASK
@@ -45,7 +64,7 @@ def _k(name, maker):
 def _mk_geqrt():
     def fn(T, Q):
         import jax.numpy as jnp
-        q, r = jnp.linalg.qr(T, mode="complete")
+        q, r = jnp.linalg.qr(T, mode="reduced")   # square tile: full Q
         return {"T": r, "Q": q}
     return fn
 
@@ -57,13 +76,32 @@ def _mk_unmqr():
     return fn
 
 
+def _tsqrt_wy(R, B, xp, chol, ti):
+    """Shared TSQRT math (jax and numpy incarnations): returns
+    (R', V, T^T) of the compact-WY Cholesky-QR above."""
+    mb = R.shape[0]
+    G = R.T @ R + B.T @ B
+    L = chol(G)
+    # Householder sign choice: R'_jj = -sign(R_jj) * |R'_jj| makes
+    # S = R - R' diagonally safe (|S_jj| >= |R'_jj|)
+    d = xp.where(xp.diagonal(R) >= 0, -1.0, 1.0).astype(R.dtype)
+    Rp = d[:, None] * L.T
+    S = R - Rp
+    Sinv = ti(S.T).T                  # S upper-tri -> invert transpose
+    V = B @ Sinv
+    Linv = ti(L)
+    # R'^-T = (R'^T)^-1 = (L d)^-1 ... with the sign fold:
+    # R' = D L^T  =>  R'^T = L D  =>  R'^-T = D^-1 L^-1 = D L^-1
+    Tt = xp.eye(mb, dtype=R.dtype) - (d[:, None] * Linv) @ R.T
+    return Rp, V, Tt
+
+
 def _mk_tsqrt():
     def fn(T, B, Q):
         import jax.numpy as jnp
-        mb = T.shape[0]
-        stacked = jnp.concatenate([T, B], axis=0)        # (2mb, mb)
-        q, r = jnp.linalg.qr(stacked, mode="complete")   # q: (2mb, 2mb)
-        return {"T": r[:mb, :], "B": jnp.zeros_like(B), "Q": q}
+        Rp, V, Tt = _tsqrt_wy(T, B, jnp, jnp.linalg.cholesky, tri_inv)
+        return {"T": Rp, "B": jnp.zeros_like(B),
+                "Q": jnp.concatenate([V, Tt], axis=0)}
     return fn
 
 
@@ -71,10 +109,16 @@ def _mk_tsmqr():
     def fn(Q, C1, C2):
         import jax.numpy as jnp
         mb = C1.shape[0]
-        stacked = jnp.concatenate([C1, C2], axis=0)
-        out = jnp.matmul(Q.T, stacked)
-        return {"C1": out[:mb, :], "C2": out[mb:, :]}
+        V, Tt = Q[:mb, :], Q[mb:, :]
+        Z = Tt @ (C1 + V.T @ C2)
+        return {"C1": C1 - Z, "C2": C2 - V @ Z}
     return fn
+
+
+def _np_tri_inv(L):
+    import scipy.linalg as sl
+    return sl.solve_triangular(L, np.eye(L.shape[0], dtype=L.dtype),
+                               lower=True)
 
 
 def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
@@ -87,6 +131,16 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
     NT = A.mt
     mb = A.mb
     use_device = device in ("tpu", "xla", "gpu")
+    # Owner-computes discipline for the final R tiles: the LAST TSQRT of
+    # column k (and the last TSMQR of each row-k tile) runs where
+    # A(NT-1, k) lives, but its R output belongs home at A(k, *).  On
+    # one rank the write-back is local; across ranks it is routed
+    # through a store task pinned to the home tile, so the payload rides
+    # a normal dataflow edge (remote-dep protocol) instead of a
+    # cross-rank direct write (reference counterpart: remote output
+    # deps land via the ACTIVATE/GET protocol, remote_dep_mpi.c, never
+    # by writing another rank's memory).
+    routed = A.nodes > 1
 
     def bodies(tb, kernel, cpu_fn):
         if use_device:
@@ -95,8 +149,8 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
         return tb
 
     p = PTG("geqrf", NT=NT)
-    p.arena("q1", (mb, mb))
-    p.arena("q2", (2 * mb, 2 * mb))
+    p.arena("q1", (mb, mb), dtype=A.dtype)
+    p.arena("q2", (2 * mb, mb), dtype=A.dtype)   # stacked [V; T^T]
 
     # GEQRT(k): diagonal QR
     tb = p.task("GEQRT", k=Range(0, NT - 1)) \
@@ -150,8 +204,10 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
                  when=lambda m, k: m > k + 1),
               OUT(TASK("TSQRT", "T", lambda m, k: dict(m=m + 1, k=k)),
                   when=lambda m, NT=NT: m < NT - 1),
-              OUT(DATA(lambda k, A=A: A(k, k)),
-                  when=lambda m, NT=NT: m == NT - 1)) \
+              (OUT(TASK("RSTORE", "X", lambda k: dict(k=k)),
+                   when=lambda m, NT=NT: m == NT - 1) if routed else
+               OUT(DATA(lambda k, A=A: A(k, k)),
+                   when=lambda m, NT=NT: m == NT - 1))) \
         .flow("B", "RW",
               IN(DATA(lambda m, k, A=A: A(m, k)), when=lambda k: k == 0),
               IN(TASK("TSMQR", "C2", lambda m, k: dict(m=m, n=k, k=k - 1)),
@@ -165,11 +221,15 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
                   when=lambda k, NT=NT: k < NT - 1))
 
     def cpu_tsqrt(T, B, Q):
-        mb_ = np.asarray(T).shape[0]
-        stacked = np.concatenate([np.asarray(T), np.asarray(B)], axis=0)
-        q, r = np.linalg.qr(stacked, mode="complete")
-        return {"T": r[:mb_, :], "B": np.zeros_like(np.asarray(B)),
-                "Q": q}
+        # same compact-WY math as the device kernel, in float64 for
+        # stability (Cholesky-QR squares the condition number)
+        R64 = np.asarray(T, dtype=np.float64)
+        B64 = np.asarray(B, dtype=np.float64)
+        Rp, V, Tt = _tsqrt_wy(R64, B64, np, np.linalg.cholesky,
+                              _np_tri_inv)
+        dt = np.asarray(T).dtype
+        return {"T": Rp.astype(dt), "B": np.zeros_like(np.asarray(B)),
+                "Q": np.concatenate([V, Tt], axis=0).astype(dt)}
     bodies(tb, _k("tsqrt", _mk_tsqrt), cpu_tsqrt)
 
     # TSMQR(m, n, k): apply Q2^T to the [A(k,n); A(m,n)] pair
@@ -189,8 +249,10 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
               OUT(TASK("TSMQR", "C1", lambda m, n, k: dict(m=m + 1, n=n,
                                                            k=k)),
                   when=lambda m, NT=NT: m < NT - 1),
-              OUT(DATA(lambda k, n, A=A: A(k, n)),
-                  when=lambda m, NT=NT: m == NT - 1)) \
+              (OUT(TASK("CSTORE", "X", lambda k, n: dict(k=k, n=n)),
+                   when=lambda m, NT=NT: m == NT - 1) if routed else
+               OUT(DATA(lambda k, n, A=A: A(k, n)),
+                   when=lambda m, NT=NT: m == NT - 1))) \
         .flow("C2", "RW",
               IN(DATA(lambda m, n, A=A: A(m, n)), when=lambda k: k == 0),
               IN(TASK("TSMQR", "C2", lambda m, n, k: dict(m=m, n=n,
@@ -207,9 +269,44 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
                   when=lambda m, n, k: m > k + 1 and n > k + 1))
     def cpu_tsmqr(Q, C1, C2):
         mb_ = np.asarray(C1).shape[0]
-        stacked = np.concatenate([np.asarray(C1), np.asarray(C2)], axis=0)
-        out = np.asarray(Q).T @ stacked
-        return {"C1": out[:mb_, :], "C2": out[mb_:, :]}
+        Qn = np.asarray(Q)
+        V, Tt = Qn[:mb_, :], Qn[mb_:, :]
+        C1n, C2n = np.asarray(C1), np.asarray(C2)
+        Z = Tt @ (C1n + V.T @ C2n)
+        return {"C1": C1n - Z, "C2": C2n - V @ Z}
     bodies(tb, _k("tsmqr", _mk_tsmqr), cpu_tsmqr)
 
-    return p.build()
+    if routed:
+        tb = p.task("RSTORE", k=Range(0, NT - 2)) \
+            .affinity(lambda k, A=A: A(k, k)) \
+            .flow("X", "RW",
+                  IN(TASK("TSQRT", "T", lambda k, NT=NT: dict(m=NT - 1,
+                                                              k=k))),
+                  OUT(DATA(lambda k, A=A: A(k, k))))
+        bodies(tb, _k("store", lambda: (lambda X: X)),
+               lambda X: np.asarray(X))
+        tb = p.task("CSTORE", k=Range(0, NT - 2),
+                    n=Range(lambda k: k + 1, NT - 1)) \
+            .affinity(lambda k, n, A=A: A(k, n)) \
+            .flow("X", "RW",
+                  IN(TASK("TSMQR", "C1",
+                          lambda k, n, NT=NT: dict(m=NT - 1, n=n, k=k))),
+                  OUT(DATA(lambda k, n, A=A: A(k, n))))
+        bodies(tb, _k("store", lambda: (lambda X: X)),
+               lambda X: np.asarray(X))
+
+    tp = p.build()
+    for name, tc in tp.task_classes.items():
+        # executed-flop weights for device load balancing (stores move
+        # a tile, no flops)
+        tc.properties["flops"] = {"GEQRT": 2.0 * mb ** 3,
+                                  "UNMQR": 2.0 * mb ** 3,
+                                  "TSQRT": 6.0 * mb ** 3,
+                                  "TSMQR": 10.0 * mb ** 3}.get(name, 1.0)
+    return tp
+
+
+def geqrf_flops(m: int, n: int) -> float:
+    """Useful FLOPs of an m x n QR factorization (2mn^2 - 2n^3/3;
+    = 4n^3/3 when square)."""
+    return 2.0 * m * n * n - 2.0 * n ** 3 / 3.0

@@ -56,6 +56,19 @@ TAG_USER = 16     # first tag available to applications
 
 _LEN = struct.Struct("!IQ")   # (tag, payload length)
 
+#: wire-format guard (VERDICT r2: a malformed or cross-version frame
+#: must fail its CONNECTION with a cause, not corrupt the recv thread):
+#: connections handshake magic+version+rank; frames are bounded and
+#: undecodable ones sever the peer
+_HANDSHAKE = struct.Struct("!4sII")   # (magic, proto version, rank)
+_WIRE_MAGIC = b"PTCE"
+_WIRE_VERSION = 1
+
+params.register("comm_max_frame_mb", 4096,
+                "largest acceptable frame payload in MiB; a length field "
+                "beyond this is treated as stream corruption and severs "
+                "the connection")
+
 
 def wire_dtype(dtype) -> str:
     """A dtype string that round-trips over the wire.  Extension dtypes
@@ -355,12 +368,18 @@ class SocketCE(CommEngine):
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            # peer announces its rank first
-            hdr = self._recv_exact(conn, 4)
+            # peer announces magic + protocol version + rank first: a
+            # stranger or cross-version peer fails ITS connection here
+            hdr = self._recv_exact(conn, _HANDSHAKE.size)
             if hdr is None:
                 conn.close()
                 continue
-            src = struct.unpack("!I", hdr)[0]
+            magic, ver, src = _HANDSHAKE.unpack(hdr)
+            if magic != _WIRE_MAGIC or ver != _WIRE_VERSION:
+                warning("rank %d: rejected connection with bad handshake "
+                        "(magic=%r version=%r)", self.rank, magic, ver)
+                conn.close()
+                continue
             with self._plock:
                 self._peers.setdefault(src, conn)
                 self._send_locks.setdefault(src, threading.Lock())
@@ -399,7 +418,7 @@ class SocketCE(CommEngine):
                     raise
                 time.sleep(0.05)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        s.sendall(struct.pack("!I", self.rank))
+        s.sendall(_HANDSHAKE.pack(_WIRE_MAGIC, _WIRE_VERSION, self.rank))
         with self._plock:
             self._peers[dst] = s
             self._send_locks.setdefault(dst, threading.Lock())
@@ -424,12 +443,21 @@ class SocketCE(CommEngine):
         return buf
 
     def _recv_loop(self, conn: socket.socket, src: int) -> None:
+        max_ln = int(params.get("comm_max_frame_mb", 4096)) << 20
         while not self._stop:
             hdr = self._recv_exact(conn, _LEN.size)
             if hdr is None:
                 self._peer_lost(src)
                 return
             tag, ln = _LEN.unpack(hdr)
+            if ln > max_ln:
+                # corrupt stream (or hostile length): sever THIS
+                # connection with a cause instead of trying to consume
+                # an absurd frame — the guard VERDICT r2 asked for
+                self._peer_corrupt(src, conn,
+                                   f"frame length {ln} exceeds the "
+                                   f"{max_ln >> 20} MiB bound (tag={tag})")
+                return
             data = self._recv_exact(conn, ln) if ln else b""
             if data is None:
                 self._peer_lost(src)
@@ -437,12 +465,29 @@ class SocketCE(CommEngine):
             self.recv_msgs += 1
             try:
                 payload = pickle.loads(data) if data else None
+            except Exception as exc:
+                # undecodable frame = wire corruption: fail the
+                # connection, not the handler path
+                self._peer_corrupt(src, conn,
+                                   f"undecodable frame tag={tag}: {exc}")
+                return
+            try:
                 self._dispatch(tag, src, payload)
             except Exception as exc:   # handler error must not kill recv,
                 warning("rank %d: AM handler tag=%d failed: %s",
                         self.rank, tag, exc)
                 if self.on_error is not None:   # ...but must fail the rank
                     self.on_error(exc)
+
+    def _peer_corrupt(self, src: int, conn: socket.socket,
+                      why: str) -> None:
+        warning("rank %d: protocol corruption from rank %d: %s",
+                self.rank, src, why)
+        try:
+            conn.close()
+        except OSError:
+            pass
+        self._peer_lost(src)
 
     def _peer_lost(self, src: int) -> None:
         """Failure detection: a peer's socket closed while we are still

@@ -84,6 +84,14 @@ class Context:
         self.device_registry = init_devices(self)
         self.devices = self.device_registry.devices
 
+        # properties dictionary: runtime-queryable hierarchical key
+        # space for live tooling (reference: parsec/dictionary.c; see
+        # utils/properties.py)
+        from parsec_tpu.utils.properties import (PropertySpace,
+                                                 install_runtime_properties)
+        self.properties = PropertySpace()
+        install_runtime_properties(self)
+
         # ICI transport: multi-device payload edges ride XLA collectives
         # (reference: the second comm-engine module seam, SURVEY §5.8).
         # Import first: it registers comm_ici_enabled, so an env override
@@ -189,6 +197,8 @@ class Context:
             self.taskpools[tp.taskpool_id] = tp
             tp.attach(self, self.termdet_for(tp))
             self._pending_start.append(tp)
+        from parsec_tpu.utils.properties import install_taskpool_properties
+        install_taskpool_properties(self, tp)
         if self.comm is not None:
             # activations may have raced this registration
             self.comm.retry_delayed()

@@ -386,9 +386,18 @@ def release_deps(es, task: Task) -> List[Task]:
             if entry is None and copy is not None:
                 entry = tc.repo.lookup_entry_and_create(task.key)
             if copy is not None:
+                if entry.copies[flow.flow_index] is not copy \
+                        and copy.arena is not None:
+                    # entry hold on the arena buffer: a NEW-flow copy
+                    # chained through several tasks lives in every
+                    # producer's entry, and only the LAST retirement may
+                    # return it to the freelist (reference: refcounted
+                    # repo copies, datarepo.h:50-58)
+                    copy.arena.retain_copy(copy)
                 entry.copies[flow.flow_index] = copy
                 consumers += 1
             src = (tc, task.key) if copy is not None else None
+            es.pins("deliver_dep", (task, succ_tc, succ_locals, dflow))
             t = deliver_dep(tp, succ_tc, succ_locals, dflow, dcopy, src)
             if t is not None:
                 ready.append(t)
@@ -409,11 +418,12 @@ def release_deps(es, task: Task) -> List[Task]:
     if tp.context is not None and tp.context.comm is not None:
         tp.context.comm.flush_activations(es, task)
         # flush serialized every outgoing payload synchronously: arena
-        # temporaries with no local consumer can go home now
+        # temporaries with no local consumer can go home now — unless an
+        # earlier producer's repo entry still holds the chained buffer
         for copy in remote_only_arena:
             if copy.data is not None:
                 copy.data.detach_copy(copy.device)
-            copy.arena.release_copy(copy)
+            copy.arena.release_unheld(copy)
     return ready
 
 
@@ -445,7 +455,7 @@ def _make_retire(task: Task):
     def retire(entry):
         for copy in entry.copies:
             if copy is not None and copy.arena is not None:
-                copy.arena.release_copy(copy)
+                copy.arena.drop_copy(copy)
     return retire
 
 

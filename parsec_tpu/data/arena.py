@@ -20,6 +20,13 @@ from parsec_tpu.data.data import Coherency, Data, DataCopy
 
 
 class Arena:
+    #: guards DataCopy.arena_refs mutations: repo-entry holds are taken
+    #: and dropped from different worker threads (release_deps vs a
+    #: predecessor's retirement), and a lost update would either free a
+    #: chained NEW-flow buffer early (corruption) or leak it.  One
+    #: class-level lock — the critical sections are a few instructions
+    _refs_lock = threading.Lock()
+
     def __init__(self, shape: Tuple[int, ...], dtype: Any = np.float32,
                  max_cached: int = 256):
         self.shape = tuple(shape)
@@ -60,9 +67,36 @@ class Arena:
     def release_copy(self, copy: DataCopy) -> None:
         if copy.arena is not self:
             raise ValueError("copy does not belong to this arena")
+        if copy.payload is None:
+            return    # already released (idempotent: multiple lifetime
+                      # managers may race to the same conclusion)
         self.release_buffer(copy.payload)
         copy.payload = None
         copy.coherency = Coherency.INVALID
+
+    # -- repo-entry holds (reference: refcounted repo copies,
+    # datarepo.h:50-58 — a NEW-flow buffer chained through several tasks
+    # is registered in every producer's entry; only the LAST drop may
+    # return it to the freelist) -----------------------------------------
+    def retain_copy(self, copy: DataCopy) -> None:
+        with Arena._refs_lock:
+            copy.arena_refs += 1
+
+    def drop_copy(self, copy: DataCopy) -> None:
+        """Drop one hold; frees the buffer when the count reaches zero."""
+        with Arena._refs_lock:
+            copy.arena_refs -= 1
+            free = copy.arena_refs <= 0
+        if free:
+            self.release_copy(copy)
+
+    def release_unheld(self, copy: DataCopy) -> None:
+        """Free only if NO entry holds the copy (supersede/remote-only
+        paths, where the releasing site is not itself a hold owner)."""
+        with Arena._refs_lock:
+            held = copy.arena_refs > 0
+        if not held:
+            self.release_copy(copy)
 
 
 class ArenaDatatype:

@@ -51,7 +51,8 @@ class DataCopy:
     (reference: parsec_data_copy_t)."""
 
     __slots__ = ("data", "device", "payload", "coherency", "version",
-                 "readers", "flags", "arena", "dtt", "__weakref__")
+                 "readers", "flags", "arena", "arena_refs", "dtt",
+                 "__weakref__")
 
     def __init__(self, data: "Data", device: int, payload: Any = None,
                  coherency: Coherency = Coherency.INVALID, version: int = 0):
@@ -63,6 +64,12 @@ class DataCopy:
         self.readers = 0          # active reader count (stage-out gating)
         self.flags = 0
         self.arena = None         # owning arena, if arena-allocated
+        #: repo-entry holds on an arena copy: a NEW-flow buffer chained
+        #: through several tasks is registered in EVERY producer's repo
+        #: entry, and may only return to the freelist when the LAST
+        #: entry retires (reference: refcounted copies in repo entries,
+        #: datarepo.h:50-58)
+        self.arena_refs = 0
         self.dtt = None           # datatype/layout tag (reshape engine)
 
     def is_pinned_snapshot(self, pinned: bool) -> bool:

@@ -62,6 +62,13 @@ params.register("device_fuse", 8,
                 "SYRK/GEMM trailing-update wave of a dense factorization "
                 "rides a single dispatch, amortizing per-launch latency; "
                 "1 disables)")
+params.register("device_dispatchers", 2,
+                "manager (launch) threads per XLA device: each dispatch "
+                "blocks on the transport ack (milliseconds through a "
+                "tunneled TPU), so overlapping independent launches keeps "
+                "the device queue fed; ordering stays safe because a "
+                "successor is only submitted after its producer's "
+                "dispatch returned")
 
 
 class XlaKernel:
@@ -236,13 +243,15 @@ class XlaDevice(Device):
         self._cond = threading.Condition()
         self._stop = False
         self.es = None   # device execution stream, set on first submit
-        self._manager = threading.Thread(
-            target=self._manager_loop, name=f"xla-mgr-{self.name}",
-            daemon=True)
+        self._managers = [
+            threading.Thread(target=self._manager_loop,
+                             name=f"xla-mgr-{self.name}-{i}", daemon=True)
+            for i in range(max(1, int(params.get("device_dispatchers", 2))))]
         self._completer = threading.Thread(
             target=self._completer_loop, name=f"xla-fin-{self.name}",
             daemon=True)
-        self._manager.start()
+        for m in self._managers:
+            m.start()
         self._completer.start()
 
     # ------------------------------------------------------------------
@@ -397,13 +406,22 @@ class XlaDevice(Device):
         k-wide TRSM/SYRK/GEMM wavefront costs one dispatch round trip."""
         spec: XlaKernel = batch[0][1]
         n = len(batch)
-        pinned: List[Any] = []
-        release_after: List[DataCopy] = []
+        #: pins and deferred arena releases stay PER TASK: each inflight
+        #: entry holds only its own, so finalizing one entry of a fused
+        #: wave cannot unpin a sibling's datums before that sibling's
+        #: completion ran (a concurrent dispatcher's _reserve would evict
+        #: the still-live copy)
+        pinned_per: List[List[Any]] = []
+        release_per: List[List[DataCopy]] = []
         flat: List[Any] = []
         try:
             for task, _spec, _load in batch:
                 tc = task.task_class
                 staged: Dict[str, Any] = {}
+                pinned: List[Any] = []
+                release_after: List[DataCopy] = []
+                pinned_per.append(pinned)
+                release_per.append(release_after)
                 # pin every datum this task touches before any eviction
                 # decision
                 for flow in tc.flows:
@@ -442,12 +460,16 @@ class XlaDevice(Device):
                 self.stats.fused_tasks += n
             outs_per_task = [spec.bind_outputs(r) for r in results]
         except Exception:
-            for d in pinned:
-                self._unpin(d)
+            for pinned in pinned_per:
+                for d in pinned:
+                    self._unpin(d)
             # arena copies already detached for deferred release would
-            # otherwise leak on the failure path (ADVICE r1 low)
-            for copy in release_after:
-                copy.arena.release_copy(copy)
+            # otherwise leak on the failure path (ADVICE r1 low);
+            # release_unheld: a chained NEW-flow buffer a predecessor's
+            # repo entry still holds must wait for that retirement
+            for release_after in release_per:
+                for copy in release_after:
+                    copy.arena.release_unheld(copy)
             raise
         self.stats.executed_tasks += n
         with self._cond:
@@ -456,8 +478,7 @@ class XlaDevice(Device):
             for i, (task, _spec, load) in enumerate(batch):
                 self._inflight.append(
                     _Inflight(self.es, task, spec, outs_per_task[i],
-                              pinned if i == 0 else [], load,
-                              release_after if i == 0 else []))
+                              pinned_per[i], load, release_per[i]))
             self._cond.notify_all()
 
     @staticmethod
@@ -718,7 +739,9 @@ class XlaDevice(Device):
             for d in inf.pinned:
                 self._unpin(d)
             for copy in inf.release_after:
-                copy.arena.release_copy(copy)
+                # a predecessor's repo entry may still hold this
+                # superseded host buffer for its OTHER consumers
+                copy.arena.release_unheld(copy)
 
     def adopt(self, datum, dc: DataCopy) -> None:
         """Account a device copy attached by an EXTERNAL placer (the ICI
@@ -938,7 +961,8 @@ class XlaDevice(Device):
         with self._cond:
             self._stop = True
             self._cond.notify_all()
-        self._manager.join(timeout=5)
+        for m in self._managers:
+            m.join(timeout=5)
         self._completer.join(timeout=5)
         self.flush()
         debug_verbose(5, "device %s: %s", self.name, self.stats.as_dict())

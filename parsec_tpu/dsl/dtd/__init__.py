@@ -4,5 +4,6 @@ reference: parsec/interfaces/dtd/ — see insert.py in this package.
 """
 
 from parsec_tpu.dsl.dtd.insert import (AFFINITY, DONT_TRACK, INOUT,  # noqa: F401
-                                       INPUT, OUTPUT, SCRATCH, VALUE,
-                                       DTDTaskpool, DTDTile)
+                                       INPUT, OUTPUT, PULLIN, PUSHOUT,
+                                       SCRATCH, VALUE, DTDTaskClass,
+                                       DTDTaskpool, DTDTile, Region)

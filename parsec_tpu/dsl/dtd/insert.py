@@ -58,12 +58,51 @@ params.register("dtd_threshold_size", 1024,
 # -- argument modes (reference: insert_function.h:60-78 flags) --------------
 
 class _Mode:
-    def __init__(self, name: str, access: int):
+    def __init__(self, name: str, access: int, base: "_Mode" = None,
+                 flags: frozenset = frozenset(), region: Any = None):
         self.name = name
         self.access = access
+        self.base = base or self
+        self.flags = flags
+        self.region = region
+
+    def __or__(self, other):
+        """Compose with a modifier, mirroring the reference's OR'd flag
+        words: ``INOUT | PUSHOUT``, ``INPUT | REGION_L``
+        (reference: insert_function.h:60-78 PUSHOUT/PULLIN + region
+        masks)."""
+        if isinstance(other, _Flag):
+            return _Mode(f"{self.name}|{other.name}", self.access,
+                         base=self.base, flags=self.flags | {other.name},
+                         region=self.region)
+        if isinstance(other, Region):
+            return _Mode(f"{self.name}|R({other.rid})", self.access,
+                         base=self.base, flags=self.flags,
+                         region=other.rid)
+        return NotImplemented
 
     def __repr__(self):
         return self.name
+
+
+class _Flag:
+    """Data-movement modifier OR'd onto an access mode."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return self.name
+
+
+class Region:
+    """Partial-tile dependency lane (reference: the region masks of
+    insert_function.h — e.g. upper/lower/diagonal sub-tile regions).
+    Accesses to DISTINCT regions of one tile do not conflict; a
+    region-free access conflicts with every lane."""
+
+    def __init__(self, rid: Any):
+        self.rid = rid
 
 
 INPUT = _Mode("INPUT", ACCESS_READ)
@@ -74,6 +113,26 @@ SCRATCH = _Mode("SCRATCH", 0)    # per-task temporary buffer
 AFFINITY = _Mode("AFFINITY", 0)  # placement hint marker (modifier)
 DONT_TRACK = _Mode("DONT_TRACK", 0)  # access data without dep tracking
 
+#: force the produced tile home (host-authoritative) at task completion
+#: instead of staying device/producer-resident until a flush
+PUSHOUT = _Flag("PUSHOUT")
+#: eager-fetch hint: the executing site pulls inputs at stage-in anyway
+#: (always-correct on-demand movement), so PULLIN is accepted for API
+#: parity and is satisfied by construction
+PULLIN = _Flag("PULLIN")
+
+
+def _norm(args):
+    """Normalize each (value, mode) arg to (value, base_mode, flags,
+    region): composed modes (``INOUT | PUSHOUT | Region(...)``) reduce to
+    their base identity for the mode checks below."""
+    out = []
+    for value, mode in args:
+        if not isinstance(mode, _Mode):
+            raise TypeError(f"unsupported arg mode {mode!r}")
+        out.append((value, mode.base, mode.flags, mode.region))
+    return out
+
 
 class DTDTile:
     """Dep-tracking state of one datum (reference: parsec_dtd_tile_t —
@@ -82,7 +141,7 @@ class DTDTile:
     tile on the wire)."""
 
     __slots__ = ("data", "last_writer", "readers", "home_rank", "version",
-                 "wire_key", "v0_sent")
+                 "wire_key", "v0_sent", "lanes")
 
     def __init__(self, data: Data, home_rank: int = 0, wire_key: Any = None):
         self.data = data
@@ -93,6 +152,21 @@ class DTDTile:
         self.wire_key = wire_key
         #: ranks already sent the pristine (version-0) home payload
         self.v0_sent: set = set()
+        #: region dependency lanes (reference: region masks) — created
+        #: lazily on the first region-flagged access; None = the tile is
+        #: tracked whole (the fast default path)
+        self.lanes: Optional[Dict[Any, "_Lane"]] = None
+
+
+class _Lane:
+    """Per-region dependency history of one tile."""
+
+    __slots__ = ("last_writer", "readers")
+
+    def __init__(self, last_writer=None, readers=None):
+        self.last_writer = last_writer
+        self.readers: List["_DTDState"] = readers if readers is not None \
+            else []
 
 
 class _DTDState:
@@ -107,7 +181,7 @@ class _DTDState:
 
     __slots__ = ("task", "remaining", "successors", "done", "affinity",
                  "rank", "is_recv", "needed", "tile", "version", "payload",
-                 "remote_sends")
+                 "remote_sends", "pushout")
 
     def __init__(self, task: Optional[Task], rank: int = 0):
         self.task = task
@@ -118,6 +192,7 @@ class _DTDState:
         self.rank = rank
         self.is_recv = False
         self.needed = False
+        self.pushout: List["DTDTile"] = []
         self.tile: Optional[DTDTile] = None
         self.version = 0
         self.payload: Optional[np.ndarray] = None
@@ -339,6 +414,21 @@ class DTDTaskpool(Taskpool):
         tc.taskpool = self
         self.task_classes[f"{tc.name}#{tc.task_class_id}"] = tc
 
+    def create_task_class(self, name: str, arg_names: Sequence[str],
+                          modes: Sequence[_Mode]) -> "DTDTaskClass":
+        """Explicit task-class API (reference:
+        parsec_dtd_create_task_classv, insert_function.c:2539 area):
+        declare the argument layout once, attach one chore per device
+        type with :meth:`DTDTaskClass.add_chore`, then pass the class to
+        :meth:`insert_task` in place of a function.  One logical task
+        can carry CPU and TPU chores; the runtime picks per execution
+        (the incarnation iteration of scheduling.execute)."""
+        if len(arg_names) != len(modes):
+            raise ValueError("one name per argument mode")
+        return DTDTaskClass(name, list(arg_names),
+                            [m.base for m in modes])
+
+
     def _cpu_hook(self, fn: Callable, names: List[str],
                   writable: List[str]):
         def hook(es, task):
@@ -415,12 +505,22 @@ class DTDTaskpool(Taskpool):
         if self.context is None:
             raise RuntimeError(
                 "attach the DTD pool to a context before inserting")
+        nargs = _norm(args)
+        if self.nranks > 1 and any(r is not None for *_x, r in nargs):
+            raise NotImplementedError(
+                "region-masked dependencies are shared-memory only "
+                "(distributed region lanes are not tracked on the wire)")
+        args = [(v, b) for v, b, _f, _r in nargs]
         rank = self._task_rank(args) if self.nranks > 1 else self.myrank
         if rank != self.myrank:
             self._insert_remote(args, rank)
             return None
-        modes = tuple(m for _, m in args)
-        tc = self._class_for(fn, modes, device)
+        if isinstance(fn, DTDTaskClass):
+            tc = fn.materialize(self)
+            fn.validate_modes(tuple(b for _v, b in args))
+        else:
+            modes = tuple(m for _, m in args)
+            tc = self._class_for(fn, modes, device)
         names = tc.dtd_names
 
         task = Task(tc, self, {"tid": next(_seq)})
@@ -439,8 +539,8 @@ class DTDTaskpool(Taskpool):
 
         # parse/validate args FIRST: raising after the nb_tasks increment
         # would leave the count high forever and hang wait() (ADVICE r1)
-        tracked: List[Tuple[DTDTile, _Mode]] = []
-        for i, (value, mode) in enumerate(args):
+        tracked: List[Tuple[DTDTile, _Mode, Any]] = []
+        for i, (value, mode, flags, region) in enumerate(nargs):
             name = names[i]
             if mode is VALUE:
                 task.locals[name] = value
@@ -454,7 +554,12 @@ class DTDTaskpool(Taskpool):
                 tile = self._as_tile(value)
                 task.data[name] = tile.data.copy_on(0)
                 if mode is not DONT_TRACK:
-                    tracked.append((tile, mode))
+                    tracked.append((tile, mode, region))
+                if "PUSHOUT" in flags and mode is not INPUT:
+                    # force the result home at completion instead of
+                    # staying producer/device-resident until a flush
+                    # (reference: PARSEC_PUSHOUT)
+                    state.pushout.append(tile)
             else:
                 raise TypeError(f"unsupported arg mode {mode!r}")
 
@@ -462,8 +567,8 @@ class DTDTaskpool(Taskpool):
         to_schedule: List[Task] = []
         with self._dep_lock:
             self._inflight += 1
-            for tile, mode in tracked:
-                self._track(state, tile, mode, to_schedule)
+            for tile, mode, region in tracked:
+                self._track(state, tile, mode, to_schedule, region=region)
             # read under the lock: once released, a completing predecessor
             # may drive remaining to 0 and schedule the task itself —
             # checking outside would double-schedule
@@ -698,11 +803,19 @@ class DTDTaskpool(Taskpool):
         raise TypeError(f"cannot interpret {value!r} as a tile")
 
     def _track(self, state: _DTDState, tile: DTDTile, mode: _Mode,
-               to_schedule: List[Task]) -> None:
+               to_schedule: List[Task], region: Any = None) -> None:
         """Register RAW/WAR/WAW edges against the tile's history (caller
         holds _dep_lock; reference: set_dependencies_for_function +
         parsec_dtd_ordering_correctly).  Versions produced on other ranks
-        appear as delivery surrogates; consuming one marks it needed."""
+        appear as delivery surrogates; consuming one marks it needed.
+
+        ``region`` selects a partial-tile dependency lane (reference:
+        the region masks of insert_function.h): distinct regions of one
+        tile do not conflict; a region-free access conflicts with every
+        lane.  Shared-memory only (guarded at insert_task)."""
+        if region is not None or tile.lanes is not None:
+            self._track_region(state, tile, mode, region)
+            return
         me = self.myrank
         lw = tile.last_writer
         if mode is INPUT:
@@ -739,11 +852,58 @@ class DTDTaskpool(Taskpool):
             tile.last_writer = state
             tile.readers = []
 
+    def _track_region(self, state: _DTDState, tile: DTDTile, mode: _Mode,
+                      region: Any) -> None:
+        """Region-lane dependency tracking (shared-memory).  The first
+        region-flagged access migrates the tile's whole-tile history into
+        the ``None`` lane; thereafter a region access conflicts with its
+        own lane plus the whole-tile lane, and a whole-tile access
+        conflicts with every lane."""
+        if tile.lanes is None:
+            tile.lanes = {None: _Lane(tile.last_writer,
+                                      list(tile.readers))}
+        lanes = tile.lanes
+        if region is not None and region not in lanes:
+            lanes[region] = _Lane()
+        conflict = [lanes[region], lanes.setdefault(None, _Lane())] \
+            if region is not None else list(lanes.values())
+        mine = lanes[region]
+        if mode is INPUT:
+            for lane in conflict:
+                if lane.last_writer is not None:
+                    self._edge(lane.last_writer, state)        # RAW
+            mine.readers.append(state)
+        else:
+            for lane in conflict:
+                for r in lane.readers:                         # WAR
+                    self._edge(r, state)
+                if lane.last_writer is not None:               # WAW
+                    self._edge(lane.last_writer, state)
+            if region is None:
+                # whole-tile write supersedes every lane's history
+                tile.lanes = {None: _Lane(state)}
+            else:
+                mine.last_writer = state
+                mine.readers = []
+            tile.version += 1
+            # keep the legacy fields coherent for flush/debug paths
+            tile.last_writer = state
+            tile.readers = []
+
     # -- dynamic release (called from engine.release_deps) ----------------
     def dynamic_release(self, es, task: Task) -> List[Task]:
         state = task.dtd
         if not isinstance(state, _DTDState):
             return []
+        for tile in state.pushout:
+            # PUSHOUT: force the produced version home now (reference:
+            # PARSEC_PUSHOUT — eager writeback instead of lazy residency)
+            try:
+                tile.data.pull_to_host()
+                if tile.data.collection is not None:
+                    tile.data.collection.refresh_backing(tile.data)
+            except Exception as exc:
+                self.context.record_error(exc, task)
         grapher = self.context.grapher if self.context else None
         ready: List[Task] = []
         outgoing: List[Tuple[int, dict]] = []
@@ -793,3 +953,63 @@ class DTDTaskpool(Taskpool):
         for dst, msg in outgoing:
             self.context.comm.dtd_send(dst, msg)
         return ready
+
+
+class DTDTaskClass:
+    """User-declared DTD task class with explicit per-device chores
+    (reference: parsec_dtd_create_task_classv + parsec_dtd_add_chore)."""
+
+    def __init__(self, name: str, arg_names: List[str],
+                 modes: List[_Mode]):
+        self.name = name
+        self.arg_names = arg_names
+        self.modes = modes
+        self.chores: List[Tuple[str, Callable]] = []
+        self._tc: Optional[TaskClass] = None
+
+    def add_chore(self, device: str, fn: Callable) -> "DTDTaskClass":
+        if self._tc is not None:
+            raise RuntimeError("add_chore after the class was first "
+                               "inserted (chore table is frozen)")
+        self.chores.append((device, fn))
+        return self
+
+    def validate_modes(self, modes: Tuple[_Mode, ...]) -> None:
+        if tuple(modes) != tuple(self.modes):
+            raise TypeError(
+                f"task class {self.name!r}: insert arg modes {modes} do "
+                f"not match the declared {tuple(self.modes)}")
+
+    def materialize(self, pool: DTDTaskpool) -> TaskClass:
+        if self._tc is not None:
+            if self._tc.taskpool is not pool:
+                raise RuntimeError(
+                    f"task class {self.name!r} is bound to another pool")
+            return self._tc
+        if not self.chores:
+            raise RuntimeError(f"task class {self.name!r} has no chores")
+        names: List[Optional[str]] = [
+            None if mode is AFFINITY else self.arg_names[i]
+            for i, mode in enumerate(self.modes)]
+        flows = []
+        for i, mode in enumerate(self.modes):
+            if mode in (INPUT, OUTPUT, INOUT, DONT_TRACK, SCRATCH):
+                access = mode.access if mode in (INPUT, OUTPUT, INOUT) \
+                    else ACCESS_READ
+                flows.append(Flow(names[i], access))
+        writable = [f.name for f in flows if f.access & ACCESS_WRITE]
+        bound = [n for n in names if n is not None]
+        incarnations = []
+        for device, fn in self.chores:
+            if device in ("tpu", "xla", "gpu"):
+                incarnations.append(
+                    (device, pool._device_hook(fn, bound, flows, writable)))
+            else:
+                incarnations.append(
+                    ("cpu", pool._cpu_hook(fn, bound, writable)))
+        tc = TaskClass(self.name, params=[("tid", None)], flows=flows,
+                       incarnations=incarnations)
+        tc.dtd_names = names
+        pool.add_task_class_dynamic(tc)
+        self._tc = tc
+        return tc

@@ -1,0 +1,569 @@
+"""Textual JDF front-end: parse the reference's task-graph language into
+the embedded PTG builder.
+
+The reference's defining artifact is a compiler for the JDF language
+(reference: parsec/interfaces/ptg/ptg-compiler/parsec.y grammar,
+parsec.l:141-159 tokens, driver main.c) emitting C.  Here the same
+surface syntax is parsed into the Python-embedded PTG builder
+(dsl/ptg/api.py) — no code generation: globals become taskpool globals,
+execution-space ranges become ``Range`` params, partitioning becomes
+affinity, dependency lines become guarded IN/OUT deps, and ``BODY``
+blocks are mapped to caller-supplied Python/JAX callables (inline C is
+NOT executed; an unmapped body raises a clear error at execution).
+
+Supported grammar subset (everything the reference's example corpus
+uses — Ex01..Ex07 and tests/apps/stencil/stencil_1D.jdf):
+
+- ``extern "C" %{ ... %}`` prologue/epilogue blocks (captured verbatim,
+  not executed),
+- globals with ``[ type=... hidden=on default=... ]`` properties,
+- task execution space: ``k = lo .. hi`` / ``lo .. hi .. step`` ranges
+  and derived locals ``name = expr``,
+- inline-C expressions ``%{ return EXPR; %}`` (expression-only; C
+  statements are rejected),
+- partitioning ``: data( exprs )``,
+- flows ``RW|READ|WRITE|CTL name`` with guarded, possibly ternary
+  endpoints ``(g) ? A Task(p) : B Other(p)``, range targets
+  ``A Task( k, 0 .. NB .. 2 )``, ``NEW``/``NULL`` endpoints, and
+  ``[ ... ]`` annotations (``type``/``type_remote`` looked up in the
+  caller's ``dtts`` map),
+- ``BODY [...] { ... } END`` (C source captured; annotation tolerated).
+
+C expressions are translated to Python (&&/||/!/ternary/-> field
+access), evaluated against the task's parameters, derived locals, and
+the taskpool globals — the same binding rules the generated code uses
+(reference: jdf2c.c expression evaluators, :2244).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from parsec_tpu.dsl.ptg.api import (DATA, IN, NEW, NULL_END, OUT, PTG,
+                                    Range, TASK)
+
+
+class JdfError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# C-expression -> Python translation
+# ---------------------------------------------------------------------------
+
+_INLINE_C = re.compile(r"%\{(.*?)%\}", re.S)
+
+
+def _inline_c_expr(body: str) -> str:
+    """``%{ return EXPR; %}`` -> EXPR; anything with statements is
+    rejected (the reference compiles arbitrary C; we map expressions)."""
+    m = re.fullmatch(r"\s*return\s+(.*?);\s*", body, re.S)
+    if not m:
+        raise JdfError(
+            f"inline C with statements is not supported (only "
+            f"'%{{ return EXPR; %}}'): {body.strip()[:60]!r}")
+    return m.group(1)
+
+
+def _translate_ternary(s: str) -> str:
+    """C ternary ``a ? b : c`` -> Python conditional, recursively,
+    splitting only at paren-depth 0."""
+    depth = 0
+    for i, ch in enumerate(s):
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "?" and depth == 0:
+            cond = s[:i]
+            rest = s[i + 1:]
+            d2 = 0
+            for j, c2 in enumerate(rest):
+                if c2 in "([":
+                    d2 += 1
+                elif c2 in ")]":
+                    d2 -= 1
+                elif c2 == ":" and d2 == 0:
+                    a, b = rest[:j], rest[j + 1:]
+                    return (f"(({_translate_ternary(a)}) if "
+                            f"({_translate_ternary(cond)}) else "
+                            f"({_translate_ternary(b)}))")
+            raise JdfError(f"ternary without ':' in {s!r}")
+    return s
+
+
+def c2py(expr: str) -> str:
+    """Translate a C expression (as appearing in JDF ranges, guards and
+    index expressions) to Python source."""
+    expr = expr.strip()
+    expr = _INLINE_C.sub(lambda m: "(" + _inline_c_expr(m.group(1)) + ")",
+                         expr)
+    expr = expr.replace("->", ".")
+    expr = expr.replace("&&", " and ").replace("||", " or ")
+    # logical not: '!' not part of '!='
+    expr = re.sub(r"!(?!=)", " not ", expr)
+    expr = _translate_ternary(expr)
+    return expr.strip()
+
+
+def _split_top(s: str, sep: str) -> List[str]:
+    """Split on ``sep`` at paren-depth 0."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+class JdfGlobal:
+    def __init__(self, name: str, props: Dict[str, str]):
+        self.name = name
+        self.props = props
+
+
+class JdfEndpoint:
+    """One side of a dependency arrow."""
+
+    def __init__(self, kind: str, flow: Optional[str] = None,
+                 target: Optional[str] = None,
+                 args: Optional[List[str]] = None):
+        self.kind = kind          # "task" | "data" | "new" | "null"
+        self.flow = flow          # peer flow name (task kind)
+        self.target = target      # task or data name
+        self.args = args or []    # raw C argument expressions
+
+
+class JdfDep:
+    def __init__(self, direction: str, guard: Optional[str],
+                 ep: JdfEndpoint, alt: Optional[JdfEndpoint],
+                 props: Dict[str, str]):
+        self.direction = direction            # "in" | "out"
+        self.guard = guard                    # raw C guard or None
+        self.ep = ep
+        self.alt = alt                        # ':' branch of a ternary
+        self.props = props                    # [ type=... ] annotations
+
+
+class JdfFlow:
+    def __init__(self, access: str, name: str):
+        self.access = access                  # RW | READ | WRITE | CTL
+        self.name = name
+        self.deps: List[JdfDep] = []
+
+
+class JdfTask:
+    def __init__(self, name: str, params: List[str]):
+        self.name = name
+        self.params = params
+        self.ranges: List[Tuple[str, str, str, Optional[str]]] = []
+        self.locals: List[Tuple[str, str]] = []      # derived, in order
+        self.partition: Optional[Tuple[str, List[str]]] = None
+        self.flows: List[JdfFlow] = []
+        self.body_src: str = ""
+        self.body_props: Dict[str, str] = {}
+
+
+class JdfFile:
+    def __init__(self):
+        self.externs: List[str] = []
+        self.globals: List[JdfGlobal] = []
+        self.tasks: List[JdfTask] = []
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+_PROPS = re.compile(r"(\w+)\s*=\s*(\"[^\"]*\"|\S+)")
+
+
+def _parse_props(s: str) -> Dict[str, str]:
+    out = {}
+    for k, v in _PROPS.findall(s):
+        out[k] = v.strip('"')
+    return out
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def parse_jdf(text: str) -> JdfFile:
+    """Parse JDF source into an AST (reference grammar: parsec.y)."""
+    jdf = JdfFile()
+
+    def grab_extern(m):
+        jdf.externs.append(m.group(1))
+        return ""
+    text = re.sub(r"extern\s+\"C\"\s*%\{(.*?)%\}", grab_extern, text,
+                  flags=re.S)
+    # protect inline-C expressions from comment/line processing
+    inlines: List[str] = []
+
+    def protect(m):
+        inlines.append(m.group(0))
+        return f"\x00{len(inlines) - 1}\x00"
+    text = _INLINE_C.sub(protect, text)
+    text = _strip_comments(text)
+
+    def unprotect(s: str) -> str:
+        return re.sub(r"\x00(\d+)\x00", lambda m: inlines[int(m.group(1))],
+                      s)
+
+    # split off BODY blocks first (they contain arbitrary C)
+    lines = text.splitlines()
+    i = 0
+    task: Optional[JdfTask] = None
+    flow: Optional[JdfFlow] = None
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if not line:
+            continue
+        if line.startswith("BODY"):
+            if task is None:
+                raise JdfError("BODY outside a task")
+            task.body_props = _parse_props(line[4:].strip(" []"))
+            body: List[str] = []
+            while i < len(lines):
+                l2 = lines[i]
+                i += 1
+                if l2.strip() == "END":
+                    break
+                body.append(l2)
+            else:
+                raise JdfError(f"task {task.name}: BODY without END")
+            task.body_src = unprotect("\n".join(body))
+            flow = None
+            continue
+        # dependency continuation (<- / ->)
+        if line.startswith("<-") or line.startswith("->"):
+            if flow is None:
+                raise JdfError(f"dangling dependency line: {line!r}")
+            flow.deps.append(_parse_dep(unprotect(line)))
+            continue
+        # flow header: ACCESS name [deps...]
+        m = re.match(r"^(RW|READ|WRITE|CTL)\s+(\w+)\s*(.*)$", line)
+        if m and task is not None:
+            flow = JdfFlow(m.group(1), m.group(2))
+            task.flows.append(flow)
+            rest = m.group(3).strip()
+            if rest:
+                flow.deps.append(_parse_dep(unprotect(rest)))
+            continue
+        # partitioning
+        if line.startswith(":") and task is not None:
+            mm = re.match(r":\s*(\w+)\s*\((.*)\)\s*$", line)
+            if not mm:
+                raise JdfError(f"bad partitioning line {line!r}")
+            task.partition = (mm.group(1),
+                              [unprotect(a.strip())
+                               for a in _split_top(mm.group(2), ",")])
+            continue
+        # definition: name = range/expr
+        m = re.match(r"^(\w+)\s*=\s*(.+)$", line)
+        if m and task is not None:
+            name, rhs = m.group(1), unprotect(m.group(2).strip())
+            parts = [p.strip() for p in re.split(r"\.\.", rhs)]
+            if name in task.params:
+                if len(parts) == 2:
+                    task.ranges.append((name, parts[0], parts[1], None))
+                elif len(parts) == 3:
+                    task.ranges.append((name, parts[0], parts[1], parts[2]))
+                else:
+                    raise JdfError(
+                        f"task {task.name}: parameter {name} needs a "
+                        f"'lo .. hi' range, got {rhs!r}")
+            else:
+                if len(parts) != 1:
+                    raise JdfError(
+                        f"task {task.name}: derived local {name} cannot "
+                        f"be a range")
+                task.locals.append((name, rhs))
+            continue
+        # global: NAME [ props ]
+        m = re.match(r"^(\w+)\s*\[(.*)\]\s*$", line)
+        if m and task is None:
+            jdf.globals.append(JdfGlobal(m.group(1),
+                                         _parse_props(unprotect(m.group(2)))))
+            continue
+        # task header: Name(a, b)
+        m = re.match(r"^(\w+)\s*\(([^)]*)\)\s*$", line)
+        if m:
+            task = JdfTask(m.group(1),
+                           [p.strip() for p in m.group(2).split(",")
+                            if p.strip()])
+            jdf.tasks.append(task)
+            flow = None
+            continue
+        raise JdfError(f"unrecognized JDF line: {line!r}")
+    return jdf
+
+
+def _parse_dep(line: str) -> JdfDep:
+    direction = "in" if line.startswith("<-") else "out"
+    rest = line[2:].strip()
+    props: Dict[str, str] = {}
+    pm = re.search(r"\[([^\]]*)\]\s*$", rest)
+    if pm:
+        props = _parse_props(pm.group(1))
+        rest = rest[:pm.start()].strip()
+    guard = None
+    alt = None
+    if rest.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    after = rest[i + 1:].strip()
+                    if after.startswith("?"):
+                        guard = rest[1:i]
+                        rest = after[1:].strip()
+                    break
+    if guard is not None:
+        branches = _split_top(rest, ":")
+        if len(branches) == 2:
+            ep = _parse_endpoint(branches[0].strip())
+            alt = _parse_endpoint(branches[1].strip())
+        else:
+            ep = _parse_endpoint(rest)
+    else:
+        ep = _parse_endpoint(rest)
+    return JdfDep(direction, guard, ep, alt, props)
+
+
+def _parse_endpoint(s: str) -> JdfEndpoint:
+    s = s.strip()
+    if s == "NEW":
+        return JdfEndpoint("new")
+    if s == "NULL":
+        return JdfEndpoint("null")
+    m = re.match(r"^(\w+)\s+(\w+)\s*\((.*)\)\s*$", s)
+    if m:
+        return JdfEndpoint("task", flow=m.group(1), target=m.group(2),
+                           args=[a.strip()
+                                 for a in _split_top(m.group(3), ",")])
+    m = re.match(r"^(\w+)\s*\((.*)\)\s*$", s)
+    if m:
+        return JdfEndpoint("data", target=m.group(1),
+                           args=[a.strip()
+                                 for a in _split_top(m.group(2), ",")])
+    raise JdfError(f"unparseable dependency endpoint {s!r}")
+
+
+# ---------------------------------------------------------------------------
+# builder: AST -> embedded PTG
+# ---------------------------------------------------------------------------
+
+def _compile_fn(expr_py: str, params: List[str],
+                derived: List[Tuple[str, str]], env: Dict[str, Any],
+                list_wrap: Optional[List[Tuple[str, str, str, str]]] = None):
+    """Build a real function ``f(params...)`` evaluating ``expr_py``
+    after computing the task's derived locals (the JDF 'name = expr'
+    definitions); ``list_wrap`` adds range-comprehension variables for
+    range deps."""
+    body = ["def _f(" + ", ".join(params) + "):"]
+    for name, dexpr in derived:
+        body.append(f"    {name} = ({c2py(dexpr)})")
+    if list_wrap:
+        comp = expr_py
+        for var, lo, hi, step in list_wrap:
+            comp += (f" for {var} in range(({c2py(lo)}), ({c2py(hi)}) + 1, "
+                     f"({c2py(step) if step else 1}))")
+        body.append(f"    return [{comp}]")
+    else:
+        body.append(f"    return ({expr_py})")
+    ns: Dict[str, Any] = dict(env)
+    exec("\n".join(body), ns)          # noqa: S102 — trusted build-time DSL
+    return ns["_f"]
+
+
+def _missing_body(task_name: str):
+    def body(*_a, **_k):
+        raise RuntimeError(
+            f"JDF task {task_name!r} has an inline-C body that was not "
+            f"mapped to Python — pass bodies={{{task_name!r}: fn}} to "
+            f"jdf_taskpool()")
+    return body
+
+
+def jdf_taskpool(source: str, *, globals: Optional[Dict[str, Any]] = None,
+                 data: Optional[Dict[str, Any]] = None,
+                 bodies: Optional[Dict[str, Any]] = None,
+                 arenas: Optional[Dict[str, Tuple[Tuple[int, ...], Any]]]
+                 = None,
+                 dtts: Optional[Dict[str, Any]] = None,
+                 name: Optional[str] = None):
+    """Parse JDF ``source`` (text or a path ending in .jdf) and build a
+    runnable taskpool.
+
+    ``globals``: values for the JDF globals (collections included).
+    ``data``: name -> data collection for partitioning/data endpoints
+    (defaults to any collection-valued globals).
+    ``bodies``: task name -> Python callable (or (device_kernel, cpu_fn)
+    tuple) replacing the inline-C BODY.
+    ``arenas``: arena name -> (shape, dtype) for NEW endpoints; a single
+    ``"default"`` entry serves JDF NEW (which is untyped in the text).
+    ``dtts``: annotation value (``type``/``type_remote``) -> dtt object.
+    """
+    if source.endswith(".jdf") and "\n" not in source:
+        with open(source) as fh:
+            text = fh.read()
+        if name is None:
+            name = re.sub(r"\.jdf$", "", source.rsplit("/", 1)[-1])
+    else:
+        text = source
+    jdf = parse_jdf(text)
+    gvals = dict(globals or {})
+    for g in jdf.globals:
+        if g.name in gvals:
+            continue
+        if data and g.name in data:
+            gvals[g.name] = data[g.name]    # collection-typed global
+        elif "default" in g.props:
+            gvals[g.name] = eval(c2py(g.props["default"]), {}, {})
+        else:
+            raise JdfError(f"JDF global {g.name!r} has no value: pass "
+                           f"globals={{{g.name!r}: ...}}")
+    dmap = dict(data or {})
+    for k, v in gvals.items():
+        if hasattr(v, "data_of") and k not in dmap:
+            dmap[k] = v
+    env = dict(gvals)
+    env.update(dmap)
+    env["np"] = np
+
+    p = PTG(name or (jdf.tasks[0].name.lower() if jdf.tasks else "jdf"),
+            **{k: v for k, v in gvals.items()
+               if isinstance(v, (int, float, str, bool))})
+    for aname, (shape, dtype) in (arenas or {}).items():
+        p.arena(aname, shape, dtype)
+
+    task_names = {t.name for t in jdf.tasks}
+
+    for t in jdf.tasks:
+        ranges: Dict[str, Any] = {}
+        declared = [r[0] for r in t.ranges]
+        for pname in t.params:
+            if pname not in declared:
+                raise JdfError(
+                    f"task {t.name}: parameter {pname} has no range")
+        for pname, lo, hi, step in t.ranges:
+            # earlier params may appear in later bounds: compile bound
+            # fns over the preceding params
+            idx = t.params.index(pname)
+            prior = t.params[:idx]
+            lo_f = _compile_fn(c2py(lo), prior, t.locals[:0], env) \
+                if prior else eval(c2py(lo), dict(env))
+            hi_f = _compile_fn(c2py(hi), prior, t.locals[:0], env) \
+                if prior else eval(c2py(hi), dict(env))
+            if step is not None:
+                st = eval(c2py(step), dict(env))
+                ranges[pname] = Range(lo_f, hi_f, st)
+            else:
+                ranges[pname] = Range(lo_f, hi_f)
+        tb = p.task(t.name, **ranges)
+        if t.partition is not None:
+            dname, args = t.partition
+            if dname not in dmap:
+                raise JdfError(f"task {t.name}: partitioning data "
+                               f"{dname!r} not provided")
+            expr = f"{dname}(" + ", ".join(c2py(a) for a in args) + ")"
+            tb.affinity(_compile_fn(expr, t.params, t.locals, env))
+        for f in t.flows:
+            ends = []
+            for dep in f.deps:
+                ends.extend(_build_dep(t, f, dep, env, dmap, jdf.tasks,
+                                       dtts or {}))
+            tb.flow(f.name, f.access, *ends)
+        body = (bodies or {}).get(t.name)
+        if body is None:
+            tb.body(_missing_body(t.name))
+        elif isinstance(body, tuple):
+            kern, cpu = body
+            tb.body(kern, device="tpu")
+            tb.body(cpu)
+        else:
+            tb.body(body)
+    return p.build()
+
+
+def _build_dep(t: JdfTask, f: JdfFlow, dep: JdfDep, env, dmap,
+               all_tasks: List[JdfTask], dtts) -> List[Any]:
+    """One JDF dependency line -> IN/OUT objects (a guarded ternary
+    yields two, with complementary guards)."""
+    task_names = {tt.name for tt in all_tasks}
+    ctor = IN if dep.direction == "in" else OUT
+    dtt = None
+    for key in ("type_remote", "type"):
+        if key in dep.props and dep.props[key] in dtts:
+            dtt = dtts[dep.props[key]]
+            break
+
+    def one(ep: JdfEndpoint, guard_expr: Optional[str]):
+        guard = _compile_fn(c2py(guard_expr), t.params, t.locals, env) \
+            if guard_expr is not None else None
+        kw = {}
+        if guard is not None:
+            kw["when"] = guard
+        if dtt is not None:
+            kw["dtt"] = dtt
+        if ep.kind == "new":
+            if dep.direction != "in":
+                raise JdfError(f"task {t.name}: NEW only valid on inputs")
+            return ctor(NEW("default"), **kw)
+        if ep.kind == "null":
+            return ctor(NULL_END(), **kw)
+        if ep.kind == "data":
+            if ep.target not in dmap:
+                raise JdfError(f"task {t.name}: data {ep.target!r} "
+                               f"not provided")
+            expr = (f"{ep.target}(" +
+                    ", ".join(c2py(a) for a in ep.args) + ")")
+            return ctor(DATA(_compile_fn(expr, t.params, t.locals, env)),
+                        **kw)
+        # task endpoint; range args become list-returning params fns
+        if ep.target not in task_names:
+            raise JdfError(f"task {t.name}: unknown peer task "
+                           f"{ep.target!r}")
+        tgt_params = next(tt.params for tt in all_tasks
+                          if tt.name == ep.target)
+        items = []
+        wraps = []
+        for pn, arg in zip(tgt_params, ep.args):
+            parts = [x.strip() for x in re.split(r"\.\.", arg)]
+            if len(parts) >= 2:
+                var = f"__r_{pn}"
+                wraps.append((var, parts[0], parts[1],
+                              parts[2] if len(parts) > 2 else None))
+                items.append(f"'{pn}': {var}")
+            else:
+                items.append(f"'{pn}': ({c2py(arg)})")
+        expr = "{" + ", ".join(items) + "}"
+        fn = _compile_fn(expr, t.params, t.locals, env,
+                         list_wrap=wraps or None)
+        return ctor(TASK(ep.target, ep.flow, fn), **kw)
+
+    if dep.alt is not None:
+        return [one(dep.ep, dep.guard),
+                one(dep.alt, f" not ({dep.guard})")]
+    return [one(dep.ep, dep.guard)]

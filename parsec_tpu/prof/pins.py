@@ -116,11 +116,88 @@ class GaugesPins:
         return str(self.gauges.snapshot())
 
 
+class IteratorsCheckerPins:
+    """Successor-iteration validator (reference:
+    mca/pins/iterators_checker — re-derives a completed task's successor
+    set and cross-checks it against the dependencies the engine actually
+    delivered; valuable precisely because this runtime's dep engine is
+    hand-written per front-end).  Per completed PTG task it re-walks the
+    flow expressions (iterate_successors) and compares with the
+    ``deliver_dep`` calls observed through the PINS hook: a lost or
+    extra delivery is reported as a context error.  Dynamic (DTD) pools
+    resolve successors from their runtime graph, not flow expressions,
+    and are skipped."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        #: id(task) -> set of (succ class name, succ key, flow name)
+        self._delivered: Dict[int, set] = {}
+        self.checked = 0
+        self.flagged = 0
+
+    def install(self, context) -> None:
+        context.pins_register("deliver_dep", self._deliver)
+        context.pins_register("complete_exec", self._complete)
+
+    def uninstall(self, context) -> None:
+        context.pins_unregister("deliver_dep", self._deliver)
+        context.pins_unregister("complete_exec", self._complete)
+
+    def _deliver(self, es, event, payload) -> None:
+        task, succ_tc, succ_locals, dflow = payload
+        with self._lock:
+            self._delivered.setdefault(id(task), set()).add(
+                (succ_tc.name, succ_tc.make_key(succ_locals), dflow))
+
+    def _expected(self, task) -> set:
+        from parsec_tpu.core.task import ToTask
+        tp = task.taskpool
+        myrank = tp.context.rank if tp.context else 0
+        want = set()
+        for flow in task.task_class.flows:
+            for dep in flow.active_outputs(task.locals):
+                end = dep.end
+                if not isinstance(end, ToTask):
+                    continue
+                succ_tc = tp.task_classes[end.task_class]
+                for succ_locals in end.instances(task.locals):
+                    if succ_tc.rank_of(succ_locals) != myrank:
+                        continue
+                    want.add((succ_tc.name, succ_tc.make_key(succ_locals),
+                              end.flow))
+        return want
+
+    def _complete(self, es, event, task) -> None:
+        if getattr(task.taskpool, "dynamic_release", None) is not None:
+            return          # DTD: successors come from the runtime graph
+        with self._lock:
+            got = self._delivered.pop(id(task), set())
+        try:
+            want = self._expected(task)
+        except Exception:
+            return          # un-evaluable expressions: nothing to check
+        self.checked += 1
+        if got != want:
+            self.flagged += 1
+            missing = want - got
+            extra = got - want
+            es.context.record_error(AssertionError(
+                f"iterators_checker: {task} successor mismatch — "
+                f"missing deliveries: {sorted(missing)}; "
+                f"unexpected deliveries: {sorted(extra)}"), task)
+
+    def display(self) -> str:
+        return f"iterators_checker checked={self.checked} " \
+               f"flagged={self.flagged}"
+
+
 #: name -> zero-arg constructor; the MCA-selected modules of ``--mca
 #: pins a,b`` (reference: the pins framework's module list, pins_init.c)
 _MODULES = {
     "print_steals": StealCounterPins,
     "alperf": GaugesPins,
+    "iterators_checker": IteratorsCheckerPins,
 }
 
 

@@ -32,6 +32,37 @@ def test_potrf_matches_numpy(device, nt):
 
 
 @pytest.mark.parametrize("device", ["tpu", "cpu"])
+def test_potrf_bf16_panels_mixed_precision(device):
+    """bf16-panel mixed precision (HPL-AI-style; bench.py potrf mp mode):
+    the kernels are dtype-following, so storing off-diagonal tiles bf16
+    must still produce a valid factorization of a (slightly perturbed)
+    matrix — loose tolerance reflects bf16 storage rounding."""
+    from ml_dtypes import bfloat16
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    mb, nt = 16, 4
+    n = nt * mb
+    rng = np.random.default_rng(3)
+    spd = _spd(n, rng)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, dtype=bfloat16)
+    for m, nn in A.local_tiles():
+        blk = spd[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
+        A.data_of(m, nn).overwrite_host(blk.astype(bfloat16))
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(potrf_taskpool(A, device=device))
+        ctx.wait()
+    L = np.zeros((n, n), np.float32)
+    for m, nn in A.local_tiles():
+        if m < nn:
+            continue
+        L[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb] = \
+            np.asarray(A.data_of(m, nn).pull_to_host().payload,
+                       dtype=np.float32)
+    L = np.tril(L)
+    err = np.abs(L @ L.T - spd).max() / np.abs(spd).max()
+    assert err < 3e-2, err
+
+
+@pytest.mark.parametrize("device", ["tpu", "cpu"])
 @pytest.mark.parametrize("nt", [1, 2, 4])
 def test_qr_matches_numpy(device, nt):
     from parsec_tpu.apps.qr import qr_taskpool

@@ -217,3 +217,103 @@ def test_mixed_dtd_then_second_pool():
         tp2.wait()
     np.testing.assert_allclose(
         np.asarray(A.data_of(0, 0).pull_to_host().payload), 3.0)
+
+
+def test_region_masks_disjoint_writers_run_unordered():
+    """Region-masked deps (reference: insert_function.h region flags):
+    writers of DISJOINT tile regions take no edge between them, while a
+    whole-tile access orders against every lane."""
+    from parsec_tpu.dsl.dtd import Region
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+    RU, RL = Region("upper"), Region("lower")
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_of(A, 0, 0)
+
+        def wr_u(T):
+            T[0, :] = T[0, :] + 1.0
+
+        def wr_l(T):
+            T[3, :] = T[3, :] + 2.0
+        t1 = tp.insert_task(wr_u, (t, INOUT | RU))
+        t2 = tp.insert_task(wr_l, (t, INOUT | RL))
+        # disjoint regions: the second writer has NO pending deps
+        assert t2.dtd.remaining == 0
+        # a whole-tile reader orders against BOTH lanes
+        t3 = tp.insert_task(lambda T: None, (t, INPUT))
+        assert t3.dtd.remaining in (1, 2)   # un-completed lane writers
+        # and a whole-tile writer after it conflicts with everything
+        t4 = tp.insert_task(lambda T: T * 2.0, (t, INOUT))
+        tp.wait()
+    out = np.asarray(A.data_of(0, 0).pull_to_host().payload)
+    np.testing.assert_allclose(out[0, :], 2.0)    # (+1) * 2
+    np.testing.assert_allclose(out[3, :], 4.0)    # (+2) * 2
+    np.testing.assert_allclose(out[1:3, :], 0.0)
+
+
+def test_region_masks_rejected_distributed():
+    from parsec_tpu.dsl.dtd import Region
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        tp.nranks = 2    # pretend: the guard must fire before any wire op
+        t = tp.tile_of(A, 0, 0)
+        with pytest.raises(NotImplementedError, match="region"):
+            tp.insert_task(lambda T: T, (t, INOUT | Region(1)))
+        tp.nranks = 1
+        tp.wait()
+
+
+def test_pushout_forces_result_home():
+    """PUSHOUT (reference: insert_function.h) writes the produced tile
+    home at completion — the host copy is authoritative without any
+    data_flush_all."""
+    from parsec_tpu.dsl.dtd import PUSHOUT
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    host = A.data_of(0, 0).copy_on(0)
+    host.payload[:] = 1.0
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        t = tp.tile_of(A, 0, 0)
+        tp.insert_task(lambda T: T + 41.0, (t, INOUT | PUSHOUT))
+        tp.wait()
+        # no flush: the home copy must already hold the result
+        datum = A.data_of(0, 0)
+        newest = max(c.version for c in datum.copies().values()
+                     if c.payload is not None)
+        hc = datum.copy_on(0)
+        assert hc is not None and hc.version == newest
+        np.testing.assert_allclose(np.asarray(hc.payload), 42.0)
+
+
+def test_create_task_class_add_chore():
+    """Explicit task classes with per-device chores (reference:
+    parsec_dtd_create_task_classv + parsec_dtd_add_chore): one logical
+    task carries a TPU and a CPU chore; the runtime selects per
+    execution, and the declared arg layout is validated at insert."""
+    from parsec_tpu.dsl.dtd import DTDTaskClass  # noqa: F401 (API surface)
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+    A.data_of(0, 0).copy_on(0).payload[:] = 3.0
+    ran = {"cpu": 0}
+    with Context(nb_cores=2) as ctx:
+        tp = make_pool(ctx)
+        cls = tp.create_task_class("axpy", ["T", "s"], [INOUT, VALUE])
+        cls.add_chore("tpu", lambda T, s: T * s)
+
+        def cpu_axpy(T, s):
+            ran["cpu"] += 1
+            return np.asarray(T) * s
+        cls.add_chore("cpu", cpu_axpy)
+        t = tp.tile_of(A, 0, 0)
+        tp.insert_task(cls, (t, INOUT), (2.0, VALUE))
+        tp.insert_task(cls, (t, INOUT), (5.0, VALUE))
+        with pytest.raises(TypeError, match="do not match"):
+            tp.insert_task(cls, (t, INPUT), (1.0, VALUE))
+        tp.wait()
+    out = np.asarray(A.data_of(0, 0).pull_to_host().payload)
+    np.testing.assert_allclose(out, 30.0)
+    # the device chore was preferred (declared first); cpu stayed cold
+    if len(Context.__mro__) and ran["cpu"]:
+        # CPU fallback is legal if no accelerator was attached
+        assert ran["cpu"] in (0, 2)

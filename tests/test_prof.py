@@ -156,10 +156,15 @@ def test_trace_tools_cli(tmp_path):
 
     env = {**__import__("os").environ, "JAX_PLATFORMS": "cpu"}
     r = subprocess.run(
-        [sys.executable, "tools/trace_info.py", path, "--stats"],
+        [sys.executable, "tools/trace_info.py", path, "--stats", "--gaps"],
         capture_output=True, text=True, timeout=120, env=env)
     assert r.returncode == 0, r.stderr
     assert "dictionary" in r.stdout and "total events" in r.stdout
+    # dbpinfos-style workhorse output: per-class stats + occupancy gaps
+    assert "per-class interval stats" in r.stdout
+    assert "count" in r.stdout and "mean" in r.stdout
+    assert "per-stream occupancy" in r.stdout
+    assert "util" in r.stdout and "largest gap" in r.stdout
 
     out = str(tmp_path / "tools.json")
     r = subprocess.run(
@@ -204,3 +209,111 @@ def test_pins_mca_selection(capfd):
     err = capfd.readouterr().err
     assert "nosuchmodule" in err          # warned, not failed
     assert "StealCounterPins" in err      # stats displayed at fini
+
+
+def test_properties_dictionary_runtime_and_taskpool():
+    """Properties dictionary (reference: parsec/dictionary.c): a
+    runtime-queryable hierarchical key space — live device counters and
+    taskpool class properties readable by path, the aggregator-GUI
+    pattern."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    rng = np.random.default_rng(0)
+    n = 32
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = a @ a.T + n * np.eye(n, dtype=np.float32)
+    A = TwoDimBlockCyclic(mb=8, nb=8, lm=n, ln=n).from_array(spd.copy())
+    with Context(nb_cores=2) as ctx:
+        ps = ctx.properties
+        assert ps.lookup("runtime/nranks") == 1
+        assert ps.lookup("runtime/scheduler")
+        dev_paths = [p for p in ps.paths("runtime/devices")
+                     if p.endswith("/executed_tasks")]
+        assert dev_paths, "no device counters registered"
+        before = sum(ps.lookup(p) for p in dev_paths)
+        ctx.add_taskpool(potrf_taskpool(A, device="tpu"))
+        # taskpool namespace appears on enqueue, with class properties
+        flops = ps.lookup("taskpool/potrf/classes/GEMM/flops")
+        assert flops == 2.0 * 8 ** 3
+        assert ps.lookup("taskpool/potrf/nb_tasks") is not None
+        ctx.wait(timeout=120)
+        after = sum(ps.lookup(p) for p in dev_paths)
+        assert after > before, "live counters did not advance"
+        tree = ps.tree("taskpool/potrf/classes")
+        assert any(p.endswith("POTRF/flops") for p in tree)
+
+
+def test_iterators_checker_clean_run():
+    """PINS iterators_checker (reference: mca/pins/iterators_checker):
+    re-derived successor sets match the engine's deliveries on a real
+    DAG; installed through MCA selection like any pins module."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    from parsec_tpu.prof.pins import IteratorsCheckerPins
+    rng = np.random.default_rng(1)
+    n = 32
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = a @ a.T + n * np.eye(n, dtype=np.float32)
+    A = TwoDimBlockCyclic(mb=8, nb=8, lm=n, ln=n).from_array(spd.copy())
+    chk = IteratorsCheckerPins()
+    with Context(nb_cores=2) as ctx:
+        chk.install(ctx)
+        ctx.add_taskpool(potrf_taskpool(A, device="cpu"))
+        ctx.wait(timeout=120)
+        chk.uninstall(ctx)
+    assert chk.checked > 0 and chk.flagged == 0, chk.display()
+
+
+def test_iterators_checker_catches_lost_delivery(monkeypatch):
+    """Negative: a seeded mis-delivery (one successor silently dropped —
+    the class of dep-engine bug the checker exists for) is flagged."""
+    import parsec_tpu.core.engine as eng
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.prof.pins import IteratorsCheckerPins
+
+    V = VectorTwoDimCyclic(mb=2, lm=8)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0.0
+    orig = eng.deliver_dep
+    dropped = {"n": 0}
+
+    def lossy(tp, succ_tc, succ_locals, dflow, copy, src):
+        if succ_locals.get("k") == 2 and not dropped["n"]:
+            dropped["n"] += 1
+            return None          # lose exactly one delivery
+        return orig(tp, succ_tc, succ_locals, dflow, copy, src)
+    # the deliver PINS event must still fire per actual delivery, so
+    # patch the engine's delivery fn (the checker observes the event
+    # BEFORE delivery; losing the delivery leaves the successor starved
+    # but the checker flags the stall's cause at producer completion)
+    chk = IteratorsCheckerPins()
+    p = PTG("chain", NT=4)
+    p.task("T", k=Range(0, 3)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("X", "RW",
+              IN(DATA(lambda k, V=V: V(k)), when=lambda k: k == 0),
+              IN(TASK("T", "X", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("T", "X", lambda k: dict(k=k + 1)),
+                  when=lambda k: k < 3),
+              OUT(DATA(lambda k, V=V: V(k)), when=lambda k: k == 3)) \
+        .body(lambda X: X + 1.0)
+
+    # patch the PINS hook instead: drop the checker's record of one
+    # delivery, simulating an iterate_successors/delivery divergence
+    real_deliver = chk._deliver
+
+    def lossy_record(es, event, payload):
+        _task, _tc, succ_locals, _fl = payload
+        if succ_locals.get("k") == 2 and not dropped["n"]:
+            dropped["n"] += 1
+            return               # the checker never sees this delivery
+        real_deliver(es, event, payload)
+    chk._deliver = lossy_record
+
+    with Context(nb_cores=2) as ctx:
+        ctx.pins_register("deliver_dep", chk._deliver)
+        ctx.pins_register("complete_exec", chk._complete)
+        ctx.add_taskpool(p.build())
+        with pytest.raises(RuntimeError) as exc:
+            ctx.wait(timeout=60)
+        assert "iterators_checker" in str(exc.value.__cause__)
+    assert dropped["n"] == 1 and chk.flagged >= 1

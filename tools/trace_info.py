@@ -24,6 +24,9 @@ def main(argv=None) -> int:
                     help="dump every event row")
     ap.add_argument("--stats", action="store_true",
                     help="per-class interval timing statistics")
+    ap.add_argument("--gaps", action="store_true",
+                    help="per-stream occupancy: busy/span/utilization and "
+                         "idle-gap statistics (dbpinfos' occupancy view)")
     args = ap.parse_args(argv)
 
     from parsec_tpu.prof.reader import intervals, read_trace
@@ -50,6 +53,25 @@ def main(argv=None) -> int:
             print("per-class interval stats (seconds):")
             print(g.agg(["count", "sum", "mean", "min", "max"])
                   .to_string(float_format=lambda v: f"{v:.6f}"))
+    if args.gaps and len(df):
+        iv = intervals(df)
+        if len(iv):
+            print("per-stream occupancy:")
+            for sid, rows in iv.groupby("stream"):
+                spans = sorted(zip(rows["ts_begin"], rows["ts_end"]))
+                span = max(e for _b, e in spans) - spans[0][0]
+                busy = sum(e - b for b, e in spans)
+                gaps, largest, cursor = 0.0, 0.0, spans[0][0]
+                for b, e in spans:
+                    if b > cursor:
+                        gaps += b - cursor
+                        largest = max(largest, b - cursor)
+                    cursor = max(cursor, e)
+                util = busy / span if span > 0 else 1.0
+                print(f"  stream {sid}: {len(spans)} intervals, "
+                      f"busy {busy:.6f}s / span {span:.6f}s "
+                      f"(util {util:.1%}), idle {gaps:.6f}s "
+                      f"(largest gap {largest:.6f}s)")
     return 0
 
 
