@@ -36,7 +36,7 @@ _PEAKS = {
 
 
 def prestage(M, ctx, spd_diag: bool = False, keep=None,
-             bump_all: float = 0.0) -> None:
+             bump_all: float = 0.0, rand_scale: float = 0.0) -> None:
     """Materialize every local tile directly in device HBM with a
     device-side generator (iota pattern, distinct buffer per tile) and
     attach the copies as coherent duplicates of the host tiles.
@@ -57,13 +57,20 @@ def prestage(M, ctx, spd_diag: bool = False, keep=None,
     @jax.jit
     def gen(seed, diag):
         shape = (M.mb, M.nb)
-        x = jax.lax.broadcasted_iota(jnp.float32, shape, 1)
-        # row-constant iota tiles are rank 1 — fine for GEMM throughput,
-        # fatal for factorizations (a Cholesky-QR Gram matrix goes
-        # singular); ``bump_all`` adds a scaled identity to EVERY tile so
-        # per-tile rank is full, ``spd_diag`` makes diagonal tiles
-        # dominant so Cholesky stays well-posed
-        out = (x * 1e-5 + seed * 1e-3) % 1.0
+        # iota tiles are cheap but GLOBALLY low-rank (columns are affine
+        # in the column index + per-tile constants) — fine for GEMM
+        # throughput, fatal for factorizations whose later panels then
+        # hit singular Schur complements.  ``rand_scale`` switches to
+        # device-side Gaussian tiles; ``bump_all`` adds identity to every
+        # tile (keeps stacked-panel Gram matrices well-conditioned for
+        # Cholesky-QR); ``spd_diag`` makes diagonal tiles dominant so
+        # Cholesky stays well-posed.
+        if rand_scale > 0.0:
+            key = jax.random.PRNGKey(jnp.asarray(seed, jnp.int32))
+            out = rand_scale * jax.random.normal(key, shape, jnp.float32)
+        else:
+            x = jax.lax.broadcasted_iota(jnp.float32, shape, 1)
+            out = (x * 1e-5 + seed * 1e-3) % 1.0
         out = out + diag * jnp.eye(M.mb, M.nb, dtype=jnp.float32)
         return out.astype(M.dtype) if np.dtype(M.dtype) != np.float32 \
             else out
@@ -77,6 +84,23 @@ def prestage(M, ctx, spd_diag: bool = False, keep=None,
         # the generated device value becomes the newest authoritative
         # copy (the write transition lives in Data, not here)
         datum.overwrite_on(dev.space, arr)
+
+
+def _discard_device_tiles(*Ms) -> None:
+    """Invalidate device-resident authoritative copies WITHOUT writeback:
+    bench data is synthetic, and the context-exit flush would otherwise
+    D2H the whole matrix through the tunnel (minutes of pure teardown).
+    """
+    from parsec_tpu.data.data import Coherency
+    for M in Ms:
+        for t in M.local_tiles():
+            d = M.data_of(*t) if isinstance(t, tuple) else M.data_of(t)
+            with d._lock:
+                for sp, c in list(d.copies().items()):
+                    if sp != 0 and c.payload is not None:
+                        d.detach_copy(sp)
+                        c.payload = None
+                        c.coherency = Coherency.INVALID
 
 
 _CSUM = {}
@@ -245,6 +269,7 @@ def run_gemm_bench(mb: int, mt: int, nt: int, kt: int, reps: int = 3,
         for d in ctx.device_registry.accelerators:
             if d.stats.executed_tasks:
                 log(f"{d.name}: {d.stats.as_dict()}")
+        _discard_device_tiles(A, B, C)
     return best
 
 
@@ -324,6 +349,7 @@ def run_potrf_bench(mb: int, nt: int, reps: int = 3,
         for d in ctx.device_registry.accelerators:
             if d.stats.executed_tasks:
                 log(f"{d.name}: {d.stats.as_dict()}")
+        _discard_device_tiles(A)
     return best
 
 
@@ -465,9 +491,10 @@ def run_geqrf_bench(mb: int, nt: int, reps: int = 3,
 
         def reset():
             if on_acc:
-                # full-rank tiles: the Cholesky-QR TSQRT needs a
-                # nonsingular Gram matrix per stacked panel
-                prestage(A, ctx, bump_all=1.0)
+                # Gaussian tiles + identity bump: the GLOBAL matrix must
+                # be full-rank (iota tiles are not) and stacked-panel
+                # Gram matrices well-conditioned for Cholesky-QR
+                prestage(A, ctx, bump_all=1.0, rand_scale=0.05)
             else:
                 rng = np.random.default_rng(7)
                 for m, nn in A.local_tiles():
@@ -507,6 +534,7 @@ def run_geqrf_bench(mb: int, nt: int, reps: int = 3,
         for d in ctx.device_registry.accelerators:
             if d.stats.executed_tasks:
                 log(f"{d.name}: {d.stats.as_dict()}")
+        _discard_device_tiles(A)
     return best
 
 
@@ -528,11 +556,18 @@ def main():
         }))
         return
     if app == "geqrf":
+        # QR keeps the FULL tile grid resident plus 2mb x mb WY edge
+        # payloads: nt=6 at mb=6144 is ~5.4GB of tiles + edges, leaving
+        # room for fused-launch transients on a 16GB v5e
         mb = int(os.environ.get("PARSEC_BENCH_MB", 6144 if on_tpu else 16))
-        nt = int(os.environ.get("PARSEC_BENCH_NT", 8 if on_tpu else 3))
+        nt = int(os.environ.get("PARSEC_BENCH_NT", 6 if on_tpu else 3))
         from parsec_tpu.utils.mca import params as _params
         _params.set("device_fuse",
-                    int(os.environ.get("PARSEC_BENCH_FUSE", 16)))
+                    int(os.environ.get("PARSEC_BENCH_FUSE", 8)))
+        _params.set("device_runahead",
+                    int(os.environ.get("PARSEC_BENCH_RUNAHEAD", 48)))
+        _params.set("device_inflight_depth",
+                    int(os.environ.get("PARSEC_BENCH_DEPTH", 32)))
         peak = _PEAKS.get(platform, 100.0)
         value = run_geqrf_bench(
             mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 3)),

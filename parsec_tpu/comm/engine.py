@@ -54,7 +54,13 @@ TAG_GET1 = 10     # one-sided get request
 TAG_GET1_REP = 11
 TAG_USER = 16     # first tag available to applications
 
-_LEN = struct.Struct("!IQ")   # (tag, payload length)
+#: frame header: (tag, pickle length, out-of-band buffer count).  Large
+#: array payloads ride OUT OF BAND (pickle protocol 5): the pickle holds
+#: only metadata while each buffer is scatter-gathered onto the socket
+#: unserialized and received straight into its own bytearray — the
+#: dataflow-bandwidth path does no full-payload serialization copy
+_LEN = struct.Struct("!IQI")
+_BUFLEN = struct.Struct("!Q")
 
 #: wire-format guard (VERDICT r2: a malformed or cross-version frame
 #: must fail its CONNECTION with a cause, not corrupt the recv thread):
@@ -62,7 +68,7 @@ _LEN = struct.Struct("!IQ")   # (tag, payload length)
 #: undecodable ones sever the peer
 _HANDSHAKE = struct.Struct("!4sII")   # (magic, proto version, rank)
 _WIRE_MAGIC = b"PTCE"
-_WIRE_VERSION = 1
+_WIRE_VERSION = 2   # v2: protocol-5 out-of-band buffer frames
 
 params.register("comm_max_frame_mb", 4096,
                 "largest acceptable frame payload in MiB; a length field "
@@ -157,16 +163,28 @@ class CommEngine:
     # -- pack/unpack (reference: ce.pack/unpack) ------------------------
     @staticmethod
     def pack(arr) -> dict:
-        """Serialize an array payload for the wire."""
+        """Snapshot an array payload for the wire.  ONE owned copy here
+        — the snapshot contract: the source tile may be mutated in place
+        by later tasks before the comm thread serializes the frame, so
+        the payload must be frozen at encode time.  The copy stays an
+        ndarray and ships OUT OF BAND (pickle protocol 5 + gather-send),
+        so this is the only copy on the send path (tobytes + in-band
+        pickling + the join used to make three)."""
         import numpy as np
-        a = np.asarray(arr)
-        return {"buf": a.tobytes(), "dtype": wire_dtype(a.dtype),
+        a = np.array(np.asarray(arr), order="C", copy=True)
+        return {"buf": a, "dtype": wire_dtype(a.dtype),
                 "shape": a.shape}
 
     @staticmethod
     def unpack(msg: dict):
         import numpy as np
-        return np.frombuffer(msg["buf"], dtype=parse_dtype(msg["dtype"])) \
+        buf = msg["buf"]
+        if isinstance(buf, np.ndarray):
+            # out-of-band delivery: the array already views the freshly
+            # received (private, writable) buffer — no copy needed
+            return np.asarray(buf, dtype=parse_dtype(msg["dtype"])) \
+                .reshape(msg["shape"])
+        return np.frombuffer(buf, dtype=parse_dtype(msg["dtype"])) \
             .reshape(msg["shape"]).copy()
 
     # -- registered memory + one-sided put/get (reference: ce.mem_register
@@ -442,6 +460,23 @@ class SocketCE(CommEngine):
             buf += chunk
         return buf
 
+    @staticmethod
+    def _recv_into(conn: socket.socket, n: int) -> Optional[bytearray]:
+        """Receive ``n`` bytes straight into one buffer (no quadratic
+        bytes-concatenation; the out-of-band payload path)."""
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            try:
+                r = conn.recv_into(view[got:], n - got)
+            except OSError:
+                return None
+            if r == 0:
+                return None
+            got += r
+        return buf
+
     def _recv_loop(self, conn: socket.socket, src: int) -> None:
         max_ln = int(params.get("comm_max_frame_mb", 4096)) << 20
         while not self._stop:
@@ -449,22 +484,42 @@ class SocketCE(CommEngine):
             if hdr is None:
                 self._peer_lost(src)
                 return
-            tag, ln = _LEN.unpack(hdr)
-            if ln > max_ln:
+            tag, ln, nbufs = _LEN.unpack(hdr)
+            if ln > max_ln or nbufs > 4096:
                 # corrupt stream (or hostile length): sever THIS
                 # connection with a cause instead of trying to consume
                 # an absurd frame — the guard VERDICT r2 asked for
                 self._peer_corrupt(src, conn,
-                                   f"frame length {ln} exceeds the "
-                                   f"{max_ln >> 20} MiB bound (tag={tag})")
+                                   f"frame length {ln}/{nbufs} bufs "
+                                   f"exceeds the {max_ln >> 20} MiB "
+                                   f"bound (tag={tag})")
                 return
             data = self._recv_exact(conn, ln) if ln else b""
             if data is None:
                 self._peer_lost(src)
                 return
+            oob: List[bytearray] = []
+            corrupt = None
+            for _ in range(nbufs):
+                bhdr = self._recv_exact(conn, _BUFLEN.size)
+                if bhdr is None:
+                    self._peer_lost(src)
+                    return
+                (bln,) = _BUFLEN.unpack(bhdr)
+                if bln > max_ln:
+                    corrupt = f"oob buffer length {bln} (tag={tag})"
+                    break
+                buf = self._recv_into(conn, bln)
+                if buf is None:
+                    self._peer_lost(src)
+                    return
+                oob.append(buf)
+            if corrupt is not None:
+                self._peer_corrupt(src, conn, corrupt)
+                return
             self.recv_msgs += 1
             try:
-                payload = pickle.loads(data) if data else None
+                payload = pickle.loads(data, buffers=oob) if data else None
             except Exception as exc:
                 # undecodable frame = wire corruption: fail the
                 # connection, not the handler path
@@ -517,12 +572,43 @@ class SocketCE(CommEngine):
             self.recv_msgs += 1
             self._dispatch(tag, self.rank, payload)
             return
-        data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL) \
-            if payload is not None else b""
+        bufs: List[Any] = []
+        raws: List[Any] = []
+        if payload is not None:
+            data = pickle.dumps(payload, protocol=5,
+                                buffer_callback=bufs.append)
+            try:
+                raws = [pb.raw() for pb in bufs]
+            except BufferError:
+                # a non-contiguous exporter: fall back to in-band
+                data = pickle.dumps(payload, protocol=5)
+                raws = []
+        else:
+            data = b""
+        parts: List[Any] = [_LEN.pack(tag, len(data), len(raws)), data]
+        for raw in raws:
+            parts.append(_BUFLEN.pack(raw.nbytes))
+            parts.append(raw)
         s = self._connect(dst)
         with self._send_locks[dst]:
             self.sent_msgs += 1
-            s.sendall(_LEN.pack(tag, len(data)) + data)
+            self._sendmsg_all(s, parts)
+
+    @staticmethod
+    def _sendmsg_all(s: socket.socket, parts: List[Any]) -> None:
+        """Gather-send every part (scatter-gather keeps large array
+        buffers out of any join copy); loops on partial sends."""
+        views = [memoryview(p) for p in parts if len(p)]
+        while views:
+            sent = s.sendmsg(views)
+            while sent and views:
+                head = views[0]
+                if sent >= head.nbytes:
+                    sent -= head.nbytes
+                    views.pop(0)
+                else:
+                    views[0] = head[sent:]
+                    sent = 0
 
     # -- collective: flat barrier, generation-numbered (gather-to-0 +
     # release; reference: ce.sync) -----------------------------------------

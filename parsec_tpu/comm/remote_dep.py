@@ -310,7 +310,7 @@ class RemoteDepEngine:
                         not isinstance(payload, np.ndarray):
                     payload = np.asarray(payload)   # pull device data home
                 buf, dt, shape = _encode(payload)
-                if len(buf) <= self.eager:
+                if getattr(buf, "nbytes", len(buf)) <= self.eager:
                     msg["data"] = ("eager", buf, dt, shape)
                 else:
                     h = next(_handle_seq)

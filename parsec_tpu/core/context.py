@@ -121,7 +121,7 @@ class Context:
         # VP map: streams -> virtual processes (+ optional core binding)
         # (reference: vpmap_init_* + thread binding, parsec.c:543-583,:861)
         from parsec_tpu.core.vpmap import VPMap
-        self.vpmap = VPMap.from_mca(self.nb_cores)
+        self.vpmap = VPMap.from_mca(self.nb_cores, rank=self.rank)
         self.streams = [ExecutionStream(self, i,
                                         vp_id=self.vpmap.vp_of(i))
                         for i in range(self.nb_cores)]

@@ -23,7 +23,7 @@ from parsec_tpu.utils.mca import params
 from parsec_tpu.utils.output import debug_verbose, warning
 
 params.register("vpmap", "flat",
-                "virtual-process map: flat | <nvp>:<threads_per_vp> | hw")
+                "virtual-process map: flat | <nvp>:<threads_per_vp> | hw | file:<path>")
 params.register("runtime_bind_threads", 0,
                 "bind worker threads to cores round-robin (Linux only)")
 
@@ -69,10 +69,73 @@ class VPMap:
                    [i % ncores for i in range(nb_threads)])
 
     @classmethod
-    def from_mca(cls, nb_threads: int) -> "VPMap":
+    def from_file(cls, path: str, nb_threads: int,
+                  rank: int = 0) -> "VPMap":
+        """Reference vpmap file format (reference: vpmap_init_from_file,
+        parsec/vpmap.c:219): one VP per line, ``rank:nbthreads:binding``
+        — a leading ':' (no rank) applies to every rank; ``binding`` is
+        a comma list of cores with ``a-b`` ranges.  Lines for other
+        ranks are skipped.  If the file describes a different thread
+        count than ``nb_threads``, the map is clipped/extended
+        round-robin with a warning (the reference spawns exactly the
+        file's threads; here the context owns the stream count)."""
+        vp_of: List[int] = []
+        core_of: List[Optional[int]] = []
+        try:
+            with open(path) as fh:
+                lines = fh.readlines()
+        except OSError as exc:
+            warning("vpmap file %s: %s; falling back to flat", path, exc)
+            return cls.from_flat(nb_threads)
+        vp = 0
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#") or ":" not in line:
+                if line and not line.startswith("#"):
+                    warning("vpmap %s: malformed line %r", path, line)
+                continue
+            rank_s, _, rest = line.partition(":")
+            if rank_s.strip() and int(rank_s) != rank:
+                continue
+            nbth_s, _, binding = rest.partition(":")
+            try:
+                nbth = max(1, int(nbth_s))
+            except ValueError:
+                warning("vpmap %s: malformed line %r", path, line)
+                continue
+            cores: List[Optional[int]] = []
+            for tok in binding.split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                if "-" in tok:
+                    lo, _, hi = tok.partition("-")
+                    cores.extend(range(int(lo), int(hi) + 1))
+                else:
+                    cores.append(int(tok))
+            for t in range(nbth):
+                vp_of.append(vp)
+                core_of.append(cores[t % len(cores)] if cores else None)
+            vp += 1
+        if not vp_of:
+            warning("vpmap %s: no VP lines for rank %d; flat map", path,
+                    rank)
+            return cls.from_flat(nb_threads)
+        if len(vp_of) != nb_threads:
+            warning("vpmap %s describes %d threads, context runs %d; "
+                    "mapping round-robin", path, len(vp_of), nb_threads)
+            vp_of = [vp_of[i % len(vp_of)] for i in range(nb_threads)]
+            core_of = [core_of[i % len(core_of)]
+                       for i in range(nb_threads)]
+        return cls(nb_threads, vp_of, core_of)
+
+    @classmethod
+    def from_mca(cls, nb_threads: int, rank: int = 0) -> "VPMap":
         spec = str(params.get("vpmap", "flat"))
         if spec == "hw":
             return cls.from_hardware(nb_threads)
+        if spec.startswith("file:"):
+            return cls.from_file(spec[5:], nb_threads, rank)
         if ":" in spec:
             return cls.from_parameters(spec, nb_threads)
         return cls.from_flat(nb_threads)

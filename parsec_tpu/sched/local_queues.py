@@ -248,8 +248,15 @@ class LocalHierQueues(_PerStream):
     def install(self, context):
         super().install(context)
         self._groups = {}   # group id -> shared mid-level HBBuffer
+        self._vpmap = getattr(context, "vpmap", None)
 
     def _gid(self, th_id: int) -> int:
+        # topology-aware when the context's vpmap carries real structure
+        # (hardware split or a vpmap FILE, reference: the hwloc-level
+        # hbbuffer chains of sched_lhq_module.c:30-44); otherwise the
+        # synthetic fixed-size grouping
+        if self._vpmap is not None and self._vpmap.nb_vps > 1:
+            return self._vpmap.vp_of(th_id)
         return th_id // max(1, int(params.get("sched_lhq_group_size", 2)))
 
     def _group(self, th_id: int) -> HBBuffer:

@@ -612,14 +612,14 @@ def _wire_guard_victim(outq, port_base):
     good = dial(1)
     import pickle
     body = pickle.dumps("hello")
-    good.sendall(_LEN.pack(TAG_USER, len(body)) + body)
+    good.sendall(_LEN.pack(TAG_USER, len(body), 0) + body)
     # 3) ...peer 2 handshakes fine, then sends an absurd length field
     evil = dial(2)
-    evil.sendall(_LEN.pack(TAG_USER, 1 << 40))
+    evil.sendall(_LEN.pack(TAG_USER, 1 << 40, 0))
     time.sleep(0.5)
     # 4) and peer 1 can STILL talk (its recv loop was untouched)
     body2 = pickle.dumps("again")
-    good.sendall(_LEN.pack(TAG_USER, len(body2)) + body2)
+    good.sendall(_LEN.pack(TAG_USER, len(body2), 0) + body2)
     deadline = time.monotonic() + 10
     while len(got) < 2 and time.monotonic() < deadline:
         time.sleep(0.05)
@@ -633,9 +633,9 @@ def _wire_guard_victim(outq, port_base):
     # only its sender, and the surviving peer still delivers afterwards
     evil2 = dial(3)
     garbage = b"\x00\xde\xad\xbe\xef not a pickle"
-    evil2.sendall(_LEN.pack(TAG_USER, len(garbage)) + garbage)
+    evil2.sendall(_LEN.pack(TAG_USER, len(garbage), 0) + garbage)
     body3 = pickle.dumps("still-here")
-    good.sendall(_LEN.pack(TAG_USER, len(body3)) + body3)
+    good.sendall(_LEN.pack(TAG_USER, len(body3), 0) + body3)
     deadline = time.monotonic() + 10
     while (len(got) < 3 or 3 not in ce.dead_peers) \
             and time.monotonic() < deadline:

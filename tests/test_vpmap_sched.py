@@ -105,3 +105,65 @@ def test_worker_binding_smoke():
     finally:
         params.unset("runtime_bind_threads")
         params.unset("vpmap")
+
+
+def test_vpmap_from_file(tmp_path):
+    """Reference vpmap file format (vpmap_init_from_file, vpmap.c:219):
+    one VP per line 'rank:nbthreads:binding', rank-less lines apply to
+    all ranks, bindings take comma lists and a-b ranges."""
+    from parsec_tpu.core.vpmap import VPMap
+    f = tmp_path / "vps.map"
+    f.write_text(
+        "# comment\n"
+        ":2:0-1\n"          # every rank: VP of 2 threads on cores 0,1
+        "0:1:3\n"           # rank 0 only: VP of 1 thread on core 3
+        "1:4:4,5\n"         # rank 1 only: skipped on rank 0
+    )
+    m = VPMap.from_file(str(f), 3, rank=0)
+    assert m.nb_vps == 2
+    assert [m.vp_of(i) for i in range(3)] == [0, 0, 1]
+    assert [m.core_of(i) for i in range(3)] == [0, 1, 3]
+    # rank 1 sees its own line plus the rank-less VP
+    m1 = VPMap.from_file(str(f), 6, rank=1)
+    assert m1.nb_vps == 2
+    assert [m1.core_of(i) for i in range(6)] == [0, 1, 4, 5, 4, 5]
+    # thread-count mismatch maps round-robin rather than failing
+    m2 = VPMap.from_file(str(f), 5, rank=0)
+    assert m2.nb_threads == 5
+    # missing file falls back to flat
+    m3 = VPMap.from_file(str(tmp_path / "nope.map"), 4)
+    assert m3.nb_vps == 1
+
+
+def test_vpmap_file_mca_selection(tmp_path):
+    from parsec_tpu.core.vpmap import VPMap
+    from parsec_tpu.utils.mca import params
+    f = tmp_path / "v.map"
+    f.write_text(":2:\n:2:\n")
+    params.set("vpmap", f"file:{f}")
+    try:
+        m = VPMap.from_mca(4)
+        assert m.nb_vps == 2
+        assert m.threads_of_vp(0) == [0, 1]
+    finally:
+        params.unset("vpmap")
+
+
+def test_lhq_groups_follow_vpmap_topology():
+    """lhq's mid-level hierarchy follows the vpmap's VP structure when
+    one exists (reference: per-hwloc-level hbbuffer chains,
+    sched_lhq_module.c:30-44) instead of the synthetic stream-id pairs."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.utils.mca import params
+    params.set("vpmap", "2:2")
+    params.set("sched", "lhq")
+    try:
+        with Context(nb_cores=4) as ctx:
+            sched = ctx.scheduler
+            assert ctx.vpmap.nb_vps == 2
+            gids = [sched._gid(t) for t in range(4)]
+            assert gids == [ctx.vpmap.vp_of(t) for t in range(4)]
+            assert gids == [0, 0, 1, 1]
+    finally:
+        params.unset("vpmap")
+        params.unset("sched")
