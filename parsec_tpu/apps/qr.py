@@ -76,12 +76,11 @@ def _mk_unmqr():
     return fn
 
 
-def _tsqrt_wy(R, B, xp, chol, ti):
-    """Shared TSQRT math (jax and numpy incarnations): returns
-    (R', V, T^T) of the compact-WY Cholesky-QR above."""
+def _wy_from_L(R, B, L, xp, ti):
+    """Closed-form compact-WY pair from ANY lower-triangular L with
+    L L^T = R^T R + B^T B (Cholesky of the Gram matrix, however it was
+    obtained): returns (R', V, T^T)."""
     mb = R.shape[0]
-    G = R.T @ R + B.T @ B
-    L = chol(G)
     # Householder sign choice: R'_jj = -sign(R_jj) * |R'_jj| makes
     # S = R - R' diagonally safe (|S_jj| >= |R'_jj|)
     d = xp.where(xp.diagonal(R) >= 0, -1.0, 1.0).astype(R.dtype)
@@ -96,10 +95,35 @@ def _tsqrt_wy(R, B, xp, chol, ti):
     return Rp, V, Tt
 
 
+def _tsqrt_wy(R, B, xp, chol, ti):
+    """Shared TSQRT math (jax and numpy incarnations): returns
+    (R', V, T^T) of the compact-WY Cholesky-QR above."""
+    G = R.T @ R + B.T @ B
+    return _wy_from_L(R, B, chol(G), xp, ti)
+
+
 def _mk_tsqrt():
     def fn(T, B, Q):
         import jax.numpy as jnp
-        Rp, V, Tt = _tsqrt_wy(T, B, jnp, jnp.linalg.cholesky, tri_inv)
+        from jax import lax
+        # Fast path: Cholesky of the Gram matrix (pure matmul + chol,
+        # rides the MXU).  Cholesky-QR squares cond(panel), so chol(G)
+        # yields NaNs for ill-conditioned stacked panels; guard with a
+        # Householder QR of the stacked panel (LAPACK-class stability,
+        # reference TSQRT's algorithm: dplasma CORE_dtsqrt) that
+        # produces the SAME triangular factor, then rebuild the
+        # identical closed-form WY pair from it.
+        G = T.T @ T + B.T @ B
+        L = jnp.linalg.cholesky(G)
+
+        def stable_L(_):
+            Rh = jnp.linalg.qr(jnp.concatenate([T, B], axis=0), mode="r")
+            s = jnp.where(jnp.diagonal(Rh) >= 0, 1.0, -1.0).astype(T.dtype)
+            return (s[:, None] * Rh).T   # positive-diag lower factor
+
+        L = lax.cond(jnp.all(jnp.isfinite(L)), lambda _: L, stable_L,
+                     operand=None)
+        Rp, V, Tt = _wy_from_L(T, B, L, jnp, tri_inv)
         return {"T": Rp, "B": jnp.zeros_like(B),
                 "Q": jnp.concatenate([V, Tt], axis=0)}
     return fn
@@ -225,8 +249,17 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
         # stability (Cholesky-QR squares the condition number)
         R64 = np.asarray(T, dtype=np.float64)
         B64 = np.asarray(B, dtype=np.float64)
-        Rp, V, Tt = _tsqrt_wy(R64, B64, np, np.linalg.cholesky,
-                              _np_tri_inv)
+        try:
+            Rp, V, Tt = _tsqrt_wy(R64, B64, np, np.linalg.cholesky,
+                                  _np_tri_inv)
+        except np.linalg.LinAlgError:
+            # non-PD Gram matrix: Householder QR of the stacked panel
+            # gives the same triangular factor, unconditionally stably
+            Rh = np.linalg.qr(np.concatenate([R64, B64], axis=0),
+                              mode="r")
+            s = np.where(np.diagonal(Rh) >= 0, 1.0, -1.0)
+            Rp, V, Tt = _wy_from_L(R64, B64, (s[:, None] * Rh).T, np,
+                                   _np_tri_inv)
         dt = np.asarray(T).dtype
         return {"T": Rp.astype(dt), "B": np.zeros_like(np.asarray(B)),
                 "Q": np.concatenate([V, Tt], axis=0).astype(dt)}

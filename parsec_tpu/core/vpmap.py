@@ -95,7 +95,11 @@ class VPMap:
                     warning("vpmap %s: malformed line %r", path, line)
                 continue
             rank_s, _, rest = line.partition(":")
-            if rank_s.strip() and int(rank_s) != rank:
+            try:
+                if rank_s.strip() and int(rank_s) != rank:
+                    continue
+            except ValueError:
+                warning("vpmap %s: malformed line %r", path, line)
                 continue
             nbth_s, _, binding = rest.partition(":")
             try:
@@ -104,15 +108,19 @@ class VPMap:
                 warning("vpmap %s: malformed line %r", path, line)
                 continue
             cores: List[Optional[int]] = []
-            for tok in binding.split(","):
-                tok = tok.strip()
-                if not tok:
-                    continue
-                if "-" in tok:
-                    lo, _, hi = tok.partition("-")
-                    cores.extend(range(int(lo), int(hi) + 1))
-                else:
-                    cores.append(int(tok))
+            try:
+                for tok in binding.split(","):
+                    tok = tok.strip()
+                    if not tok:
+                        continue
+                    if "-" in tok:
+                        lo, _, hi = tok.partition("-")
+                        cores.extend(range(int(lo), int(hi) + 1))
+                    else:
+                        cores.append(int(tok))
+            except ValueError:
+                warning("vpmap %s: malformed binding %r", path, line)
+                cores = []
             for t in range(nbth):
                 vp_of.append(vp)
                 core_of.append(cores[t % len(cores)] if cores else None)

@@ -67,12 +67,18 @@ class Arena:
     def release_copy(self, copy: DataCopy) -> None:
         if copy.arena is not self:
             raise ValueError("copy does not belong to this arena")
-        if copy.payload is None:
+        # Swap payload->None under _refs_lock so racing releasers (repo
+        # retirement vs device completer, both legitimately observing
+        # refs==0) cannot both see a non-None payload and double-free the
+        # buffer onto the freelist.
+        with Arena._refs_lock:
+            buf, copy.payload = copy.payload, None
+            if buf is not None:
+                copy.coherency = Coherency.INVALID
+        if buf is None:
             return    # already released (idempotent: multiple lifetime
                       # managers may race to the same conclusion)
-        self.release_buffer(copy.payload)
-        copy.payload = None
-        copy.coherency = Coherency.INVALID
+        self.release_buffer(buf)
 
     # -- repo-entry holds (reference: refcounted repo copies,
     # datarepo.h:50-58 — a NEW-flow buffer chained through several tasks

@@ -473,7 +473,12 @@ class XlaDevice(Device):
             raise
         self.stats.executed_tasks += n
         with self._cond:
-            while len(self._inflight) >= self._depth and not self._stop:
+            # gate on the WHOLE wave fitting under the inflight depth:
+            # appending n entries after a <depth check would let the
+            # window exceed device_inflight_depth by fuse-width-1 and
+            # under-account HBM backpressure (ADVICE r3 low)
+            room = max(self._depth - n, 0)   # n>depth: drain fully first
+            while len(self._inflight) > room and not self._stop:
                 self._cond.wait(0.1)
             for i, (task, _spec, load) in enumerate(batch):
                 self._inflight.append(
