@@ -1,0 +1,92 @@
+"""Numerics accounting for the Cholesky drivers (apps/potrf_check.py):
+backward error of the factored tile grid and HPL-AI-style iterative
+refinement recovering f32-class solve accuracy from a bf16 factor
+(VERDICT r3 #3), plus the TSQRT ill-conditioning guard (ADVICE r3)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+
+def _factor(n, mb, dtype, seed=0):
+    """Run the real potrf taskpool over an SPD matrix stored in
+    ``dtype`` tiles; returns (A tiled-matrix, orig_tile regen fn)."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    rng = np.random.default_rng(seed)
+    B = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (B @ B.T + n * np.eye(n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, dtype=dtype)
+    stored = {}
+    for m, nn in A.local_tiles():
+        blk = spd[m * mb:(m + 1) * mb,
+                  nn * mb:(nn + 1) * mb].astype(dtype)
+        stored[(m, nn)] = blk.copy()
+        A.data_of(m, nn).overwrite_host(blk)
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(potrf_taskpool(A, device="cpu"))
+        ctx.wait()
+    return A, lambda m, nn: stored[(m, nn)]
+
+
+def test_backward_error_f32_tight():
+    from parsec_tpu.apps.potrf_check import backward_error
+    A, orig = _factor(64, 16, np.float32)
+    err = backward_error(A, orig)
+    assert err < 1e-5, err
+
+
+def test_backward_error_bf16_at_storage_epsilon():
+    from ml_dtypes import bfloat16
+    from parsec_tpu.apps.potrf_check import backward_error
+    A, orig = _factor(64, 16, bfloat16)
+    err = backward_error(A, orig)
+    # bf16 storage: error sits at bf16 epsilon (~8e-3), far above f32
+    assert 1e-5 < err < 5e-2, err
+
+
+def test_refinement_recovers_f32_accuracy_from_bf16_factor():
+    """The HPL-AI contract: a bf16-storage factor + f32 residual
+    iteration reaches f32-class solve accuracy in a few steps."""
+    from ml_dtypes import bfloat16
+    from parsec_tpu.apps.potrf_check import refine_solve
+    A, orig = _factor(64, 16, bfloat16)
+    hist = refine_solve(A, orig, steps=3, seed=1)
+    assert hist[0] > 1e-6          # the raw bf16 solve is NOT f32-class
+    assert hist[-1] < 1e-5         # refinement gets there
+    assert hist[-1] < hist[0]
+
+
+def test_refinement_baseline_f32_factor():
+    from parsec_tpu.apps.potrf_check import refine_solve
+    A, orig = _factor(64, 16, np.float32)
+    hist = refine_solve(A, orig, steps=1, seed=1)
+    assert hist[0] < 1e-5
+
+
+def test_tsqrt_ill_conditioned_panel_no_nan():
+    """ADVICE r3: chol(G) NaNs on an ill-conditioned stacked panel; the
+    Householder fallback inside the TSQRT kernel must keep the QR
+    factorization finite and correct."""
+    from parsec_tpu.apps.qr import qr_taskpool
+    mb, nt = 8, 2
+    n = nt * mb
+    rng = np.random.default_rng(7)
+    # nearly rank-deficient columns: cond ~ 1e6, squared by Cholesky-QR
+    # to ~1e12 — far beyond f32 chol
+    U, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    V, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.logspace(0, -6, n)
+    a = (U * s) @ V.T
+    a = a.astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n).from_array(a.copy())
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(qr_taskpool(A, device="tpu"))
+        ctx.wait()
+    out = A.to_array()
+    assert np.isfinite(out).all()
+    R = np.triu(out)
+    ata = a.T @ a
+    # R^T R == A^T A within f32 for a cond-1e6 matrix
+    assert np.abs(R.T @ R - ata).max() / np.abs(ata).max() < 1e-2
