@@ -44,10 +44,21 @@ from parsec_tpu.data.data import (ACCESS_READ, ACCESS_RW, ACCESS_WRITE,
 from parsec_tpu.utils.mca import params
 
 
-def _apply_payload(datum: Data, arr: np.ndarray) -> None:
+def _apply_payload(datum: Data, arr: np.ndarray,
+                   slices: Optional[tuple] = None) -> None:
     """Land a network payload as the datum's new authoritative host
-    value (the coherency transition lives in Data.overwrite_host)."""
-    datum.overwrite_host(arr)
+    value (the coherency transition lives in Data.overwrite_host).
+    ``slices`` applies a region-lane payload into its sub-tile extent
+    only (reference: per-region datatypes on the wire,
+    insert_function.h:60-78) — a read-modify-write so concurrent
+    disjoint-lane values survive."""
+    if slices is None:
+        datum.overwrite_host(arr)
+        return
+    copy = datum.pull_to_host()
+    cur = np.array(copy.payload, copy=True)
+    cur[tuple(slices)] = arr
+    datum.overwrite_host(cur)
 
 params.register("dtd_window_size", 2048,
                 "max in-flight DTD tasks before insert_task throttles")
@@ -78,7 +89,7 @@ class _Mode:
         if isinstance(other, Region):
             return _Mode(f"{self.name}|R({other.rid})", self.access,
                          base=self.base, flags=self.flags,
-                         region=other.rid)
+                         region=other)
         return NotImplemented
 
     def __repr__(self):
@@ -99,10 +110,19 @@ class Region:
     """Partial-tile dependency lane (reference: the region masks of
     insert_function.h — e.g. upper/lower/diagonal sub-tile regions).
     Accesses to DISTINCT regions of one tile do not conflict; a
-    region-free access conflicts with every lane."""
+    region-free access conflicts with every lane.
 
-    def __init__(self, rid: Any):
+    ``slices`` (a tuple of python slices, e.g. ``(slice(0, 8),)`` for
+    the tile's top half) declares the lane's byte extent.  It is what
+    lets region lanes work ACROSS RANKS: a remote lane write ships only
+    the lane's sub-array and the receiver applies it read-modify-write,
+    so concurrent writers of disjoint lanes on different ranks cannot
+    clobber each other (the reference's per-region MPI datatypes).
+    Ordering-only regions (no slices) stay shared-memory."""
+
+    def __init__(self, rid: Any, slices: Optional[tuple] = None):
         self.rid = rid
+        self.slices = tuple(slices) if slices is not None else None
 
 
 INPUT = _Mode("INPUT", ACCESS_READ)
@@ -159,14 +179,17 @@ class DTDTile:
 
 
 class _Lane:
-    """Per-region dependency history of one tile."""
+    """Per-region dependency history of one tile.  ``version`` is the
+    tile-version of the lane's last write — what names that write's
+    payload on the wire (distributed lanes)."""
 
-    __slots__ = ("last_writer", "readers")
+    __slots__ = ("last_writer", "readers", "version")
 
-    def __init__(self, last_writer=None, readers=None):
+    def __init__(self, last_writer=None, readers=None, version: int = 0):
         self.last_writer = last_writer
         self.readers: List["_DTDState"] = readers if readers is not None \
             else []
+        self.version = version
 
 
 class _DTDState:
@@ -181,7 +204,7 @@ class _DTDState:
 
     __slots__ = ("task", "remaining", "successors", "done", "affinity",
                  "rank", "is_recv", "needed", "tile", "version", "payload",
-                 "remote_sends", "pushout")
+                 "remote_sends", "pushout", "region")
 
     def __init__(self, task: Optional[Task], rank: int = 0):
         self.task = task
@@ -196,7 +219,10 @@ class _DTDState:
         self.tile: Optional[DTDTile] = None
         self.version = 0
         self.payload: Optional[np.ndarray] = None
-        #: (dst_rank, tile, version) payloads to ship at completion
+        #: region-lane id of a surrogate's write (None = whole tile):
+        #: selects the slice extent its payload applies into
+        self.region: Any = None
+        #: (dst_rank, tile, version, lane) payloads to ship at completion
         self.remote_sends: set = set()
 
 
@@ -212,6 +238,14 @@ class DTDTaskpool(Taskpool):
         self._dep_lock = threading.Lock()
         self._tiles: Dict[Any, DTDTile] = {}
         self._tiles_by_wire: Dict[Any, DTDTile] = {}
+        #: region-lane byte extents, rid -> tuple of slices (populated
+        #: identically on every rank by the SPMD insert stream — the
+        #: wire carries only the rid)
+        self._region_slices: Dict[Any, tuple] = {}
+        #: serializes payload read-modify-write spans: two unordered
+        #: disjoint-lane appliers interleaving pull/overwrite would lose
+        #: one lane's bytes (whole-tile overwrite restores stale data)
+        self._apply_lock = threading.Lock()
         self._dc_ids: Dict[int, int] = {}
         self._classes: Dict[Any, TaskClass] = {}
         self._inflight = 0
@@ -268,22 +302,79 @@ class DTDTaskpool(Taskpool):
         rank, and apply queued inbound flushes (the distributed epilogue
         of parsec_dtd_data_flush_all: every tile's home datum holds the
         final value once all ranks pass Context.wait quiescence)."""
-        outgoing: List[DTDTile] = []
+        outgoing: List[Tuple[DTDTile, Any, int]] = []
         with self._dep_lock:
             self._drained = True
             queued, self._flush_queue = self._flush_queue, []
             for tile in self._tiles.values():
-                lw = tile.last_writer
-                if lw is not None and not lw.is_recv \
-                        and tile.home_rank != self.myrank:
-                    outgoing.append(tile)
-        for wire, arr in queued:
+                if tile.home_rank == self.myrank:
+                    continue
+                if tile.lanes is None:
+                    lw = tile.last_writer
+                    if lw is not None and not lw.is_recv:
+                        outgoing.append((tile, None, tile.version))
+                else:
+                    # per-lane final writers may live on DIFFERENT
+                    # ranks: each rank flushes home only the lanes it
+                    # wrote last, as slice payloads
+                    for lrid, lane in tile.lanes.items():
+                        lw = lane.last_writer
+                        if lw is not None and not lw.is_recv:
+                            outgoing.append((tile, lrid, lane.version))
+        for wire, arr, lane, ver in queued:
             tile = self._tiles_by_wire.get(wire)
             if tile is not None:
-                _apply_payload(tile.data, arr)
-        for tile in outgoing:
+                self._apply_flush(tile, arr, lane, ver)
+        for tile, lane, ver in outgoing:
             self.context.comm.dtd_send(
-                tile.home_rank, self._wire_msg("flush", tile, tile.version))
+                tile.home_rank, self._wire_msg("flush", tile, ver, lane))
+
+    def _merge_payload(self, tile: DTDTile, arr: np.ndarray,
+                       slices: Optional[tuple],
+                       preserve: List[tuple]) -> None:
+        """The one payload-landing primitive: write ``arr`` (into
+        ``slices`` if given, else whole-tile) while restoring
+        ``preserve`` extents from the current value.  The whole
+        read-modify-write span holds _apply_lock — two unordered
+        disjoint-lane appliers interleaving pull/overwrite would
+        otherwise lose one lane's bytes."""
+        with self._apply_lock:
+            if slices is not None:
+                _apply_payload(tile.data, arr, slices)
+                return
+            if not preserve:
+                _apply_payload(tile.data, arr)
+                return
+            copy = tile.data.pull_to_host()
+            cur = np.array(copy.payload, copy=True)
+            new = np.asarray(arr).reshape(cur.shape).copy()
+            for sl in preserve:
+                new[tuple(sl)] = cur[tuple(sl)]
+            tile.data.overwrite_host(new)
+
+    def _apply_flush(self, tile: DTDTile, arr: np.ndarray, lane: Any,
+                     ver: int) -> None:
+        """Version-aware flush application: a flush carries the sender's
+        final write version for its (lane) extent, and must not clobber
+        extents this rank knows to be NEWER — e.g. a whole-tile write on
+        rank A flushed home after rank B's later lane write (the lane's
+        own flush, or the home rank's local value, carries the newer
+        bytes)."""
+        if lane is not None:
+            l = tile.lanes.get(lane) if tile.lanes else None
+            if l is not None and l.version > ver:
+                return          # a newer write to this lane supersedes
+            self._merge_payload(tile, arr, self._region_slices.get(lane),
+                                [])
+            return
+        preserve = []
+        if tile.lanes:
+            for lrid, l in tile.lanes.items():
+                if lrid is not None and l.version > ver:
+                    sl = self._region_slices.get(lrid)
+                    if sl is not None:
+                        preserve.append(sl)
+        self._merge_payload(tile, arr, None, preserve)
 
     def _raise_context_error(self) -> None:
         errs = getattr(self.context, "_errors", None)
@@ -506,14 +597,21 @@ class DTDTaskpool(Taskpool):
             raise RuntimeError(
                 "attach the DTD pool to a context before inserting")
         nargs = _norm(args)
-        if self.nranks > 1 and any(r is not None for *_x, r in nargs):
-            raise NotImplementedError(
-                "region-masked dependencies are shared-memory only "
-                "(distributed region lanes are not tracked on the wire)")
+        for *_x, r in nargs:
+            if r is None:
+                continue
+            if self.nranks > 1 and r.slices is None:
+                raise NotImplementedError(
+                    "distributed region lanes need a byte extent: "
+                    "declare Region(rid, slices=...) so lane payloads "
+                    "can ride the wire (ordering-only regions are "
+                    "shared-memory only)")
+            if r.slices is not None:
+                self._region_slices[r.rid] = r.slices
         args = [(v, b) for v, b, _f, _r in nargs]
         rank = self._task_rank(args) if self.nranks > 1 else self.myrank
         if rank != self.myrank:
-            self._insert_remote(args, rank)
+            self._insert_remote(nargs, rank)
             return None
         if isinstance(fn, DTDTaskClass):
             tc = fn.materialize(self)
@@ -554,7 +652,9 @@ class DTDTaskpool(Taskpool):
                 tile = self._as_tile(value)
                 task.data[name] = tile.data.copy_on(0)
                 if mode is not DONT_TRACK:
-                    tracked.append((tile, mode, region))
+                    tracked.append((tile, mode,
+                                    region.rid if region is not None
+                                    else None))
                 if "PUSHOUT" in flags and mode is not INPUT:
                     # force the result home at completion instead of
                     # staying producer/device-resident until a flush
@@ -596,47 +696,82 @@ class DTDTaskpool(Taskpool):
                 first = self._as_tile(value)
         return first.home_rank if first is not None else 0
 
-    def _insert_remote(self, args, rank: int) -> None:
+    def _conflict_lanes(self, tile: DTDTile,
+                        rid: Any) -> List[Tuple[Any, _Lane]]:
+        """(lane rid, lane) pairs an access to ``rid`` conflicts with
+        (caller holds _dep_lock; tile.lanes must exist): its own lane
+        plus the whole-tile lane, or EVERY lane for a whole-tile
+        access."""
+        lanes = tile.lanes
+        if rid is not None and rid not in lanes:
+            lanes[rid] = _Lane()
+        lanes.setdefault(None, _Lane())
+        return [(rid, lanes[rid]), (None, lanes[None])] \
+            if rid is not None else list(lanes.items())
+
+    def _insert_remote(self, nargs, rank: int) -> None:
         """Track a task that executes on another rank: its reads of
         locally-produced versions trigger payload sends; its writes insert
-        delivery surrogates so later local consumers chain correctly."""
-        reads: List[DTDTile] = []
-        writes: List[DTDTile] = []
-        for value, mode in args:
+        delivery surrogates so later local consumers chain correctly.
+        Region-lane accesses conflict laneswise, and a lane write's
+        payload is named (tile, version) with its lane rid riding along
+        so the receiver applies only the lane's extent."""
+        reads: List[Tuple[DTDTile, Any]] = []
+        writes: List[Tuple[DTDTile, Any]] = []
+        for value, mode, _f, region in nargs:
             if mode in (INPUT, OUTPUT, INOUT):
                 tile = self._as_tile(value)
+                rid = region.rid if region is not None else None
                 if mode in (INPUT, INOUT):
-                    reads.append(tile)
+                    reads.append((tile, rid))
                 if mode in (OUTPUT, INOUT):
-                    writes.append(tile)
-        sends: List[Tuple[int, DTDTile, int]] = []
+                    writes.append((tile, rid))
+        sends: List[Tuple[int, DTDTile, int, Any]] = []
         with self._dep_lock:
-            for tile in reads:
-                lw = tile.last_writer
-                if lw is None:
+            for tile, rid in reads:
+                if tile.lanes is None and rid is None:
+                    lws = [(tile.last_writer, None, tile.version)]
+                    v0_needed = tile.last_writer is None
+                else:
+                    if tile.lanes is None:
+                        tile.lanes = {None: _Lane(tile.last_writer,
+                                                  list(tile.readers),
+                                                  tile.version)}
+                    lws = [(lane.last_writer, lrid, lane.version)
+                           for lrid, lane in self._conflict_lanes(tile,
+                                                                  rid)]
+                    # mirrors _track_region's v0 rule EXACTLY (the SPMD
+                    # streams keep lane states consistent, so sender and
+                    # receiver reach the same verdict): the NONE lane
+                    # writerless, and a lane-scoped read's own lane too
+                    lanes = tile.lanes
+                    v0_needed = lanes[None].last_writer is None \
+                        and (rid is None
+                             or lanes[rid].last_writer is None)
+                if v0_needed and tile.home_rank == self.myrank \
+                        and rank != self.myrank \
+                        and rank not in tile.v0_sent:
                     # pristine home value: the owner forwards version 0
-                    if tile.home_rank == self.myrank \
-                            and rank != self.myrank \
-                            and rank not in tile.v0_sent:
-                        tile.v0_sent.add(rank)
-                        sends.append((rank, tile, 0))
-                elif not lw.is_recv and lw.rank == self.myrank:
-                    key = (rank, tile, tile.version)
+                    tile.v0_sent.add(rank)
+                    sends.append((rank, tile, 0, None))
+                for lw, lrid, lver in lws:
+                    if lw is None or lw.is_recv or lw.rank != self.myrank:
+                        continue   # a surrogate's rank serves its payload
+                    key = (rank, tile, lver, lrid)
                     if key not in lw.remote_sends:
                         # recorded either way so N readers on one rank
                         # cost ONE payload on the wire
                         lw.remote_sends.add(key)
                         if lw.done:
                             sends.append(key)
-                # lw on a third rank: that rank serves the payload
-            for tile in writes:
-                self._surrogate_write(tile)
-        for dst, tile, ver in sends:
-            self._send_payload(dst, tile, ver)
+            for tile, rid in writes:
+                self._surrogate_write(tile, rid)
+        for dst, tile, ver, lane in sends:
+            self._send_payload(dst, tile, ver, lane)
 
-    def _surrogate_write(self, tile: DTDTile) -> None:
+    def _surrogate_write(self, tile: DTDTile, rid: Any = None) -> None:
         """Advance the tile's version past a remote write, leaving a
-        delivery surrogate as last writer (caller holds _dep_lock).
+        delivery surrogate as (lane) last writer (caller holds _dep_lock).
 
         The WAW edge chains through EVERY surrogate — including unneeded
         ones — so WAR edges from still-pending readers of older versions
@@ -649,13 +784,35 @@ class DTDTaskpool(Taskpool):
         d.is_recv = True
         d.tile = tile
         d.version = tile.version
-        for r in tile.readers:       # WAR: local readers finish first
-            self._edge(r, d)
-        lw = tile.last_writer        # WAW: order in-place datum writes
-        if lw is not None:
-            self._edge(lw, d)
+        d.region = rid
+        if tile.lanes is None and rid is None:
+            for r in tile.readers:   # WAR: local readers finish first
+                self._edge(r, d)
+            lw = tile.last_writer    # WAW: order in-place datum writes
+            if lw is not None:
+                self._edge(lw, d)
+            if d.remaining == 0:
+                d.done = True        # no pending obligations: pass-through
+            tile.last_writer = d
+            tile.readers = []
+            return
+        if tile.lanes is None:
+            tile.lanes = {None: _Lane(tile.last_writer,
+                                      list(tile.readers), tile.version)}
+        lanes = tile.lanes
+        for _lrid, lane in self._conflict_lanes(tile, rid):
+            for r in lane.readers:                     # WAR
+                self._edge(r, d)
+            if lane.last_writer is not None:           # WAW
+                self._edge(lane.last_writer, d)
         if d.remaining == 0:
-            d.done = True            # no pending obligations: pass-through
+            d.done = True
+        if rid is None:
+            tile.lanes = {None: _Lane(d, version=tile.version)}
+        else:
+            lanes[rid].last_writer = d
+            lanes[rid].readers = []
+            lanes[rid].version = tile.version
         tile.last_writer = d
         tile.readers = []
 
@@ -696,12 +853,46 @@ class DTDTaskpool(Taskpool):
         if d.remaining == 0:
             to_schedule.append(task)
 
+    def _apply_data(self, tile: DTDTile, arr: np.ndarray, lane: Any,
+                    ver: int) -> None:
+        """Apply an in-run network payload.  A lane payload writes only
+        its slice extent.  A whole-tile payload must not clobber extents
+        of lanes with NEWER versions whose newest writer is a surrogate:
+        that lane's bytes arrive via its own recv chain, which is
+        UNORDERED relative to this one (disjoint lanes take no mutual
+        edges) — preserving makes both arrival orders converge.  A
+        newer lane whose newest writer is a LOCAL task is ordered after
+        this recv (it conflicts transitively), so its extent still wants
+        this payload's bytes and is NOT preserved."""
+        if lane is not None:
+            self._merge_payload(tile, arr, self._region_slices.get(lane),
+                                [])
+            return
+        preserve = []
+        with self._dep_lock:       # lanes mutate under the pool dep lock
+            if tile.lanes:
+                for lrid, l in tile.lanes.items():
+                    if lrid is None or l.version <= ver:
+                        continue
+                    lw = l.last_writer
+                    # preserve the lane when its newer bytes arrive via
+                    # an UNORDERED channel: a surrogate's own recv chain,
+                    # or — for the version-0 pristine pull, which takes
+                    # no edges on pre-existing lane writers — any write
+                    # at all (a local one may have already landed)
+                    if (lw is not None and lw.is_recv) or ver == 0:
+                        sl = self._region_slices.get(lrid)
+                        if sl is not None:
+                            preserve.append(sl)
+        self._merge_payload(tile, arr, None, preserve)
+
     def _recv_class(self) -> TaskClass:
         if self._recv_tc is None:
             def _recv_hook(es, task):
                 st = task.dtd
                 if st.payload is not None:
-                    _apply_payload(st.tile.data, st.payload)
+                    self._apply_data(st.tile, st.payload, st.region,
+                                     st.version)
                     st.payload = None
                 return None
             tc = TaskClass("_dtd_recv", params=[("tid", None)], flows=[],
@@ -710,8 +901,14 @@ class DTDTaskpool(Taskpool):
             self._recv_tc = tc
         return self._recv_tc
 
-    def _wire_msg(self, kind: str, tile: DTDTile, ver: int) -> dict:
+    def _wire_msg(self, kind: str, tile: DTDTile, ver: int,
+                  lane: Any = None) -> dict:
         """Encode a tile payload message (pulls the tile home first).
+
+        A region-lane write ships ONLY the lane's slice extent (the
+        reference's per-region datatypes, insert_function.h:60-78); the
+        lane rid rides the message and the receiver applies the payload
+        into the same extent read-modify-write.
 
         Payloads over the eager limit travel by RENDEZVOUS: a snapshot
         registers as a serve-once region and only its handle rides the
@@ -723,6 +920,10 @@ class DTDTaskpool(Taskpool):
         arr = np.asarray(copy.payload)
         base = {"tp": self.taskpool_id, "kind": kind,
                 "tile": tile.wire_key, "ver": ver}
+        if lane is not None:
+            arr = np.ascontiguousarray(
+                arr[tuple(self._region_slices[lane])])
+            base["lane"] = lane
         eager = int(params.get("comm_eager_limit", 65536))
         comm = self.context.comm if self.context is not None else None
         if comm is not None and arr.nbytes > eager:
@@ -732,8 +933,10 @@ class DTDTaskpool(Taskpool):
             return {**base, "ref": rid, "from": self.myrank}
         return {**base, **CommEngine.pack(arr)}
 
-    def _send_payload(self, dst: int, tile: DTDTile, ver: int) -> None:
-        self.context.comm.dtd_send(dst, self._wire_msg("data", tile, ver))
+    def _send_payload(self, dst: int, tile: DTDTile, ver: int,
+                      lane: Any = None) -> None:
+        self.context.comm.dtd_send(dst, self._wire_msg("data", tile, ver,
+                                                       lane))
 
     def _dtd_incoming(self, src: int, msg: dict) -> None:
         """Comm-thread entry for DTD payload/flush messages."""
@@ -777,13 +980,15 @@ class DTDTaskpool(Taskpool):
             if to_schedule:
                 scheduling.schedule(self.context.streams[0], to_schedule)
         elif msg["kind"] == "flush":
+            lane = msg.get("lane")
             with self._dep_lock:
                 if not self._drained:
-                    self._flush_queue.append((wire, arr))
+                    self._flush_queue.append((wire, arr, lane,
+                                              msg["ver"]))
                     return
                 tile = self._tiles_by_wire.get(wire)
             if tile is not None:
-                _apply_payload(tile.data, arr)
+                self._apply_flush(tile, arr, lane, msg["ver"])
 
     def _as_tile(self, value) -> DTDTile:
         if isinstance(value, DTDTile):
@@ -812,9 +1017,9 @@ class DTDTaskpool(Taskpool):
         ``region`` selects a partial-tile dependency lane (reference:
         the region masks of insert_function.h): distinct regions of one
         tile do not conflict; a region-free access conflicts with every
-        lane.  Shared-memory only (guarded at insert_task)."""
+        lane."""
         if region is not None or tile.lanes is not None:
-            self._track_region(state, tile, mode, region)
+            self._track_region(state, tile, mode, region, to_schedule)
             return
         me = self.myrank
         lw = tile.last_writer
@@ -849,43 +1054,70 @@ class DTDTaskpool(Taskpool):
                 # (ADVICE r2 high)
                 self._edge(lw, state)
             tile.version += 1
+            state.version = tile.version
             tile.last_writer = state
             tile.readers = []
 
     def _track_region(self, state: _DTDState, tile: DTDTile, mode: _Mode,
-                      region: Any) -> None:
-        """Region-lane dependency tracking (shared-memory).  The first
-        region-flagged access migrates the tile's whole-tile history into
-        the ``None`` lane; thereafter a region access conflicts with its
-        own lane plus the whole-tile lane, and a whole-tile access
-        conflicts with every lane."""
+                      rid: Any, to_schedule: List[Task]) -> None:
+        """Region-lane dependency tracking.  The first region-flagged
+        access migrates the tile's whole-tile history into the ``None``
+        lane; thereafter a region access conflicts with its own lane
+        plus the whole-tile lane, and a whole-tile access conflicts with
+        every lane.  Versions produced on other ranks appear as lane
+        surrogates (same machinery as whole-tile distributed tracking);
+        consuming one marks it needed and its payload applies into the
+        lane's slice extent only."""
+        me = self.myrank
         if tile.lanes is None:
             tile.lanes = {None: _Lane(tile.last_writer,
-                                      list(tile.readers))}
+                                      list(tile.readers), tile.version)}
         lanes = tile.lanes
-        if region is not None and region not in lanes:
-            lanes[region] = _Lane()
-        conflict = [lanes[region], lanes.setdefault(None, _Lane())] \
-            if region is not None else list(lanes.values())
-        mine = lanes[region]
+        conflict = self._conflict_lanes(tile, rid)
+        if mode is INPUT or mode is INOUT:
+            # pristine remote-home tile: materialize the v0 pull in the
+            # whole-tile lane (mirrors _track's surrogate-on-demand).
+            # Keyed on the NONE lane being writerless — another lane
+            # having a writer must not suppress it, or a whole-tile read
+            # after a lone OUTPUT lane write would read uninitialized
+            # extents; the v0 apply preserves every written lane's bytes
+            if self.nranks > 1 and tile.home_rank != me \
+                    and lanes[None].last_writer is None \
+                    and (rid is None
+                         or lanes[rid].last_writer is None):
+                d = _DTDState(None, rank=me)
+                d.is_recv, d.tile, d.version = True, tile, 0
+                lanes[None].last_writer = d
+        mine = lanes[rid] if rid is not None else None
         if mode is INPUT:
-            for lane in conflict:
-                if lane.last_writer is not None:
-                    self._edge(lane.last_writer, state)        # RAW
-            mine.readers.append(state)
+            for _lrid, lane in conflict:
+                lw = lane.last_writer
+                if lw is not None:
+                    if lw.is_recv:
+                        self._mark_needed(lw, to_schedule)
+                    self._edge(lw, state)                      # RAW
+            (mine if mine is not None else lanes[None]).readers.append(
+                state)
         else:
-            for lane in conflict:
+            for _lrid, lane in conflict:
                 for r in lane.readers:                         # WAR
                     self._edge(r, state)
-                if lane.last_writer is not None:               # WAW
-                    self._edge(lane.last_writer, state)
-            if region is None:
+                lw = lane.last_writer
+                if lw is not None:                             # WAW
+                    if lw.is_recv and mode is INOUT:
+                        # INOUT reads the surrogate's version
+                        self._mark_needed(lw, to_schedule)
+                    self._edge(lw, state)
+            tile.version += 1
+            state.version = tile.version
+            state.region = rid
+            if rid is None:
                 # whole-tile write supersedes every lane's history
-                tile.lanes = {None: _Lane(state)}
+                tile.lanes = {None: _Lane(state, version=tile.version)}
             else:
                 mine.last_writer = state
                 mine.readers = []
-            tile.version += 1
+                mine.version = tile.version
             # keep the legacy fields coherent for flush/debug paths
             tile.last_writer = state
             tile.readers = []
@@ -923,9 +1155,11 @@ class DTDTaskpool(Taskpool):
                     state.done = True
                     self._inflight -= 1
                     break
-            for dst, tile, ver in sorted(delta, key=lambda e: (e[0], e[2])):
-                outgoing.append((dst, self._wire_msg("data", tile, ver)))
-                encoded.add((dst, tile, ver))
+            for dst, tile, ver, lane in sorted(
+                    delta, key=lambda e: (e[0], e[2])):
+                outgoing.append((dst, self._wire_msg("data", tile, ver,
+                                                     lane)))
+                encoded.add((dst, tile, ver, lane))
         with self._window:
             # worklist: an unneeded surrogate whose last obligation clears
             # completes IN PLACE (no task to run) and propagates to its
