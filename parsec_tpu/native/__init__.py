@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct as struct_mod
 import subprocess
 import threading
 from typing import Optional
@@ -113,6 +114,7 @@ def _sign(lib: ctypes.CDLL) -> None:
         "ptq_trace_new": ([u64], p),
         "ptq_trace_delete": ([p], None),
         "ptq_trace_event": ([p, i32, i32, u64, u64, u64, d], None),
+        "ptq_trace_events_bulk": ([p, C.POINTER(C.c_uint8), u64], None),
         "ptq_trace_count": ([p], u64),
         "ptq_trace_event_size": ([], u64),
         "ptq_trace_read": ([p, u64, C.POINTER(C.c_uint8), u64], u64),
@@ -251,6 +253,23 @@ class NativeTraceBuffer:
               object_id: int, ts: float) -> None:
         self._lib.ptq_trace_event(self._h, key, flags, taskpool_id,
                                   event_id, object_id, ts)
+
+    #: packed layout for events_bulk — unsigned 64-bit on the way IN
+    #: (matches ptq_trace_event's parameter types; negative object_ids
+    #: fold to two's complement like the per-event path)
+    _EVFMT_IN = struct_mod.Struct("<iiQQqd")
+
+    def events_bulk(self, events) -> None:
+        """One boundary crossing for a batch of (key, flags, tp, eid,
+        oid, ts) tuples — the tracer hot path's amortized ingest."""
+        if not events:
+            return
+        pack = self._EVFMT_IN.pack
+        buf = b"".join(pack(k, f, tp & 0xFFFFFFFFFFFFFFFF,
+                            e & 0xFFFFFFFFFFFFFFFF, o, ts)
+                       for k, f, tp, e, o, ts in events)
+        carr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+        self._lib.ptq_trace_events_bulk(self._h, carr, len(buf))
 
     def __len__(self):
         return int(self._lib.ptq_trace_count(self._h))

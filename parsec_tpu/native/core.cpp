@@ -193,6 +193,18 @@ void ptq_trace_event(void* h, int32_t key, int32_t flags,
     t->events.push_back({key, flags, taskpool_id, event_id, object_id, ts});
 }
 
+// Bulk ingest of packed events (same layout as ptq_ev): the Python
+// tracer batches its hot path into ONE boundary crossing per ~1k
+// events instead of a ctypes call per event.
+void ptq_trace_events_bulk(void* h, const uint8_t* buf, uint64_t nbytes) {
+    auto* t = static_cast<ptq_trace*>(h);
+    uint64_t n = nbytes / sizeof(ptq_ev);
+    if (!n) return;
+    const ptq_ev* evs = reinterpret_cast<const ptq_ev*>(buf);
+    std::lock_guard<std::mutex> g(t->m);
+    t->events.insert(t->events.end(), evs, evs + n);
+}
+
 uint64_t ptq_trace_count(void* h) {
     auto* t = static_cast<ptq_trace*>(h);
     std::lock_guard<std::mutex> g(t->m);

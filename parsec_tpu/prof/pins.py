@@ -10,9 +10,9 @@ here subscribe to them.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
-from parsec_tpu.prof.profiling import EV_POINT, Profile
+from parsec_tpu.prof.profiling import EV_END, EV_POINT, EV_START, Profile
 
 #: lifecycle events emitted by the runtime (scheduling.py / context.py)
 PINS_EVENTS = ("select", "exec_begin", "exec_end", "exec_async",
@@ -21,12 +21,24 @@ PINS_EVENTS = ("select", "exec_begin", "exec_end", "exec_async",
 
 class TaskProfilerPins:
     """Feed task execution intervals into the binary trace
-    (reference: mca/pins/task_profiler)."""
+    (reference: mca/pins/task_profiler).
 
-    def __init__(self, profile: Profile):
+    Hot-path discipline (reference: profiling.c writes one fixed-size
+    record with no allocation): the per-event path caches the stream
+    buffer per es and the dictionary key per task class, and by default
+    records NO Python info payload — info-less events land straight in
+    the native C++ packed buffer.  ``with_locals=True`` restores the
+    per-event ``{"locals": ...}`` payload (richer traces, Python-path
+    cost; the reference's converter-string info analog).
+    """
+
+    def __init__(self, profile: Profile, with_locals: bool = False):
         self.profile = profile
+        self.with_locals = with_locals
         self._event_ids: Dict[int, int] = {}   # task seq -> trace event id
         self._closed: set = set()              # eids closed by exec_end
+        self._sbs: Dict[int, Any] = {}         # th_id -> StreamBuffer
+        self._keys: Dict[str, int] = {}        # class name -> dict key
 
     def install(self, context) -> None:
         context.pins_register("exec_begin", self._begin)
@@ -39,22 +51,35 @@ class TaskProfilerPins:
         context.pins_unregister("complete_exec", self._complete)
 
     def _sb(self, es):
-        return self.profile.stream(es.th_id, f"worker-{es.th_id}")
+        sb = self._sbs.get(es.th_id)
+        if sb is None:
+            sb = self._sbs[es.th_id] = \
+                self.profile.stream(es.th_id, f"worker-{es.th_id}")
+        return sb
+
+    def _key(self, name: str) -> int:
+        k = self._keys.get(name)
+        if k is None:
+            k = self._keys[name] = self.profile.add_event_class(name).key
+        return k
 
     def _begin(self, es, event, task) -> None:
+        if not self.profile.enabled:
+            return
         eid = self.profile.next_event_id()
         self._event_ids[task.seq] = eid
-        self.profile.trace_interval_start(
-            self._sb(es), task.task_class.name, task.taskpool.taskpool_id,
-            eid, object_id=hash(task.key),
-            info={"locals": dict(task.locals)})
+        info = {"locals": dict(task.locals)} if self.with_locals else None
+        self._sb(es).trace(self._key(task.task_class.name), EV_START,
+                           task.taskpool.taskpool_id, eid,
+                           hash(task.key), info)
 
     def _end(self, es, event, task) -> None:
+        if not self.profile.enabled:
+            return
         eid = self._event_ids.get(task.seq, 0)
         self._closed.add(eid)
-        self.profile.trace_interval_end(
-            self._sb(es), task.task_class.name, task.taskpool.taskpool_id,
-            eid, object_id=hash(task.key))
+        self._sb(es).trace(self._key(task.task_class.name), EV_END,
+                           task.taskpool.taskpool_id, eid, hash(task.key))
 
     def _complete(self, es, event, task) -> None:
         # device (ASYNC) tasks never ran exec_end on a worker stream:
@@ -66,13 +91,13 @@ class TaskProfilerPins:
         if eid in self._closed:             # already closed by _end
             self._closed.discard(eid)
             return
-        self.profile.trace_interval_end(
-            self._sb(es), task.task_class.name, task.taskpool.taskpool_id,
-            eid, object_id=hash(task.key))
+        self._sb(es).trace(self._key(task.task_class.name), EV_END,
+                           task.taskpool.taskpool_id, eid, hash(task.key))
 
 
-def install_task_profiler(context, profile: Profile) -> TaskProfilerPins:
-    mod = TaskProfilerPins(profile)
+def install_task_profiler(context, profile: Profile,
+                          with_locals: bool = False) -> TaskProfilerPins:
+    mod = TaskProfilerPins(profile, with_locals=with_locals)
     mod.install(context)
     return mod
 

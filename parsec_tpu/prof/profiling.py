@@ -59,10 +59,16 @@ class StreamBuffer:
     timestamp, at dump time.
     """
 
+    #: pending-list length that triggers a bulk flush into the native
+    #: packed buffer (one ctypes crossing per chunk, not per event —
+    #: the per-event hot path is ONE list append; sp-perf.c-class cost)
+    FLUSH_CHUNK = 1024
+
     def __init__(self, stream_id: int, name: str):
         self.stream_id = stream_id
         self.name = name
         self.events: List[Tuple] = []
+        self._pending: List[Tuple] = []
         self._native = None
         try:
             from parsec_tpu.native import NativeTraceBuffer, available
@@ -76,16 +82,26 @@ class StreamBuffer:
               timestamp: Optional[float] = None) -> None:
         ts = timestamp if timestamp is not None else time.perf_counter()
         if info is None and self._native is not None:
-            self._native.event(key, flags, taskpool_id, event_id,
-                               object_id, ts)
+            self._pending.append((key, flags, taskpool_id, event_id,
+                                  object_id, ts))
+            if len(self._pending) >= self.FLUSH_CHUNK:
+                self.flush_native()
             return
         self.events.append((key, flags, taskpool_id, event_id, object_id,
                             ts, info))
+
+    def flush_native(self) -> None:
+        """Bulk-load pending info-less events into the native packed
+        buffer (one boundary crossing per chunk)."""
+        if self._pending and self._native is not None:
+            pending, self._pending = self._pending, []
+            self._native.events_bulk(pending)
 
     def merged_events(self) -> List[Tuple]:
         """All events (native + python), timestamp-ordered."""
         if self._native is None:
             return list(self.events)
+        self.flush_native()
         merged = [ev + (None,) for ev in self._native.drain()]
         merged.extend(self.events)
         merged.sort(key=lambda e: e[5])

@@ -42,7 +42,7 @@ def test_trace_intervals_complete(tmp_path, device):
     A.data_of(0, 0).copy_on(0).payload[:] = 0.0
     prof = profiling_init("test")
     with Context(nb_cores=2) as ctx:
-        mod = install_task_profiler(ctx, prof)
+        mod = install_task_profiler(ctx, prof, with_locals=True)
         ctx.add_taskpool(_chain_pool(A, nt, device))
         ctx.wait()
         mod.uninstall(ctx)
