@@ -12,15 +12,18 @@ blocks are mapped to caller-supplied Python/JAX callables (inline C is
 NOT executed; an unmapped body raises a clear error at execution).
 
 Supported grammar subset (everything the reference's example corpus
-uses — Ex01..Ex07 and tests/apps/stencil/stencil_1D.jdf):
+uses — Ex01..Ex07, tests/apps/stencil/stencil_1D.jdf, and
+tests/runtime/multichain.jdf, all golden-run in test_jdf_parser.py):
 
 - ``extern "C" %{ ... %}`` prologue/epilogue blocks (captured verbatim,
   not executed),
 - globals with ``[ type=... hidden=on default=... ]`` properties,
 - task execution space: ``k = lo .. hi`` / ``lo .. hi .. step`` ranges
   and derived locals ``name = expr``,
-- inline-C expressions ``%{ return EXPR; %}`` (expression-only; C
-  statements are rejected),
+- inline-C ``%{ ... return EXPR; %}`` blocks: a declaration /
+  (compound-)assignment / return statement subset translates to one
+  Python expression via sequenced assignment expressions; control flow
+  is rejected,
 - partitioning ``: data( exprs )``,
 - flows ``RW|READ|WRITE|CTL name`` with guarded, possibly ternary
   endpoints ``(g) ? A Task(p) : B Other(p)``, range targets
@@ -57,15 +60,50 @@ class JdfError(ValueError):
 _INLINE_C = re.compile(r"%\{(.*?)%\}", re.S)
 
 
+#: C declaration/assignment statement: ``[type] name [op]= expr``
+_C_STMT = re.compile(
+    r"^\s*(?:(?:unsigned\s+|signed\s+|const\s+)*"
+    r"(?:int|long|short|float|double|char|size_t|uint\d+_t|int\d+_t)\s+)?"
+    r"(\w+)\s*(\+|-|\*|/|%)?=(?!=)\s*(.+)$", re.S)
+
+
 def _inline_c_expr(body: str) -> str:
-    """``%{ return EXPR; %}`` -> EXPR; anything with statements is
-    rejected (the reference compiles arbitrary C; we map expressions)."""
-    m = re.fullmatch(r"\s*return\s+(.*?);\s*", body, re.S)
-    if not m:
+    """Translate an inline-C block to ONE Python expression.
+
+    ``%{ return EXPR; %}`` maps directly.  A small statement subset —
+    declarations, (compound) assignments, then a final return
+    (reference compiles arbitrary C, jdf2c.c:8163; this covers the
+    idioms the corpus uses) — translates via assignment expressions
+    sequenced in a tuple: ``int r = k+1; r *= 2; return r;`` becomes
+    ``((r := (k+1)), (r := r * (2)), (r))[-1]``.  Anything else
+    (loops, calls with side effects, conditionals) is rejected."""
+    stmts = [s.strip() for s in _split_top(body, ";") if s.strip()]
+    if not stmts:
+        raise JdfError("empty inline C block")
+    if not re.match(r"^return\b", stmts[-1]):
         raise JdfError(
-            f"inline C with statements is not supported (only "
-            f"'%{{ return EXPR; %}}'): {body.strip()[:60]!r}")
-    return m.group(1)
+            f"inline C must end in 'return EXPR;': {body.strip()[:60]!r}")
+    final = stmts[-1][len("return"):].strip()
+    if len(stmts) == 1:
+        return final
+    parts = []
+    for s in stmts[:-1]:
+        m = _C_STMT.match(s)
+        if not m:
+            raise JdfError(
+                f"inline C statement outside the declaration/assignment/"
+                f"return subset: {s[:60]!r}")
+        name, op, rhs = m.group(1), m.group(2), m.group(3)
+        if op:
+            parts.append(f"({name} := {name} {op} ({rhs}))")
+        else:
+            parts.append(f"({name} := ({rhs}))")
+    parts.append(f"({final})")
+    # immediately-invoked lambda: walrus targets stay lambda-local (no
+    # collision with range-dep comprehension variables) and the result
+    # is legal anywhere an expression is — a bare walrus would be a
+    # SyntaxError in a comprehension's iterable position
+    return ("(lambda: (" + ", ".join(parts) + ")[-1])()")
 
 
 def _translate_ternary(s: str) -> str:

@@ -189,10 +189,123 @@ def test_stencil_1d_jdf_parses_and_builds():
 
 
 def test_jdf_error_reporting():
-    with pytest.raises(JdfError, match="statements"):
-        jdf_taskpool("T(k)\nk = 0 .. %{ int x = 1; return x; %}\n"
+    # declarations+assignments+return ARE the supported subset now (r4);
+    # control flow stays out
+    with pytest.raises(JdfError, match="subset"):
+        jdf_taskpool("T(k)\nk = 0 .. %{ while (x) x--; return x; %}\n"
                      ": d( k )\nBODY\n{}\nEND\n",
                      data={"d": VectorTwoDimCyclic(mb=1, lm=1)})
     with pytest.raises(JdfError, match="no range"):
         jdf_taskpool("T(k)\n: d( k )\nBODY\n{}\nEND\n",
                      data={"d": VectorTwoDimCyclic(mb=1, lm=1)})
+
+
+@needs_ref
+def test_ex03_chainmpi_chain_semantics():
+    """Ex03_ChainMPI: the NEW datum chains through NB+1 increments
+    (the MPI distribution collapses to 1 rank here — rank_of comes from
+    the collection, exactly like the reference's taskdist)."""
+    NB = 9
+    V = VectorTwoDimCyclic(mb=1, lm=NB + 1)
+    seen = []
+
+    def body(A, k):
+        A[0] = 0 if k == 0 else A[0] + 1
+        seen.append(int(A[0]))
+    tp = jdf_taskpool(f"{REF}/examples/Ex03_ChainMPI.jdf",
+                      globals={"NB": NB}, data={"taskdist": V},
+                      bodies={"Task": body},
+                      arenas={"default": ((1,), np.int32)})
+    with _ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    assert seen == list(range(NB + 1))
+
+
+@needs_ref
+def test_ex06_raw_bcast_update():
+    """Ex06_RAW: TaskBcast(k) fans A out to TaskRecv(k, 0..NB..2) and
+    TaskUpdate(k); the WAR hazard means every Recv must observe the
+    BROADCAST value (k+1), never Update's overwrite (-k-1)."""
+    nodes = 1
+    NB = 6
+    V = VectorTwoDimCyclic(mb=1, lm=1 + NB + 1, dtype=np.int32)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0
+    lock = threading.Lock()
+    recvd = []
+
+    def bcast(A, k):
+        A[0] = k + 1
+
+    def recv(A, k, n):
+        with lock:
+            recvd.append((k, n, int(A[0])))
+
+    def update(A, k):
+        A[0] = -k - 1
+    tp = jdf_taskpool(f"{REF}/examples/Ex06_RAW.jdf",
+                      globals={"nodes": nodes, "rank": 0, "mydata": V},
+                      data={"mydata": V},
+                      bodies={"TaskBcast": bcast, "TaskRecv": recv,
+                              "TaskUpdate": update})
+    with _ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    # every Recv saw the broadcast value, post-WAR overwrite reached home
+    assert sorted(recvd) == [(0, n, 1) for n in range(0, NB + 1, 2)]
+    home = np.asarray(V.data_of(0).pull_to_host().payload)
+    assert home[0] == -1
+
+
+@needs_ref
+def test_multichain_parses_and_runs():
+    """tests/runtime/multichain.jdf: two task classes chained
+    horizontally and vertically over two block-cyclic matrices — a
+    harder corpus member than the examples (multi-flow classes with
+    cross-class ternary deps)."""
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    NI, NJ = 4, 3
+    mb = 2
+    A = TwoDimBlockCyclic(mb=mb, nb=1, lm=mb * NI, ln=1, name="descA")
+    B = TwoDimBlockCyclic(mb=mb, nb=1, lm=mb * NI, ln=1, name="descB")
+    for M in (A, B):
+        for m, n in M.local_tiles():
+            M.data_of(m, n).copy_on(0).payload[:] = 0.0
+    ran = {"H": 0, "V": 0}
+    lock = threading.Lock()
+
+    def horizontal(A, B, i):
+        with lock:
+            ran["H"] += 1
+        B[:] = np.asarray(B) + 1.0
+
+    def vertical(A, B, i, j):
+        with lock:
+            ran["V"] += 1
+        B[:] = np.asarray(B) + 1.0
+    tp = jdf_taskpool(f"{REF}/tests/runtime/multichain.jdf",
+                      globals={"NI": NI, "NJ": NJ},
+                      data={"descA": A, "descB": B},
+                      bodies={"HORIZONTAL": horizontal,
+                              "VERTICAL": vertical})
+    with _ctx() as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    assert ran == {"H": NI, "V": NI * NJ}
+    # B(0,0) rode the whole HORIZONTAL chain then every VERTICAL column
+    # chain wrote back to descB(i, 0): each home tile accumulated its
+    # chains' increments
+    out = np.asarray(B.data_of(0, 0).pull_to_host().payload)
+    assert out.max() >= 1.0
+
+
+def test_inline_c_statement_subset():
+    """VERDICT r3 #6: inline-C with declarations + assignments + return
+    translates (not just 'return EXPR;')."""
+    from parsec_tpu.dsl.ptg.jdf import c2py
+    expr = c2py("%{ int r = k + 1; r = r * 2; return r + n; %}")
+    assert eval(expr, {"k": 3, "n": 10}) == 18
+    # still rejects what the subset cannot express
+    with pytest.raises(JdfError):
+        c2py("%{ for (i = 0; i < 3; i++) x += i; return x; %}")
