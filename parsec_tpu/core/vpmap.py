@@ -28,6 +28,92 @@ params.register("runtime_bind_threads", 0,
                 "bind worker threads to cores round-robin (Linux only)")
 
 
+def _parse_cpu_list(s: str) -> List[int]:
+    """Kernel cpu-list syntax: ``0-3,8,10-11`` -> [0,1,2,3,8,10,11]."""
+    out: List[int] = []
+    for tok in s.strip().split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "-" in tok:
+            lo, _, hi = tok.partition("-")
+            out.extend(range(int(lo), int(hi) + 1))
+        else:
+            out.append(int(tok))
+    return out
+
+
+def discover_topology(sysfs_root: str = "/sys"):
+    """OS-level hardware topology (the hwloc counterpart — reference:
+    parsec_hwloc.c builds the socket/cache hierarchy; here the kernel's
+    sysfs exports the same facts): reads
+    ``cpu*/topology/package_cpus_list`` and
+    ``cpu*/cache/index*/{level,type,shared_cpu_list}`` into grouped
+    core lists per sharing level.
+
+    Returns ``{"cpus": [ids...], "package": [[cores]...],
+    "l3": [...], "l2": [...], "l1": [...]}`` where each level lists
+    disjoint groups of cores sharing that resource.  Missing sysfs
+    (non-Linux, containers) yields single/empty groups — callers fall
+    back to flat splits."""
+    base = os.path.join(sysfs_root, "devices/system/cpu")
+    cpus: List[int] = []
+    try:
+        for name in os.listdir(base):
+            m = name.startswith("cpu") and name[3:].isdigit()
+            if m:
+                cpus.append(int(name[3:]))
+    except OSError:
+        return {"cpus": [], "package": [], "l3": [], "l2": [], "l1": []}
+    cpus.sort()
+
+    def read(path: str) -> Optional[str]:
+        try:
+            with open(path) as fh:
+                return fh.read().strip()
+        except OSError:
+            return None
+
+    def groups_from(keyfn) -> List[List[int]]:
+        seen = {}
+        for c in cpus:
+            key = keyfn(c)
+            if key is None:
+                key = ("self", c)
+            seen.setdefault(key, []).append(c)
+        return sorted(seen.values(), key=lambda g: g[0])
+
+    def pkg_key(c: int):
+        s = read(f"{base}/cpu{c}/topology/package_cpus_list")
+        return tuple(_parse_cpu_list(s)) if s else None
+
+    def cache_key(level: int):
+        def key(c: int):
+            cdir = f"{base}/cpu{c}/cache"
+            try:
+                idxs = [n for n in os.listdir(cdir)
+                        if n.startswith("index")]
+            except OSError:
+                return None
+            for idx in idxs:
+                lv = read(f"{cdir}/{idx}/level")
+                ty = read(f"{cdir}/{idx}/type") or ""
+                if lv and int(lv) == level and ty != "Instruction":
+                    s = read(f"{cdir}/{idx}/shared_cpu_list")
+                    if s:
+                        return tuple(_parse_cpu_list(s))
+            return None
+        return key
+
+    return {
+        "cpus": cpus,
+        "package": groups_from(pkg_key),
+        "l3": groups_from(cache_key(3)),
+        "l2": groups_from(cache_key(2)),
+        "l1": groups_from(cache_key(1)),
+    }
+
+
 class VPMap:
     """Stream -> (vp, core) placement (reference: vpmap.h:45-68)."""
 
@@ -58,15 +144,45 @@ class VPMap:
                                 for i in range(nb_threads)])
 
     @classmethod
-    def from_hardware(cls, nb_threads: int) -> "VPMap":
-        """Split streams evenly over the visible cores (reference:
-        vpmap_init_from_hardware_affinity; without hwloc the 'socket'
-        granularity degenerates to contiguous, balanced core blocks)."""
-        ncores = os.cpu_count() or 1
-        nvp = max(1, min(nb_threads, ncores))
-        return cls(nb_threads,
-                   [i * nvp // nb_threads for i in range(nb_threads)],
-                   [i % ncores for i in range(nb_threads)])
+    def from_hardware(cls, nb_threads: int,
+                      sysfs_root: str = "/sys") -> "VPMap":
+        """One VP per hardware locality domain (reference:
+        vpmap_init_from_hardware_affinity, parsec_hwloc.c socket/NUMA
+        grouping): ``discover_topology`` reads the kernel's cache +
+        package hierarchy and the VP groups follow the deepest level
+        with real sharing — packages, else shared-LLC islands.  With no
+        discoverable structure (1 core, no sysfs) this degenerates to
+        contiguous balanced core blocks, the old behavior."""
+        topo = discover_topology(sysfs_root)
+        groups: List[List[int]] = []
+        for lvl in ("package", "l3", "l2"):
+            lv = topo.get(lvl) or []
+            # a level only structures the machine if it has SEVERAL
+            # groups of genuinely shared cores (singleton-per-core
+            # levels are no locality signal)
+            if len(lv) > 1 and any(len(g) > 1 for g in lv):
+                groups = lv
+                break
+        if len(groups) <= 1:
+            ncores = len(topo.get("cpus") or []) or os.cpu_count() or 1
+            nvp = max(1, min(nb_threads, ncores))
+            return cls(nb_threads,
+                       [i * nvp // nb_threads for i in range(nb_threads)],
+                       [i % ncores for i in range(nb_threads)])
+        # interleave streams across the domains (balanced VPs), binding
+        # each to a concrete core of its domain
+        order = []
+        width = max(len(g) for g in groups)
+        for j in range(width):
+            for g, cores in enumerate(groups):
+                if j < len(cores):
+                    order.append((g, cores[j]))
+        vp_of, core_of = [], []
+        for i in range(nb_threads):
+            g, c = order[i % len(order)]
+            vp_of.append(g)
+            core_of.append(c)
+        return cls(nb_threads, vp_of, core_of)
 
     @classmethod
     def from_file(cls, path: str, nb_threads: int,
