@@ -317,3 +317,78 @@ def test_iterators_checker_catches_lost_delivery(monkeypatch):
             ctx.wait(timeout=60)
         assert "iterators_checker" in str(exc.value.__cause__)
     assert dropped["n"] == 1 and chk.flagged >= 1
+
+
+# -- live aggregator (aggregator_visu counterpart, VERDICT r3 missing #6) --
+
+def test_aggregator_ingest_and_totals():
+    from parsec_tpu.prof.aggregator import Aggregator, render_table
+    agg = Aggregator(port=0)
+    try:
+        agg.ingest(0, {"tasks_retired": 10, "pending_tasks": 2})
+        agg.ingest(1, {"tasks_retired": 5, "pending_tasks": 1})
+        agg.ingest(0, {"tasks_retired": 12, "pending_tasks": 0})
+        t = agg.table()
+        assert t[0]["tasks_retired"] == 12 and t[1]["tasks_retired"] == 5
+        assert agg.totals()["tasks_retired"] == 17
+        assert [v for _ts, v in agg.history(0, "tasks_retired")] == [10, 12]
+        out = render_table(t, agg.totals())
+        assert "rank" in out and "17" in out
+    finally:
+        agg.close()
+
+
+def test_gauge_publisher_streams_over_tcp():
+    import time
+    from parsec_tpu.prof.aggregator import Aggregator, GaugePublisher
+
+    class FakeGauges:
+        def __init__(self):
+            self.n = 0
+
+        def snapshot(self):
+            self.n += 1
+            return {"tasks_retired": self.n}
+
+    agg = Aggregator(port=0)
+    pub = GaugePublisher(FakeGauges(), rank=3, host="127.0.0.1",
+                         port=agg.port, interval=0.05)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            t = agg.table()
+            if 3 in t and t[3]["tasks_retired"] >= 2:
+                break
+            time.sleep(0.05)
+        assert 3 in agg.table()
+        assert agg.table()[3]["tasks_retired"] >= 2
+    finally:
+        pub.close()
+        agg.close()
+
+
+def test_aggregator_live_with_runtime_gauges():
+    """End-to-end: a real Context's Gauges publish through TCP while a
+    taskpool runs; the aggregator's final totals see every retirement."""
+    import time
+    from parsec_tpu.prof.aggregator import Aggregator, GaugePublisher
+
+    nt = 30
+    agg = Aggregator(port=0)
+    try:
+        with Context(nb_cores=2) as ctx:
+            g = install_gauges(ctx)
+            pub = GaugePublisher(g, rank=0, host="127.0.0.1",
+                                 port=agg.port, interval=0.02)
+            ctx.add_taskpool(_chain_pool(TwoDimBlockCyclic(
+                mb=4, nb=4, lm=4, ln=4), nt))
+            ctx.wait()
+            pub.close()              # final flush carries the end state
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if agg.totals().get("tasks_retired", 0) >= nt:
+                break
+            time.sleep(0.05)
+        assert agg.totals()["tasks_retired"] >= nt
+    finally:
+        agg.close()

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Live gauge viewer: terminal dashboard over the gauge aggregator
+(reference: tools/aggregator_visu/basic_gui.py + plot_gui.py — the GUI
+end of the PAPI-SDE live pipeline; this renders the same table in a
+terminal, refreshing in place).
+
+Run an aggregator and point ranks' GaugePublishers at it, then:
+
+    python tools/live_view.py --port 21900 [--interval 0.5]
+
+or, to host the aggregator in-process (the common single-host case):
+
+    python tools/live_view.py --serve --port 21900
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from parsec_tpu.prof.aggregator import Aggregator, render_table  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=21900)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--interval", type=float, default=0.5)
+    ap.add_argument("--serve", action="store_true",
+                    help="host the aggregator here (ranks publish to "
+                         "this process)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one table and exit (scripting)")
+    args = ap.parse_args()
+    if not args.serve:
+        ap.error("remote-scrape mode is not implemented — run with "
+                 "--serve and point publishers here")
+    agg = Aggregator(host=args.host, port=args.port)
+    print(f"aggregating on {args.host}:{agg.port}", file=sys.stderr)
+    try:
+        while True:
+            out = render_table(agg.table(), agg.totals())
+            if args.once:
+                print(out)
+                return
+            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        agg.close()
+
+
+if __name__ == "__main__":
+    main()
