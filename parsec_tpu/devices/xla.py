@@ -39,7 +39,17 @@ from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency,
 from parsec_tpu.devices.device import Device
 from parsec_tpu.core.task import ToDesc
 from parsec_tpu.utils.mca import params
-from parsec_tpu.utils.output import debug_verbose
+from parsec_tpu.utils.output import debug_verbose, warning
+
+
+def _transient_compile_error(exc: Exception) -> bool:
+    """A tunneled-TPU compile RPC that died mid-response (axon
+    remote_compile flake): the program is valid and the server usually
+    holds it in cache by the time a retry lands.  Anything else —
+    OOM, invalid program, real device fault — is NOT transient."""
+    s = str(exc)
+    return ("remote_compile" in s or "response body closed" in s) \
+        and "INTERNAL" in s
 
 params.register("device_inflight_depth", 8,
                 "max in-flight device tasks per XLA device")
@@ -452,10 +462,25 @@ class XlaDevice(Device):
                     else:
                         flat.append(task.taskpool.globals.get(a))
             donate = self._donate and not self._donation_hazard(spec, flat)
-            if n == 1:
-                results = [spec.jitted(donate)(*flat)]
-            else:
-                results = list(spec.jitted_fused(donate, n)(*flat))
+
+            def dispatch():
+                if n == 1:
+                    return [spec.jitted(donate)(*flat)]
+                return list(spec.jitted_fused(donate, n)(*flat))
+
+            try:
+                results = dispatch()
+            except Exception as exc:   # transient tunnel compile flake
+                # retry ONLY when nothing was donated: a flake that hit
+                # after donation leaves the inputs deleted, and the
+                # string guard cannot distinguish compile- from
+                # execute-phase failure
+                if donate or not _transient_compile_error(exc):
+                    raise
+                warning("%s: transient compile failure (%s); retrying "
+                        "once", self.name, str(exc)[:120])
+                results = dispatch()   # server-side cache usually warm now
+            if n > 1:
                 self.stats.fused_launches += 1
                 self.stats.fused_tasks += n
             outs_per_task = [spec.bind_outputs(r) for r in results]
