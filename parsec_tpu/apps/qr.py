@@ -64,15 +64,19 @@ def _k(name, maker):
 def _mk_geqrt():
     def fn(T, Q):
         import jax.numpy as jnp
-        q, r = jnp.linalg.qr(T, mode="reduced")   # square tile: full Q
-        return {"T": r, "Q": q}
+        # factor in f32 even under bf16 tile storage (mp mode); results
+        # land back in the storage dtype (kernels are dtype-FOLLOWING,
+        # same discipline as apps/potrf.py)
+        q, r = jnp.linalg.qr(T.astype(jnp.float32), mode="reduced")
+        return {"T": r.astype(T.dtype), "Q": q.astype(T.dtype)}
     return fn
 
 
 def _mk_unmqr():
     def fn(Q, C):
         import jax.numpy as jnp
-        return {"C": jnp.matmul(Q.T, C)}
+        acc = jnp.matmul(Q.T, C, preferred_element_type=jnp.float32)
+        return {"C": acc.astype(C.dtype)}
     return fn
 
 
@@ -106,6 +110,8 @@ def _mk_tsqrt():
     def fn(T, B, Q):
         import jax.numpy as jnp
         from jax import lax
+        T = T.astype(jnp.float32)      # WY construction runs in f32
+        B = B.astype(jnp.float32)
         # Fast path: Cholesky of the Gram matrix (pure matmul + chol,
         # rides the MXU).  Cholesky-QR squares cond(panel), so chol(G)
         # yields NaNs for ill-conditioned stacked panels; guard with a
@@ -124,8 +130,9 @@ def _mk_tsqrt():
         L = lax.cond(jnp.all(jnp.isfinite(L)), lambda _: L, stable_L,
                      operand=None)
         Rp, V, Tt = _wy_from_L(T, B, L, jnp, tri_inv)
-        return {"T": Rp, "B": jnp.zeros_like(B),
-                "Q": jnp.concatenate([V, Tt], axis=0)}
+        dt = Q.dtype                    # NEW-flow arena dtype = storage
+        return {"T": Rp.astype(dt), "B": jnp.zeros_like(B, dtype=dt),
+                "Q": jnp.concatenate([V, Tt], axis=0).astype(dt)}
     return fn
 
 
@@ -134,8 +141,16 @@ def _mk_tsmqr():
         import jax.numpy as jnp
         mb = C1.shape[0]
         V, Tt = Q[:mb, :], Q[mb:, :]
-        Z = Tt @ (C1 + V.T @ C2)
-        return {"C1": C1 - Z, "C2": C2 - V @ Z}
+        # f32 accumulation through the 5-matmul WY application; outputs
+        # round back to the tile storage dtype (bf16 in mp mode)
+        inner = (C1.astype(jnp.float32)
+                 + jnp.matmul(V.T, C2, preferred_element_type=jnp.float32))
+        Z = jnp.matmul(Tt, inner.astype(Tt.dtype),
+                       preferred_element_type=jnp.float32)
+        C2n = C2.astype(jnp.float32) - jnp.matmul(
+            V, Z.astype(V.dtype), preferred_element_type=jnp.float32)
+        return {"C1": (C1.astype(jnp.float32) - Z).astype(C1.dtype),
+                "C2": C2n.astype(C2.dtype)}
     return fn
 
 

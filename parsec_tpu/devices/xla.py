@@ -968,6 +968,32 @@ class XlaDevice(Device):
             self.stats.bytes_out += getattr(dc.payload, "nbytes", 0)
         datum.pull_to_host()
 
+    def discard_scratch(self) -> None:
+        """Drop device copies of collection-less datums (NEW-flow arena
+        temporaries) WITHOUT writeback, with full accounting — the
+        quiescent-point twin of flush() for data nobody user-visible
+        will ever read.  Benches call it before teardown so fini's
+        flush does not D2H gigabytes of dead QR panels / potrf
+        inverses through a slow link."""
+        with self._mem_lock:
+            for key in list(self._lru.keys()):
+                dcref, sz, voff = self._lru[key]
+                dc = dcref()
+                if dc is None:
+                    del self._lru[key]
+                    self._bytes_used -= sz
+                    self._zone_free(voff)
+                    continue
+                datum = dc.data
+                if datum is None or datum.collection is not None:
+                    continue   # user-visible data keeps flush semantics
+                del self._lru[key]
+                datum.detach_copy(self.space)
+                dc.payload = None
+                dc.coherency = Coherency.INVALID
+                self._bytes_used -= sz
+                self._zone_free(voff)
+
     def flush(self) -> None:
         """Push every authoritative device copy home (reference:
         parsec_dtd_data_flush_all / GPU w2r writeback tasks).  Flush is a
