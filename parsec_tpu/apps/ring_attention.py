@@ -1,0 +1,100 @@
+"""Ring attention: the long-context flagship over the ring pipeline.
+
+The reference has no attention dimension (SURVEY §5.7 — its dataflow
+rings are the PRIMITIVE); this app is the TPU-first instantiation the
+survey prescribes: sequence parallelism where each of P parties holds
+one query block resident and the K/V blocks circulate the ring, with a
+numerically-stable ONLINE-SOFTMAX accumulator (the flash-attention
+recurrence) as the per-visit combine.  After P rounds every query block
+has attended over the FULL sequence while only (2·Tkv·d)-sized KV
+payloads ever moved — the classic ring-attention data movement, here as
+a plain PTG over the runtime's neighbor-exchange schedule
+(apps/ring.py), so it runs single-chip, over the multi-device ICI
+preplace path, or across ranks on the comm engine unchanged.
+
+Packing (everything rides two TiledMatrix collections):
+- circulating block ``V(q)``: ``[K_q ; V_q]`` stacked — (2·Tkv, d)
+- resident accumulator ``A(q)``: ``[Q_q | O | m | l]`` — (Tq, 2d+2)
+  with the running output O, row-max m, and row-denominator l of the
+  online softmax.  ``finalize`` unpacks O/l into the attention output.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from parsec_tpu.apps.ring import ring_pipeline_taskpool
+from parsec_tpu.core.taskpool import ParameterizedTaskpool
+from parsec_tpu.data.matrix import TiledMatrix
+
+
+def pack_query(Q: np.ndarray) -> np.ndarray:
+    """Initial accumulator [Q | O=0 | m=-inf | l=0] for one query block."""
+    Tq, d = Q.shape
+    acc = np.zeros((Tq, 2 * d + 2), np.float32)
+    acc[:, :d] = Q
+    acc[:, 2 * d] = -np.inf
+    return acc
+
+
+def pack_kv(K: np.ndarray, V: np.ndarray) -> np.ndarray:
+    return np.concatenate([K, V], axis=0).astype(np.float32)
+
+
+def unpack_output(acc: np.ndarray, d: int) -> np.ndarray:
+    """O / l — the softmax-normalized attention output."""
+    o = acc[:, d:2 * d]
+    l = acc[:, 2 * d + 1:2 * d + 2]
+    return o / np.maximum(l, 1e-30)
+
+
+def _combine(acc, blk, xp):
+    """One online-softmax visit: fold KV block ``blk`` into ``acc``
+    (the flash-attention m/l/O recurrence, jax- and numpy-generic)."""
+    d = (acc.shape[1] - 2) // 2
+    Tkv = blk.shape[0] // 2
+    q = acc[:, :d]
+    o = acc[:, d:2 * d]
+    m = acc[:, 2 * d]
+    l = acc[:, 2 * d + 1]
+    k = blk[:Tkv]
+    v = blk[Tkv:]
+    s = (q @ k.T) * (1.0 / np.sqrt(d))
+    m_new = xp.maximum(m, s.max(axis=-1))
+    p = xp.exp(s - m_new[:, None])
+    alpha = xp.exp(m - m_new)
+    l_new = alpha * l + p.sum(axis=-1)
+    o_new = alpha[:, None] * o + p @ v
+    parts = [q, o_new, m_new[:, None], l_new[:, None]]
+    return xp.concatenate(parts, axis=1)
+
+
+def _combine_np(acc, blk):
+    return _combine(np.asarray(acc, np.float32),
+                    np.asarray(blk, np.float32), np)
+
+
+def _combine_jax(acc, blk):
+    import jax.numpy as jnp
+    return _combine(acc.astype(jnp.float32), blk.astype(jnp.float32),
+                    jnp)
+
+
+def ring_attention_taskpool(KV: TiledMatrix, ACC: TiledMatrix,
+                            device: str = "cpu") -> ParameterizedTaskpool:
+    """P-party ring attention: ``KV(q)`` are the circulating packed
+    [K;V] blocks, ``ACC(q)`` the resident packed [Q|O|m|l] accumulators
+    (fill with pack_query/pack_kv; read back with unpack_output)."""
+    combine = _combine_jax if device in ("tpu", "xla", "gpu") \
+        else _combine_np
+    return ring_pipeline_taskpool(KV, ACC, combine=combine,
+                                  device=device)
+
+
+def dense_reference(Q: np.ndarray, K: np.ndarray,
+                    V: np.ndarray) -> np.ndarray:
+    """Materialized-softmax attention over the full sequence."""
+    d = Q.shape[1]
+    s = (Q @ K.T) / np.sqrt(d)
+    p = np.exp(s - s.max(axis=-1, keepdims=True))
+    return (p / p.sum(axis=-1, keepdims=True)) @ V

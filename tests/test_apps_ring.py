@@ -67,3 +67,63 @@ def test_ring_across_4_ranks():
     """The ring's neighbor hops cross ranks: every edge is one
     interconnect message (the DCN case of the §5.7 story)."""
     assert run_distributed(_ring_ranks, 4, timeout=240) == ["ok"] * 4
+
+
+# -- ring attention (SURVEY §5.7 long-context flagship) ---------------------
+
+def _attn_setup(P, Tq, d, seed):
+    from parsec_tpu.apps.ring_attention import pack_kv, pack_query
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    rng = np.random.default_rng(seed)
+    Q = rng.standard_normal((P * Tq, d)).astype(np.float32)
+    K = rng.standard_normal((P * Tq, d)).astype(np.float32)
+    V = rng.standard_normal((P * Tq, d)).astype(np.float32)
+    KV = TwoDimBlockCyclic(mb=2 * Tq, nb=d, lm=P * 2 * Tq, ln=d,
+                           name="KV")
+    ACC = TwoDimBlockCyclic(mb=Tq, nb=2 * d + 2, lm=P * Tq, ln=2 * d + 2,
+                            name="ACC")
+    for q in range(P):
+        KV.data_of(q, 0).overwrite_host(
+            pack_kv(K[q * Tq:(q + 1) * Tq], V[q * Tq:(q + 1) * Tq]))
+        ACC.data_of(q, 0).overwrite_host(
+            pack_query(Q[q * Tq:(q + 1) * Tq]))
+    return Q, K, V, KV, ACC
+
+
+def _attn_check(ACC, Q, K, V, P, Tq, d):
+    from parsec_tpu.apps.ring_attention import (dense_reference,
+                                                unpack_output)
+    want = dense_reference(Q, K, V)
+    for q in range(P):
+        acc = np.asarray(ACC.data_of(q, 0).pull_to_host().payload)
+        got = unpack_output(acc, d)
+        np.testing.assert_allclose(got, want[q * Tq:(q + 1) * Tq],
+                                   rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("device", ["cpu", "tpu"])
+def test_ring_attention_matches_dense(device):
+    """P-party ring attention over the runtime's neighbor-exchange
+    schedule equals materialized-softmax attention over the full
+    sequence."""
+    from parsec_tpu.apps.ring_attention import ring_attention_taskpool
+    P, Tq, d = 4, 8, 16
+    Q, K, V, KV, ACC = _attn_setup(P, Tq, d, seed=11)
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(ring_attention_taskpool(KV, ACC, device=device))
+        ctx.wait(timeout=120)
+    _attn_check(ACC, Q, K, V, P, Tq, d)
+
+
+def test_ring_attention_multi_device_mesh():
+    """Ring attention over the virtual device mesh: KV blocks hop the
+    ICI preplace path between per-device resident accumulators."""
+    from parsec_tpu.apps.ring_attention import ring_attention_taskpool
+    P, Tq, d = 4, 4, 8
+    Q, K, V, KV, ACC = _attn_setup(P, Tq, d, seed=12)
+    with Context(nb_cores=4) as ctx:
+        KV.distribute_devices(ctx)
+        ACC.distribute_devices(ctx)
+        ctx.add_taskpool(ring_attention_taskpool(KV, ACC, device="tpu"))
+        ctx.wait(timeout=120)
+    _attn_check(ACC, Q, K, V, P, Tq, d)
