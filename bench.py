@@ -495,9 +495,13 @@ def run_tasks_bench(n: int = 20000):
     return n / dt
 
 
-def run_stencil_bench(mb: int = 1 << 20, nt: int = 8, steps: int = 16):
+def run_stencil_bench(mb: int = 0, nt: int = 8, steps: int = 16):
     """Sustained 1D 3-point stencil throughput through the runtime,
-    points/s (testing_stencil_1D analog)."""
+    points/s (testing_stencil_1D analog).  The probe fills HOST tiles,
+    so tile size trades per-launch latency against H2D staging cost;
+    override via PARSEC_BENCH_MB."""
+    if not mb:
+        mb = int(os.environ.get("PARSEC_BENCH_MB", 1 << 20))
     from parsec_tpu.apps.stencil import stencil_taskpool
     from parsec_tpu.core.context import Context
     from parsec_tpu.data.matrix import VectorTwoDimCyclic
