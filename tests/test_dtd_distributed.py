@@ -586,3 +586,49 @@ def _region_output_then_whole_read(ctx, rank, nranks):
 def test_dtd_region_output_lane_then_whole_read():
     assert run_distributed(_region_output_then_whole_read, 2,
                            timeout=240) == ["ok"] * 2
+
+
+def _region_four_rank_quarters(ctx, rank, nranks):
+    """4 ranks each own one quarter-lane of a rank-0-home tile and
+    chain privately over 3 rounds; every rank then reads the whole
+    tile.  Exercises v0 pulls, lane surrogates, sliced payloads, and
+    version-aware flushes under maximal interleaving."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, INPUT, OUTPUT, Region
+
+    V = VectorTwoDimCyclic(mb=16, lm=16, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 0.0
+    R = VectorTwoDimCyclic(mb=16, lm=16 * nranks, nodes=nranks,
+                           myrank=rank, name="R")
+    for m, _ in R.local_tiles():
+        R.data_of(m).copy_on(0).payload[:] = -1.0
+    tp = _make_pool(ctx)
+    t = tp.tile_of(V, 0)
+    quarters = [Region(f"q{i}", slices=(slice(4 * i, 4 * i + 4),))
+                for i in range(4)]
+
+    def bump(i):
+        def body(T):
+            out = np.asarray(T).copy()
+            out[4 * i:4 * i + 4] += i + 1
+            return out
+        return body
+
+    for _ in range(3):
+        for i, q in enumerate(quarters):
+            tp.insert_task(bump(i), (t, INOUT | q), (i, AFFINITY))
+    for r in range(nranks):
+        tp.insert_task(lambda s, o: np.asarray(s).copy(),
+                       (t, INPUT), (tp.tile_of(R, r), OUTPUT))
+    tp.wait(timeout=180)
+    ctx.wait(timeout=180)
+    want = np.repeat(np.arange(1.0, 5.0) * 3, 4).astype(np.float32)
+    got = np.asarray(R.data_of(rank).pull_to_host().payload)
+    np.testing.assert_allclose(got, want)
+    return "ok"
+
+
+def test_dtd_region_four_rank_quarter_lanes():
+    assert run_distributed(_region_four_rank_quarters, 4,
+                           timeout=300) == ["ok"] * 4
