@@ -29,11 +29,23 @@ from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
 
 def ring_pipeline_taskpool(V: TiledMatrix, A: TiledMatrix,
                            combine: Optional[Callable] = None,
-                           device: str = "cpu") -> ParameterizedTaskpool:
+                           device: str = "cpu",
+                           visit_class: Optional[Callable] = None
+                           ) -> ParameterizedTaskpool:
     """Build the P-party ring: ``V(q)`` are the circulating blocks,
     ``A(q)`` the resident accumulators (initialized by the caller;
     updated as ``A(q) = combine(A(q), block)`` once per visiting block).
-    Default ``combine`` is addition — the ring-allreduce instance."""
+    Default ``combine`` is addition — the ring-allreduce instance.
+
+    Position-dependent operators: a combine whose parameters are
+    literally named ``(acc, blk, q, t)`` receives the party and round
+    indices ((q - t) mod P recovers which block is visiting).  For
+    DEVICE combines prefer declaring ``(acc, blk, vc)`` with a
+    ``visit_class(q, t) -> small int``: the class rides as a derived
+    task parameter, so the kernel compiles once per CLASS (e.g. 3
+    causal variants) instead of once per (q, t) pair — per-(q,t)
+    statics would defeat wavefront launch fusion and trigger P^2
+    recompiles."""
     P = V.mt
     if A.mt != P:
         raise ValueError("one accumulator per party")
@@ -41,15 +53,43 @@ def ring_pipeline_taskpool(V: TiledMatrix, A: TiledMatrix,
         def combine(acc, blk):
             return np.asarray(acc) + np.asarray(blk)
 
-    def body(B, Acc):
-        return {"Acc": combine(Acc, B)}
+    # protocol detection by parameter NAMES (arity would be spoofed by
+    # unrelated optional params — a 2-ary combine with two kwargs must
+    # not silently receive q/t)
+    import inspect
+    pnames = list(inspect.signature(combine).parameters)
+    wants_vc = "vc" in pnames
+    wants_pos = (not wants_vc) and "q" in pnames and "t" in pnames
+    if wants_vc and visit_class is None:
+        raise ValueError("a combine declaring 'vc' needs visit_class=")
+
+    if wants_vc:
+        def body(B, Acc, vc):
+            return {"Acc": combine(Acc, B, vc)}
+    elif wants_pos:
+        def body(B, Acc, q, t):
+            return {"Acc": combine(Acc, B, q, t)}
+    else:
+        def body(B, Acc):
+            return {"Acc": combine(Acc, B)}
 
     p = PTG("ring", P=P)
     # R(q, t): party q, round t.  Round 0 combines the party's OWN block
     # and launches it around the ring; round t receives the block that
     # started at party (q - t) mod P and forwards it until it has
-    # visited everyone.
-    tb = p.task("R", q=Range(0, P - 1), t=Range(0, P - 1)) \
+    # visited everyone.  ``vc`` (when requested) is a derived 1-value
+    # parameter — the JDF derived-local idiom — so it lands in
+    # task.locals and binds to kernels by name.
+    params = dict(q=Range(0, P - 1), t=Range(0, P - 1))
+    if wants_vc:
+        params["vc"] = Range(lambda q, t: int(visit_class(q, t)),
+                             lambda q, t: int(visit_class(q, t)))
+    tb = p.task("R", **params)
+    if wants_vc:
+        # dep expressions name peers by (q, t) alone — vc is derived,
+        # so it must not participate in the task key
+        tb.make_key(lambda q, t: (q, t))
+    tb = tb \
         .affinity(lambda q, A=A: A(q)) \
         .priority(lambda t, P=P: P - t) \
         .flow("B", "READ",
@@ -69,8 +109,15 @@ def ring_pipeline_taskpool(V: TiledMatrix, A: TiledMatrix,
               OUT(DATA(lambda q, A=A: A(q)),
                   when=lambda t, P=P: t == P - 1))
     if device in ("tpu", "xla", "gpu"):
-        def kernel(B, Acc):
-            return combine(Acc, B)
+        if wants_vc:
+            def kernel(B, Acc, vc):
+                return combine(Acc, B, vc)
+        elif wants_pos:
+            def kernel(B, Acc, q, t):
+                return combine(Acc, B, q, t)
+        else:
+            def kernel(B, Acc):
+                return combine(Acc, B)
         tb.body(kernel, device=device)
     tb.body(body)
     return p.build()

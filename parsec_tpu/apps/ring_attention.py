@@ -28,12 +28,18 @@ from parsec_tpu.core.taskpool import ParameterizedTaskpool
 from parsec_tpu.data.matrix import TiledMatrix
 
 
+#: finite "minus infinity" for the running row-max: a literal -inf
+#: would make the fully-masked causal case produce exp(-inf - -inf)
+#: = exp(nan); masked probabilities are zeroed explicitly instead
+_NEG = -1e30
+
+
 def pack_query(Q: np.ndarray) -> np.ndarray:
-    """Initial accumulator [Q | O=0 | m=-inf | l=0] for one query block."""
+    """Initial accumulator [Q | O=0 | m=-NEG | l=0] for one query block."""
     Tq, d = Q.shape
     acc = np.zeros((Tq, 2 * d + 2), np.float32)
     acc[:, :d] = Q
-    acc[:, 2 * d] = -np.inf
+    acc[:, 2 * d] = _NEG
     return acc
 
 
@@ -48,9 +54,12 @@ def unpack_output(acc: np.ndarray, d: int) -> np.ndarray:
     return o / np.maximum(l, 1e-30)
 
 
-def _combine(acc, blk, xp):
+def _combine(acc, blk, xp, mask=None):
     """One online-softmax visit: fold KV block ``blk`` into ``acc``
-    (the flash-attention m/l/O recurrence, jax- and numpy-generic)."""
+    (the flash-attention m/l/O recurrence, jax- and numpy-generic).
+    ``mask`` (Tq, Tkv) of 0/1 zeroes disallowed probabilities — scores
+    are shifted to _NEG AND p is multiplied by the mask, so a fully
+    masked block is an exact no-op (l and O unchanged)."""
     d = (acc.shape[1] - 2) // 2
     Tkv = blk.shape[0] // 2
     q = acc[:, :d]
@@ -60,8 +69,12 @@ def _combine(acc, blk, xp):
     k = blk[:Tkv]
     v = blk[Tkv:]
     s = (q @ k.T) * (1.0 / np.sqrt(d))
+    if mask is not None:
+        s = s * mask + _NEG * (1.0 - mask)
     m_new = xp.maximum(m, s.max(axis=-1))
     p = xp.exp(s - m_new[:, None])
+    if mask is not None:
+        p = p * mask
     alpha = xp.exp(m - m_new)
     l_new = alpha * l + p.sum(axis=-1)
     o_new = alpha[:, None] * o + p @ v
@@ -80,21 +93,81 @@ def _combine_jax(acc, blk):
                     jnp)
 
 
+# Causal masking rides the ring's VISIT CLASS (0 = block fully in the
+# future: exact no-op; 1 = the diagonal block: lower triangle; 2 =
+# fully in the past: unmasked).  With equal query/KV block lengths the
+# diagonal mask is position-independent, so kernels compile once per
+# CLASS — three variants total — instead of once per (q, t) pair, and
+# wavefront launch fusion still groups same-class visits.
+
+
+def _causal_visit_class(P):
+    def vc(q, t):
+        kv = (q - t) % P
+        return 2 if kv < q else (1 if kv == q else 0)
+    return vc
+
+
+def _combine_np_causal(Tq, Tkv):
+    diag = np.tril(np.ones((Tq, Tkv), np.float32))
+    def fn(acc, blk, vc):
+        a = np.asarray(acc, np.float32)
+        if int(vc) == 0:
+            return a
+        mask = None if int(vc) == 2 else diag
+        return _combine(a, np.asarray(blk, np.float32), np, mask)
+    return fn
+
+
+def _combine_jax_causal(Tq, Tkv):
+    def fn(acc, blk, vc):
+        import jax.numpy as jnp
+        # vc is a STATIC kernel argument (task-local, 3 values): the
+        # branch resolves at trace time into one of 3 compiled variants
+        if int(vc) == 0:
+            return acc.astype(jnp.float32)
+        mask = None if int(vc) == 2 \
+            else jnp.tril(jnp.ones((Tq, Tkv), jnp.float32))
+        return _combine(acc.astype(jnp.float32),
+                        blk.astype(jnp.float32), jnp, mask)
+    return fn
+
+
 def ring_attention_taskpool(KV: TiledMatrix, ACC: TiledMatrix,
-                            device: str = "cpu") -> ParameterizedTaskpool:
+                            device: str = "cpu",
+                            causal: bool = False) -> ParameterizedTaskpool:
     """P-party ring attention: ``KV(q)`` are the circulating packed
     [K;V] blocks, ``ACC(q)`` the resident packed [Q|O|m|l] accumulators
-    (fill with pack_query/pack_kv; read back with unpack_output)."""
-    combine = _combine_jax if device in ("tpu", "xla", "gpu") \
-        else _combine_np
+    (fill with pack_query/pack_kv; read back with unpack_output).
+    ``causal=True`` applies the global-position causal mask per visit
+    (block skips and the diagonal triangle fall out of one arithmetic
+    mask, so the ring schedule is unchanged)."""
+    on_dev = device in ("tpu", "xla", "gpu")
+    if causal:
+        P = KV.mt
+        Tkv = KV.mb // 2
+        Tq = ACC.mb
+        if Tq != Tkv:
+            raise ValueError(
+                "causal ring attention needs equal query/KV block "
+                "lengths (the diagonal mask is then class-invariant)")
+        combine = _combine_jax_causal(Tq, Tkv) if on_dev \
+            else _combine_np_causal(Tq, Tkv)
+        return ring_pipeline_taskpool(
+            KV, ACC, combine=combine, device=device,
+            visit_class=_causal_visit_class(P))
+    combine = _combine_jax if on_dev else _combine_np
     return ring_pipeline_taskpool(KV, ACC, combine=combine,
                                   device=device)
 
 
-def dense_reference(Q: np.ndarray, K: np.ndarray,
-                    V: np.ndarray) -> np.ndarray:
+def dense_reference(Q: np.ndarray, K: np.ndarray, V: np.ndarray,
+                    causal: bool = False) -> np.ndarray:
     """Materialized-softmax attention over the full sequence."""
     d = Q.shape[1]
     s = (Q @ K.T) / np.sqrt(d)
+    if causal:
+        n = Q.shape[0]
+        s = np.where(np.tril(np.ones((n, n), bool)), s, -np.inf)
     p = np.exp(s - s.max(axis=-1, keepdims=True))
     return (p / p.sum(axis=-1, keepdims=True)) @ V

@@ -67,6 +67,10 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
                 source: Optional[Tuple[TaskClass, Tuple]]) -> Optional[Task]:
     """Record one dependency arrival at a local successor; return the
     instantiated Task exactly when it becomes ready."""
+    # dep expressions may address peers by their FREE parameters only;
+    # derived single-value params (JDF derived-local idiom) are filled
+    # here so the instantiated task carries the full local set
+    succ_locals = succ_tc.complete_locals(succ_locals)
     key = succ_tc.make_key(succ_locals)
 
     def fn(rec):

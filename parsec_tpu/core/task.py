@@ -264,6 +264,28 @@ class TaskClass:
     def key_to_locals(self, key: Tuple) -> Dict[str, int]:
         return {p: key[1 + i] for i, (p, _) in enumerate(self.params)}
 
+    def complete_locals(self, locals_: Dict[str, int]) -> Dict[str, int]:
+        """Fill DERIVED parameters absent from a dep-provided params
+        dict (single-value ranges over earlier params — the JDF
+        derived-local idiom, e.g. the ring's visit class): dep
+        expressions may name peers by the free parameters alone, but
+        task instances carry the full local set.  A missing param whose
+        range holds more than one value is a real addressing error."""
+        if all(p in locals_ for p, _ in self.params):
+            return locals_
+        out = dict(locals_)
+        g = self.taskpool.globals if self.taskpool is not None else {}
+        for name, range_fn in self.params:
+            if name in out:
+                continue
+            vals = list(range_fn(g, out))
+            if len(vals) != 1:
+                raise KeyError(
+                    f"{self.name}: dep params missing {name!r}, which "
+                    f"is not single-valued ({len(vals)} candidates)")
+            out[name] = vals[0]
+        return out
+
     # -- parameter space ---------------------------------------------------
     def iter_space(self, globals_: Dict[str, Any]) -> Iterable[Dict[str, int]]:
         """Enumerate the full parameter space (generated startup loops in the

@@ -90,10 +90,10 @@ def _attn_setup(P, Tq, d, seed):
     return Q, K, V, KV, ACC
 
 
-def _attn_check(ACC, Q, K, V, P, Tq, d):
+def _attn_check(ACC, Q, K, V, P, Tq, d, causal=False):
     from parsec_tpu.apps.ring_attention import (dense_reference,
                                                 unpack_output)
-    want = dense_reference(Q, K, V)
+    want = dense_reference(Q, K, V, causal=causal)
     for q in range(P):
         acc = np.asarray(ACC.data_of(q, 0).pull_to_host().payload)
         got = unpack_output(acc, d)
@@ -101,18 +101,21 @@ def _attn_check(ACC, Q, K, V, P, Tq, d):
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("device", ["cpu", "tpu"])
-def test_ring_attention_matches_dense(device):
+def test_ring_attention_matches_dense(device, causal):
     """P-party ring attention over the runtime's neighbor-exchange
     schedule equals materialized-softmax attention over the full
-    sequence."""
+    sequence — causal masking included (block skips + the diagonal
+    triangle fall out of the global-position mask)."""
     from parsec_tpu.apps.ring_attention import ring_attention_taskpool
     P, Tq, d = 4, 8, 16
     Q, K, V, KV, ACC = _attn_setup(P, Tq, d, seed=11)
     with Context(nb_cores=4) as ctx:
-        ctx.add_taskpool(ring_attention_taskpool(KV, ACC, device=device))
+        ctx.add_taskpool(ring_attention_taskpool(KV, ACC, device=device,
+                                                 causal=causal))
         ctx.wait(timeout=120)
-    _attn_check(ACC, Q, K, V, P, Tq, d)
+    _attn_check(ACC, Q, K, V, P, Tq, d, causal=causal)
 
 
 def test_ring_attention_multi_device_mesh():
