@@ -466,9 +466,17 @@ def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
     the bench declares eager coverage for its own message size — the
     same choice bandwidth.jdf runs make via MCA."""
     from parsec_tpu.comm.launch import run_distributed
+    prior = os.environ.get("PARSEC_MCA_comm_eager_limit")
     os.environ.setdefault("PARSEC_MCA_comm_eager_limit",
                           str(nbytes * 2))
-    res = run_distributed(_pp_worker, 2, args=(nbytes, hops), timeout=300)
+    try:
+        res = run_distributed(_pp_worker, 2, args=(nbytes, hops),
+                              timeout=300)
+    finally:
+        if prior is None:
+            os.environ.pop("PARSEC_MCA_comm_eager_limit", None)
+        else:
+            os.environ["PARSEC_MCA_comm_eager_limit"] = prior
     return float(np.mean([r[1] for r in res]))
 
 

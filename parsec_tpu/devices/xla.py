@@ -988,9 +988,14 @@ class XlaDevice(Device):
                 if datum is None or datum.collection is not None:
                     continue   # user-visible data keeps flush semantics
                 del self._lru[key]
-                datum.detach_copy(self.space)
-                dc.payload = None
-                dc.coherency = Coherency.INVALID
+                # _mem_lock -> datum._lock is the established order
+                # (_reserve's eviction path writes back under it), so
+                # taking the per-datum lock here is deadlock-free and
+                # closes the window against concurrent flush/pull
+                with datum._lock:
+                    datum.detach_copy(self.space)
+                    dc.payload = None
+                    dc.coherency = Coherency.INVALID
                 self._bytes_used -= sz
                 self._zone_free(voff)
 

@@ -40,6 +40,7 @@ the taskpool globals — the same binding rules the generated code uses
 
 from __future__ import annotations
 
+import ast
 import re
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -133,6 +134,55 @@ def _translate_ternary(s: str) -> str:
     return s
 
 
+def _c_div(a, b):
+    """C ``/`` semantics: truncation toward zero when both operands are
+    integral (Python ``/`` would yield a float and silently build a
+    wrong task space), true division otherwise."""
+    if isinstance(a, (int, np.integer)) and isinstance(b, (int, np.integer)):
+        q = abs(a) // abs(b)
+        return -q if (a < 0) != (b < 0) else q
+    return a / b
+
+
+def _c_mod(a, b):
+    """C ``%`` semantics: remainder truncates toward zero (Python floors)."""
+    if isinstance(a, (int, np.integer)) and isinstance(b, (int, np.integer)):
+        return a - _c_div(a, b) * b
+    return a % b
+
+
+#: names injected into every namespace that evaluates c2py() output
+C_EVAL_HELPERS = {"_c_div": _c_div, "_c_mod": _c_mod}
+
+
+class _CArithTransform(ast.NodeTransformer):
+    """Rewrite ``a / b`` -> ``_c_div(a, b)`` and ``a % b`` ->
+    ``_c_mod(a, b)`` so integral JDF index math keeps C semantics."""
+
+    def visit_BinOp(self, node):
+        self.generic_visit(node)
+        fn = ("_c_div" if isinstance(node.op, ast.Div)
+              else "_c_mod" if isinstance(node.op, ast.Mod) else None)
+        if fn is None:
+            return node
+        return ast.copy_location(
+            ast.Call(func=ast.Name(id=fn, ctx=ast.Load()),
+                     args=[node.left, node.right], keywords=[]), node)
+
+
+def _c_arith(expr: str) -> str:
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError:
+        return expr     # let the later compile step surface the error
+    if not any(isinstance(n, ast.BinOp) and
+               isinstance(n.op, (ast.Div, ast.Mod))
+               for n in ast.walk(tree)):
+        return expr
+    return ast.unparse(ast.fix_missing_locations(
+        _CArithTransform().visit(tree)))
+
+
 def c2py(expr: str) -> str:
     """Translate a C expression (as appearing in JDF ranges, guards and
     index expressions) to Python source."""
@@ -144,7 +194,7 @@ def c2py(expr: str) -> str:
     # logical not: '!' not part of '!='
     expr = re.sub(r"!(?!=)", " not ", expr)
     expr = _translate_ternary(expr)
-    return expr.strip()
+    return _c_arith(expr.strip())
 
 
 def _split_top(s: str, sep: str) -> List[str]:
@@ -478,7 +528,8 @@ def jdf_taskpool(source: str, *, globals: Optional[Dict[str, Any]] = None,
         if data and g.name in data:
             gvals[g.name] = data[g.name]    # collection-typed global
         elif "default" in g.props:
-            gvals[g.name] = eval(c2py(g.props["default"]), {}, {})
+            gvals[g.name] = eval(c2py(g.props["default"]),
+                                 dict(C_EVAL_HELPERS), {})
         else:
             raise JdfError(f"JDF global {g.name!r} has no value: pass "
                            f"globals={{{g.name!r}: ...}}")
@@ -489,6 +540,7 @@ def jdf_taskpool(source: str, *, globals: Optional[Dict[str, Any]] = None,
     env = dict(gvals)
     env.update(dmap)
     env["np"] = np
+    env.update(C_EVAL_HELPERS)
 
     p = PTG(name or (jdf.tasks[0].name.lower() if jdf.tasks else "jdf"),
             **{k: v for k, v in gvals.items()

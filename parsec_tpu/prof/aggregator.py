@@ -51,7 +51,13 @@ class Aggregator:
 
     # -- ingest ------------------------------------------------------------
     def _accept_loop(self) -> None:
-        self._srv.settimeout(0.2)
+        # close() flags _stop and joins this thread BEFORE closing the
+        # socket, so the fd stays valid for the life of the loop; the
+        # guard covers the join-timeout fallback where close() proceeds.
+        try:
+            self._srv.settimeout(0.2)
+        except OSError:
+            return
         while not self._stop:
             try:
                 conn, _addr = self._srv.accept()
@@ -88,17 +94,21 @@ class Aggregator:
     def ingest(self, rank: int, gauges: Dict[str, float],
                t: Optional[float] = None) -> None:
         t = time.time() if t is None else t
+        # The wire accepts arbitrary JSON values; keep only numerics so
+        # render_table/history never see a string/null from a publisher.
+        clean = {k: float(v) for k, v in gauges.items()
+                 if isinstance(v, (int, float))}
         with self._lock:
-            self._latest[rank] = dict(gauges)
+            self._latest[rank] = dict(clean)
             self._seen_at[rank] = t
-            for k, v in gauges.items():
+            for k, v in clean.items():
                 h = self._hist.get((rank, k))
                 if h is None:
                     h = self._hist[(rank, k)] = deque(maxlen=self._history)
-                h.append((t, float(v)))
+                h.append((t, v))
             subs = list(self._subs)
         for cb in subs:
-            cb(rank, gauges)
+            cb(rank, clean)
 
     # -- consume -----------------------------------------------------------
     def subscribe(self, cb: Callable[[int, Dict[str, float]], None]):
@@ -133,6 +143,7 @@ class Aggregator:
 
     def close(self) -> None:
         self._stop = True
+        self._thread.join(timeout=2)
         try:
             self._srv.close()
         except OSError:

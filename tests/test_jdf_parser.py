@@ -309,3 +309,18 @@ def test_inline_c_statement_subset():
     # still rejects what the subset cannot express
     with pytest.raises(JdfError):
         c2py("%{ for (i = 0; i < 3; i++) x += i; return x; %}")
+
+
+def test_inline_c_integer_division():
+    """ADVICE r4 (medium): C '/' and '%' on integral operands keep C
+    truncating semantics through translation; floats keep true division."""
+    from parsec_tpu.dsl.ptg.jdf import C_EVAL_HELPERS, c2py
+    ns = dict(C_EVAL_HELPERS)
+    assert eval(c2py("%{ int r = k / 2; return r; %}"), {**ns, "k": 3}) == 1
+    assert eval(c2py("k / 2"), {**ns, "k": 7}) == 3
+    assert eval(c2py("(0 - 7) / 2"), ns) == -3     # truncation toward zero
+    assert eval(c2py("(0 - 7) % 2"), ns) == -1     # C remainder sign
+    assert eval(c2py("k / 2.0"), {**ns, "k": 7}) == 3.5
+    # compound '/=' goes through the same rewrite
+    assert eval(c2py("%{ int r = k; r /= 2; return r; %}"),
+                {**ns, "k": 9}) == 4

@@ -392,3 +392,19 @@ def test_aggregator_live_with_runtime_gauges():
         assert agg.totals()["tasks_retired"] >= nt
     finally:
         agg.close()
+
+
+def test_aggregator_nonnumeric_ingest_and_clean_close():
+    """ADVICE r4: a publisher sending string/null gauges must not crash
+    render_table; close() joins the accept thread (VERDICT r4 #9)."""
+    from parsec_tpu.prof.aggregator import Aggregator, render_table
+    agg = Aggregator(port=0)
+    try:
+        agg.ingest(0, {"ok": 3, "bad": "oops", "worse": None, "f": 1.5})
+        t = agg.table()
+        assert "bad" not in t[0] and "worse" not in t[0]
+        assert t[0]["ok"] == 3.0
+        render_table(t, agg.totals())     # must not raise
+    finally:
+        agg.close()
+    assert not agg._thread.is_alive()
