@@ -106,6 +106,12 @@ class RemoteDepEngine:
         self._app_sent = 0
         self._app_recv = 0
         self._retry_pending = False
+        #: dynamic taskpools holding a runtime action until the
+        #: pool-scoped quiescence round proves global drain (the
+        #: reference's dynamic/fourcounter termdet role for
+        #: %option dynamic pools)
+        self._dyn_holds: List = []
+        self._dyn_released = threading.Event()
         ce.on_error = self._on_handler_error
         # Funnelled progress: socket recv threads only ENQUEUE; one
         # dedicated comm-progress thread runs the dep-engine work and
@@ -561,7 +567,8 @@ class RemoteDepEngine:
             return self._app_sent - self._app_recv
 
     def _termdet_cb(self, src: int, msg: dict) -> None:
-        if msg.get("kind") == "terminate":
+        kind = msg.get("kind")
+        if kind == "terminate":
             if self.rank != 0:
                 nxt = (self.rank + 1) % self.nranks
                 if nxt != 0:
@@ -569,13 +576,25 @@ class RemoteDepEngine:
                                     {"kind": "terminate"})
             self._terminated.set()
             return
+        if kind == "dyn_release":
+            if self.rank != 0:
+                nxt = (self.rank + 1) % self.nranks
+                if nxt != 0:
+                    self.ce.send_am(TAG_TERMDET, nxt,
+                                    {"kind": "dyn_release"})
+            self._release_dyn_holds()
+            return
         # token: wait until locally idle, then forward
-        threading.Thread(target=self._forward_token, args=(msg,),
+        threading.Thread(target=self._forward_token,
+                         args=(msg, kind == "dyn_token"),
                          daemon=True).start()
 
-    def _forward_token(self, token: dict) -> None:
-        while not self._local_idle():
-            if self._terminated.wait(0.01):
+    def _forward_token(self, token: dict, dyn: bool = False) -> None:
+        idle = self._dyn_idle if dyn else self._local_idle
+        done_evt = self._dyn_released if dyn else self._terminated
+        kind = "dyn_token" if dyn else "token"
+        while not idle():
+            if done_evt.wait(0.01):
                 return
         with self._term_lock:
             my_black = self._color_black
@@ -590,18 +609,95 @@ class RemoteDepEngine:
             if clean:
                 nxt = 1 % self.nranks
                 if nxt != 0:
-                    self.ce.send_am(TAG_TERMDET, nxt, {"kind": "terminate"})
-                self._terminated.set()
+                    self.ce.send_am(
+                        TAG_TERMDET, nxt,
+                        {"kind": "dyn_release" if dyn else "terminate"})
+                if dyn:
+                    self._release_dyn_holds()
+                else:
+                    self._terminated.set()
             else:
                 self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
-                    "kind": "token", "black": False, "balance": 0,
+                    "kind": kind, "black": False, "balance": 0,
                     "rounds": token["rounds"] + 1})
         else:
             self.ce.send_am(TAG_TERMDET, (self.rank + 1) % self.nranks, {
-                "kind": "token",
+                "kind": kind,
                 "black": token["black"] or my_black,
                 "balance": token["balance"] + self._balance(),
                 "rounds": token["rounds"]})
+
+    # -- dynamic-pool termination (reference: the DISTRIBUTED termdet
+    # behind ptgpp --dynamic-termdet; here a pool-scoped Safra round) ----
+    def register_dynamic_hold(self, tp) -> None:
+        """A DynamicTaskpool took a runtime-action hold at attach; it is
+        released only when resolve_dynamic_holds proves global drain."""
+        with self._term_lock:
+            self._dyn_holds.append(tp)
+
+    def _dyn_idle(self) -> bool:
+        """Locally drained MODULO the dynamic holds: every non-held pool
+        done, every held pool at zero tasks with only its hold pending,
+        and no parked protocol state (the Safra balance covers messages
+        in flight)."""
+        ctx = self.context
+        with self._dlock:
+            if self._delayed or self._dtd_backlog:
+                return False
+        if self._pending_gets or self.dtd_refs_pending or \
+                not self._cmdq.empty():
+            return False
+        with self._term_lock:
+            holds = list(self._dyn_holds)
+        with ctx._lock:
+            if ctx._active_taskpools != len(holds):
+                return False
+        return all(tp.nb_tasks == 0 and tp.nb_pending_actions == 1
+                   for tp in holds)
+
+    def _release_dyn_holds(self) -> None:
+        with self._term_lock:
+            holds, self._dyn_holds = self._dyn_holds, []
+        for tp in holds:
+            if getattr(tp, "_dyn_hold", False):
+                tp._dyn_hold = False
+                tp.termdet.taskpool_addto_runtime_actions(tp, -1)
+        self._dyn_released.set()
+
+    def resolve_dynamic_holds(self, timeout: float = 120.0) -> None:
+        """Block until every rank's dynamic pools drained with no
+        discovery message in flight, then release their holds everywhere
+        (called by Context.wait before the completion wait)."""
+        with self._term_lock:
+            if not self._dyn_holds:
+                return
+        if self.nranks == 1:
+            self._release_dyn_holds()
+            self._dyn_released.clear()
+            return
+        if self.rank == 0:
+            def kick():
+                while not self._dyn_idle():
+                    if self._dyn_released.wait(0.01):
+                        return
+                with self._term_lock:
+                    self._color_black = False
+                self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
+                    "kind": "dyn_token", "black": False, "balance": 0,
+                    "rounds": 0})
+            threading.Thread(target=kick, daemon=True).start()
+        import time
+        deadline = time.monotonic() + timeout
+        while not self._dyn_released.wait(0.05):
+            if self.ce.dead_peers:
+                raise ConnectionError(
+                    f"rank {self.rank}: dynamic-pool quiescence with "
+                    f"dead peer(s) {sorted(self.ce.dead_peers)}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rank {self.rank}: dynamic-pool termination not "
+                    "reached")
+        self._dyn_released.clear()
 
     def wait_quiescence(self, timeout: float = 120.0) -> None:
         """Block until every rank is idle and no message is in flight

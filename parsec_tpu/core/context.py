@@ -248,6 +248,11 @@ class Context:
         """Block until all enqueued taskpools complete
         (reference: parsec_context_wait:776)."""
         self.start()
+        if self.comm is not None:
+            # dynamic pools hold a runtime action until the pool-scoped
+            # quiescence round proves every rank drained (see
+            # DynamicTaskpool.attach); resolve before waiting on them
+            self.comm.resolve_dynamic_holds(timeout or 120.0)
         with self._cond:
             ok = self._cond.wait_for(
                 lambda: self._active_taskpools == 0 or self._errors,

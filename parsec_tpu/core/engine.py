@@ -99,6 +99,12 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
     if rec is None:
         return None
     task = Task(succ_tc, taskpool, rec.locals)
+    if taskpool.dynamic:
+        # dynamically-discovered pools count tasks as they materialize
+        # (reference: dynamic termdet, ptgpp --dynamic-termdet); the +1
+        # precedes the producer's -1 in complete_execution, so the count
+        # cannot transiently hit zero mid-discovery
+        taskpool.termdet.taskpool_addto_nb_tasks(taskpool, 1)
     task.data.update(rec.inputs)
     task.pinned_flows.update(k for k, v in rec.inputs.items()
                              if v is not None)
@@ -324,6 +330,10 @@ def release_deps(es, task: Task) -> List[Task]:
             elif isinstance(end, ToTask):
                 succ_tc = tp.task_classes[end.task_class]
                 for succ_locals in end.instances(task.locals):
+                    # dep expressions address peers by free params; fill
+                    # derived ones NOW — rank_of/make_key below may need
+                    # them (e.g. an affinity over a derived local)
+                    succ_locals = succ_tc.complete_locals(succ_locals)
                     if grapher is not None:
                         grapher.edge(task, succ_tc.make_key(succ_locals),
                                      flow.name)

@@ -39,6 +39,10 @@ class TaskpoolState(IntEnum):
 class Taskpool:
     """Base taskpool (reference: parsec_taskpool_t)."""
 
+    #: dynamically-discovered pools count tasks into nb_tasks as they are
+    #: instantiated (engine.deliver_dep) instead of at startup enumeration
+    dynamic = False
+
     def __init__(self, name: str = "taskpool",
                  globals_: Optional[Dict[str, Any]] = None):
         self.taskpool_id = next(_tp_ids)
@@ -153,6 +157,56 @@ class ParameterizedTaskpool(Taskpool):
                     ready.append(Task(tc, self, locals_))
         if nb_local:
             self.termdet.taskpool_addto_nb_tasks(self, nb_local)
+        return ready
+
+
+class DynamicTaskpool(ParameterizedTaskpool):
+    """Dynamically-discovered PTG pool (reference: ``%option dynamic``
+    / ptgpp --dynamic-termdet, interfaces/ptg/ptg-compiler/main.c:28-44;
+    the JDF customer is tests/apps/haar_tree/project_dyn.jdf): the
+    parameter space is too large or unknowable to enumerate, so startup
+    does NO enumeration — task classes carrying a ``startup_fn`` property
+    seed the DAG (the reference's generated-startup replacement,
+    project_dyn.jdf:109-159), every task discovered at runtime is counted
+    into ``nb_tasks`` the moment it is instantiated (engine.deliver_dep),
+    and termination fires when the in-flight count drains — dynamic
+    termination detection.  Bodies may overwrite derived locals on
+    ``task.locals`` (this_task->locals.X.value in the reference) to prune
+    output guards at runtime."""
+
+    dynamic = True
+
+    def attach(self, context, termdet) -> None:
+        super().attach(context, termdet)
+        if context is not None and getattr(context, "comm", None) \
+                is not None:
+            # Distributed dynamic pools must NOT terminate on a local
+            # zero count: a rank whose tasks all arrive by remote
+            # discovery (the project_dyn seeding pattern) would fire
+            # termination before the first activation lands, and a rank
+            # that transiently drains to zero while a discovery message
+            # is in flight would terminate early.  The reference needs a
+            # DISTRIBUTED termdet for exactly this (ptgpp
+            # --dynamic-termdet); here the pool takes a permanent
+            # runtime-action hold, released only when the comm layer's
+            # pool-scoped Safra round proves every rank drained with no
+            # discovery in flight (RemoteDepEngine.resolve_dynamic_holds).
+            self._dyn_hold = True
+            termdet.taskpool_addto_runtime_actions(self, 1)
+            context.comm.register_dynamic_hold(self)
+
+    def startup(self) -> List[Task]:
+        myrank = self.context.rank if self.context else 0
+        ready: List[Task] = []
+        for tc in self.task_classes.values():
+            fn = tc.properties.get("startup_fn")
+            if fn is None:
+                continue
+            for seed in fn(self.globals, myrank):
+                locals_ = tc.complete_locals(dict(seed))
+                ready.append(Task(tc, self, locals_))
+        if ready:
+            self.termdet.taskpool_addto_nb_tasks(self, len(ready))
         return ready
 
 

@@ -56,6 +56,15 @@ class DataCollection:
         backing storage (no-op for collections without a backing array)."""
 
     # -- convenience ------------------------------------------------------
+    @property
+    def super(self):
+        """C struct-embedding shim: the reference reaches the tiled-matrix
+        base as ``desc->super`` and the collection as ``desc->super.super``
+        (two_dim_rectangle_cyclic.h:24); Python flattens the embedding, so
+        the chain terminates on the object itself — JDF expressions like
+        ``dA->super.mt`` (kcyclic.jdf:111) read through unchanged."""
+        return self
+
     def is_local(self, *indices) -> bool:
         return self.rank_of(*indices) == self.myrank
 

@@ -55,12 +55,15 @@ def _instances(tp) -> List[Tuple[TaskClass, Dict[str, int]]]:
             for locals_ in tc.iter_space(tp.globals)]
 
 
-def _succ_locals(end: ToTask, loc):
-    return end.instances(loc)
+def _succ_locals(end: ToTask, loc, stc: TaskClass):
+    # fill derived single-valued params: dep expressions name peers by
+    # their free params only (mirrors engine.release_deps)
+    return [stc.complete_locals(s) for s in end.instances(loc)]
 
 
-def _src_locals(end: FromTask, loc) -> List[Dict[str, int]]:
-    return end.instances(loc)
+def _src_locals(end: FromTask, loc,
+                stc: TaskClass) -> List[Dict[str, int]]:
+    return [stc.complete_locals(s) for s in end.instances(loc)]
 
 
 def _topo_order(tp, instances):
@@ -75,7 +78,7 @@ def _topo_order(tp, instances):
                 if not isinstance(end, ToTask):
                     continue
                 stc = tp.task_classes[end.task_class]
-                for sloc in _succ_locals(end, loc):
+                for sloc in _succ_locals(end, loc, stc):
                     j = idx.get(stc.make_key(sloc))
                     if j is not None:
                         succs[i].append(j)
@@ -146,7 +149,7 @@ def run_ptg_as_dtd(src_tp, dtd_tp: DTDTaskpool) -> None:
                     if not isinstance(end, FromTask):
                         continue
                     stc = src_tp.task_classes[end.task_class]
-                    for sloc in _src_locals(end, loc):
+                    for sloc in _src_locals(end, loc, stc):
                         t = out_tiles.get((stc.make_key(sloc), end.flow))
                         if t is not None:
                             ctl_args.append((t, INPUT))
@@ -165,7 +168,7 @@ def run_ptg_as_dtd(src_tp, dtd_tp: DTDTaskpool) -> None:
             elif isinstance(end, FromTask):
                 tile = None
                 stc = src_tp.task_classes[end.task_class]
-                for sloc in _src_locals(end, loc):
+                for sloc in _src_locals(end, loc, stc):
                     tile = out_tiles.get((stc.make_key(sloc), end.flow))
                     if tile is not None:
                         break

@@ -72,8 +72,19 @@ def _named(fn: Callable) -> Callable[[Dict[str, int]], Any]:
 
 def _resolve(v: Any, globals_: Dict[str, Any], locals_: Dict[str, int]) -> int:
     if callable(v):
+        # signature introspection costs ~10us; Range bounds resolve once
+        # per parameter per enumeration node, so memoize the name list
+        # on the function itself (iter_space over an O(NT^3) space would
+        # otherwise pay it millions of times)
+        names = getattr(v, "_pt_argnames", None)
+        if names is None:
+            names = [p.name
+                     for p in inspect.signature(v).parameters.values()]
+            try:
+                v._pt_argnames = names
+            except AttributeError:
+                pass   # builtins/bound methods: uncached, still correct
         scope = {**globals_, **locals_}
-        names = [p.name for p in inspect.signature(v).parameters.values()]
         return v(**{n: scope[n] for n in names})
     return int(v)
 
@@ -325,6 +336,10 @@ class PTG:
         self.globals_ = dict(globals_)
         self._tasks: List[TaskBuilder] = []
         self._arenas: Dict[str, Arena] = {}
+        #: build a DynamicTaskpool instead (JDF ``%option dynamic = ON``):
+        #: no startup enumeration; task classes seed via the
+        #: ``startup_fn`` property and tasks are counted as discovered
+        self.dynamic = False
 
     def task(self, name: str, **params) -> TaskBuilder:
         tb = TaskBuilder(self, name, params)
@@ -337,7 +352,11 @@ class PTG:
         return self
 
     def build(self) -> ParameterizedTaskpool:
-        tp = ParameterizedTaskpool(self.name, globals_=self.globals_)
+        if self.dynamic:
+            from parsec_tpu.core.taskpool import DynamicTaskpool
+            tp = DynamicTaskpool(self.name, globals_=self.globals_)
+        else:
+            tp = ParameterizedTaskpool(self.name, globals_=self.globals_)
         for aname, arena in self._arenas.items():
             tp.add_arena(aname, arena)
         for tb in self._tasks:

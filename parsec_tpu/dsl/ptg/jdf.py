@@ -255,15 +255,33 @@ class JdfFlow:
 
 
 class JdfTask:
-    def __init__(self, name: str, params: List[str]):
+    def __init__(self, name: str, params: List[str],
+                 props: Optional[Dict[str, str]] = None):
         self.name = name
-        self.params = params
-        self.ranges: List[Tuple[str, str, str, Optional[str]]] = []
-        self.locals: List[Tuple[str, str]] = []      # derived, in order
+        self.params = params          # HEADER params: the free addressing
+        self.props = props or {}      # [ make_key_fn=... startup_fn=... ]
+        #: execution-space definitions in DECLARATION ORDER — ranges and
+        #: derived locals interleave (BT_reduction.jdf: a local between
+        #: two ranges feeds the later range's bounds)
+        self.defs: List[Tuple] = []   # ("range", n, lo, hi, step) |
+        #                               ("local", n, expr)
         self.partition: Optional[Tuple[str, List[str]]] = None
         self.flows: List[JdfFlow] = []
         self.body_src: str = ""
         self.body_props: Dict[str, str] = {}
+
+    @property
+    def ranges(self) -> List[Tuple[str, str, str, Optional[str]]]:
+        return [(d[1], d[2], d[3], d[4]) for d in self.defs
+                if d[0] == "range"]
+
+    @property
+    def locals(self) -> List[Tuple[str, str]]:
+        return [(d[1], d[2]) for d in self.defs if d[0] == "local"]
+
+    @property
+    def def_names(self) -> List[str]:
+        return [d[1] for d in self.defs]
 
 
 class JdfFile:
@@ -271,6 +289,7 @@ class JdfFile:
         self.externs: List[str] = []
         self.globals: List[JdfGlobal] = []
         self.tasks: List[JdfTask] = []
+        self.options: Dict[str, str] = {}     # %option lines
 
 
 # ---------------------------------------------------------------------------
@@ -364,6 +383,12 @@ def parse_jdf(text: str) -> JdfFile:
                               [unprotect(a.strip())
                                for a in _split_top(mm.group(2), ",")])
             continue
+        # %option name = value (reference: parsec.y options rule; e.g.
+        # "%option dynamic = ON", "%option no_taskpool_instance = true")
+        if line.startswith("%option"):
+            for k, v in _PROPS.findall(unprotect(line[len("%option"):])):
+                jdf.options[k] = v.strip('"')
+            continue
         # definition: name = range/expr
         m = re.match(r"^(\w+)\s*=\s*(.+)$", line)
         if m and task is not None:
@@ -371,9 +396,11 @@ def parse_jdf(text: str) -> JdfFile:
             parts = [p.strip() for p in re.split(r"\.\.", rhs)]
             if name in task.params:
                 if len(parts) == 2:
-                    task.ranges.append((name, parts[0], parts[1], None))
+                    task.defs.append(("range", name, parts[0], parts[1],
+                                      None))
                 elif len(parts) == 3:
-                    task.ranges.append((name, parts[0], parts[1], parts[2]))
+                    task.defs.append(("range", name, parts[0], parts[1],
+                                      parts[2]))
                 else:
                     raise JdfError(
                         f"task {task.name}: parameter {name} needs a "
@@ -383,7 +410,7 @@ def parse_jdf(text: str) -> JdfFile:
                     raise JdfError(
                         f"task {task.name}: derived local {name} cannot "
                         f"be a range")
-                task.locals.append((name, rhs))
+                task.defs.append(("local", name, rhs))
             continue
         # global: NAME [ props ]
         m = re.match(r"^(\w+)\s*\[(.*)\]\s*$", line)
@@ -391,12 +418,20 @@ def parse_jdf(text: str) -> JdfFile:
             jdf.globals.append(JdfGlobal(m.group(1),
                                          _parse_props(unprotect(m.group(2)))))
             continue
-        # task header: Name(a, b)
-        m = re.match(r"^(\w+)\s*\(([^)]*)\)\s*$", line)
+        # task header: Name(a, b) [ props... ] — the property block may
+        # span lines (project_dyn.jdf:43-44)
+        m = re.match(r"^(\w+)\s*\(([^)]*)\)\s*(\[.*)?$", line)
         if m:
+            propsrc = m.group(3) or ""
+            while propsrc.count("[") > propsrc.count("]") \
+                    and i < len(lines):
+                propsrc += " " + lines[i].strip()
+                i += 1
             task = JdfTask(m.group(1),
                            [p.strip() for p in m.group(2).split(",")
-                            if p.strip()])
+                            if p.strip()],
+                           props=_parse_props(
+                               unprotect(propsrc.strip(" []"))))
             jdf.tasks.append(task)
             flow = None
             continue
@@ -414,19 +449,19 @@ def _parse_dep(line: str) -> JdfDep:
         rest = rest[:pm.start()].strip()
     guard = None
     alt = None
-    if rest.startswith("("):
-        depth = 0
-        for i, ch in enumerate(rest):
-            if ch == "(":
-                depth += 1
-            elif ch == ")":
-                depth -= 1
-                if depth == 0:
-                    after = rest[i + 1:].strip()
-                    if after.startswith("?"):
-                        guard = rest[1:i]
-                        rest = after[1:].strip()
-                    break
+    # guard: any top-level '?' splits "<expr> ? endpoint [: alt]" — the
+    # expression need not be parenthesized (project_dyn.jdf:52
+    # "larger_than_thresh ? RL PROJECT(...)")
+    depth = 0
+    for i, ch in enumerate(rest):
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "?" and depth == 0:
+            guard = rest[:i].strip()
+            rest = rest[i + 1:].strip()
+            break
     if guard is not None:
         branches = _split_top(rest, ":")
         if len(branches) == 2:
@@ -485,6 +520,14 @@ def _compile_fn(expr_py: str, params: List[str],
     return ns["_f"]
 
 
+def _single_valued(vf, names: List[str]):
+    """Derived local -> single-valued parameter range: the value function
+    (over the preceding definition names) evaluated once per instance."""
+    def fn(globals_, locals_):
+        return [vf(*[locals_[n] for n in names])]
+    return fn
+
+
 def _missing_body(task_name: str):
     def body(*_a, **_k):
         raise RuntimeError(
@@ -500,6 +543,7 @@ def jdf_taskpool(source: str, *, globals: Optional[Dict[str, Any]] = None,
                  arenas: Optional[Dict[str, Tuple[Tuple[int, ...], Any]]]
                  = None,
                  dtts: Optional[Dict[str, Any]] = None,
+                 funcs: Optional[Dict[str, Any]] = None,
                  name: Optional[str] = None):
     """Parse JDF ``source`` (text or a path ending in .jdf) and build a
     runnable taskpool.
@@ -512,6 +556,10 @@ def jdf_taskpool(source: str, *, globals: Optional[Dict[str, Any]] = None,
     ``arenas``: arena name -> (shape, dtype) for NEW endpoints; a single
     ``"default"`` entry serves JDF NEW (which is untyped in the text).
     ``dtts``: annotation value (``type``/``type_remote``) -> dtt object.
+    ``funcs``: C-function name -> Python callable for task-level
+    properties (``make_key_fn`` over named params; ``startup_fn`` as
+    ``fn(globals_, rank) -> iterable of seed param dicts`` for
+    ``%option dynamic = ON`` pools — project_dyn.jdf:43-44,109-159).
     """
     if source.endswith(".jdf") and "\n" not in source:
         with open(source) as fh:
@@ -528,8 +576,10 @@ def jdf_taskpool(source: str, *, globals: Optional[Dict[str, Any]] = None,
         if data and g.name in data:
             gvals[g.name] = data[g.name]    # collection-typed global
         elif "default" in g.props:
+            # defaults may reference earlier globals (kcyclic.jdf:111
+            # "dA->super.mt-1"): evaluate against the values so far
             gvals[g.name] = eval(c2py(g.props["default"]),
-                                 dict(C_EVAL_HELPERS), {})
+                                 {**C_EVAL_HELPERS, **gvals}, {})
         else:
             raise JdfError(f"JDF global {g.name!r} has no value: pass "
                            f"globals={{{g.name!r}: ...}}")
@@ -545,40 +595,69 @@ def jdf_taskpool(source: str, *, globals: Optional[Dict[str, Any]] = None,
     p = PTG(name or (jdf.tasks[0].name.lower() if jdf.tasks else "jdf"),
             **{k: v for k, v in gvals.items()
                if isinstance(v, (int, float, str, bool))})
+    p.dynamic = str(jdf.options.get("dynamic", "")).lower() \
+        in ("on", "true", "1", "yes")
     for aname, (shape, dtype) in (arenas or {}).items():
         p.arena(aname, shape, dtype)
 
     task_names = {t.name for t in jdf.tasks}
 
     for t in jdf.tasks:
-        ranges: Dict[str, Any] = {}
-        declared = [r[0] for r in t.ranges]
+        declared = [d[1] for d in t.defs if d[0] == "range"]
         for pname in t.params:
             if pname not in declared:
                 raise JdfError(
                     f"task {t.name}: parameter {pname} has no range")
-        for pname, lo, hi, step in t.ranges:
-            # earlier params may appear in later bounds: compile bound
-            # fns over the preceding params
-            idx = t.params.index(pname)
-            prior = t.params[:idx]
-            lo_f = _compile_fn(c2py(lo), prior, t.locals[:0], env) \
-                if prior else eval(c2py(lo), dict(env))
-            hi_f = _compile_fn(c2py(hi), prior, t.locals[:0], env) \
-                if prior else eval(c2py(hi), dict(env))
-            if step is not None:
-                st = eval(c2py(step), dict(env))
-                ranges[pname] = Range(lo_f, hi_f, st)
+        # Execution space: EVERY definition — ranges and derived locals,
+        # in declaration order — becomes a TaskClass parameter; derived
+        # locals are single-valued ranges over the preceding names.  This
+        # mirrors the reference exactly (locals live in
+        # this_task->locals and bodies may overwrite them — the
+        # project_dyn.jdf dynamic-pruning idiom), and lets later range
+        # bounds use earlier derived locals (BT_reduction.jdf "s = 1 ..
+        # sz" where sz derives from t).
+        space: Dict[str, Any] = {}
+        prior: List[str] = []
+        for d in t.defs:
+            if d[0] == "range":
+                _, pname, lo, hi, step = d
+                lo_f = _compile_fn(c2py(lo), list(prior), [], env)
+                hi_f = _compile_fn(c2py(hi), list(prior), [], env)
+                if step is not None:
+                    space[pname] = Range(
+                        lo_f, hi_f, _compile_fn(c2py(step), list(prior),
+                                                [], env))
+                else:
+                    space[pname] = Range(lo_f, hi_f)
+                prior.append(pname)
             else:
-                ranges[pname] = Range(lo_f, hi_f)
-        tb = p.task(t.name, **ranges)
+                _, lname, expr = d
+                space[lname] = _single_valued(
+                    _compile_fn(c2py(expr), list(prior), [], env),
+                    list(prior))
+                prior.append(lname)
+        tb = p.task(t.name, **space)
+        if "make_key_fn" in t.props:
+            fn = (funcs or {}).get(t.props["make_key_fn"])
+            if fn is None:
+                raise JdfError(
+                    f"task {t.name}: make_key_fn "
+                    f"{t.props['make_key_fn']!r} not in funcs=")
+            tb.make_key(fn)
+        if "startup_fn" in t.props:
+            fn = (funcs or {}).get(t.props["startup_fn"])
+            if fn is None:
+                raise JdfError(
+                    f"task {t.name}: startup_fn "
+                    f"{t.props['startup_fn']!r} not in funcs=")
+            tb.property("startup_fn", fn)
         if t.partition is not None:
             dname, args = t.partition
             if dname not in dmap:
                 raise JdfError(f"task {t.name}: partitioning data "
                                f"{dname!r} not provided")
             expr = f"{dname}(" + ", ".join(c2py(a) for a in args) + ")"
-            tb.affinity(_compile_fn(expr, t.params, t.locals, env))
+            tb.affinity(_compile_fn(expr, t.def_names, [], env))
         for f in t.flows:
             ends = []
             for dep in f.deps:
@@ -609,8 +688,12 @@ def _build_dep(t: JdfTask, f: JdfFlow, dep: JdfDep, env, dmap,
             dtt = dtts[dep.props[key]]
             break
 
+    names = t.def_names   # guards/args see ranges AND derived locals,
+    #                       all read from task.locals (body overwrites
+    #                       of a local are visible to output guards)
+
     def one(ep: JdfEndpoint, guard_expr: Optional[str]):
-        guard = _compile_fn(c2py(guard_expr), t.params, t.locals, env) \
+        guard = _compile_fn(c2py(guard_expr), names, [], env) \
             if guard_expr is not None else None
         kw = {}
         if guard is not None:
@@ -629,8 +712,7 @@ def _build_dep(t: JdfTask, f: JdfFlow, dep: JdfDep, env, dmap,
                                f"not provided")
             expr = (f"{ep.target}(" +
                     ", ".join(c2py(a) for a in ep.args) + ")")
-            return ctor(DATA(_compile_fn(expr, t.params, t.locals, env)),
-                        **kw)
+            return ctor(DATA(_compile_fn(expr, names, [], env)), **kw)
         # task endpoint; range args become list-returning params fns
         if ep.target not in task_names:
             raise JdfError(f"task {t.name}: unknown peer task "
@@ -649,8 +731,7 @@ def _build_dep(t: JdfTask, f: JdfFlow, dep: JdfDep, env, dmap,
             else:
                 items.append(f"'{pn}': ({c2py(arg)})")
         expr = "{" + ", ".join(items) + "}"
-        fn = _compile_fn(expr, t.params, t.locals, env,
-                         list_wrap=wraps or None)
+        fn = _compile_fn(expr, names, [], env, list_wrap=wraps or None)
         return ctor(TASK(ep.target, ep.flow, fn), **kw)
 
     if dep.alt is not None:

@@ -82,6 +82,10 @@ def build_dag(tp, duration_fn: Callable[[str, Dict[str, int]], float],
                         continue
                     dst_tc = tp.task_classes[dep.end.task_class]
                     for params in dep.end.instances(locals_):
+                        # dep expressions carry free params only; fill
+                        # derived locals before keying (JDF derived
+                        # locals are single-valued TaskClass params)
+                        params = dst_tc.complete_locals(params)
                         dkey = dst_tc.make_key(params)
                         if dkey in dag.nodes:
                             dag.succs[key].append((dkey, flow.name,

@@ -1,0 +1,351 @@
+"""JDF hard-corpus golden tests (VERDICT r4 missing #1): parse and run
+the REFERENCE's hardest .jdf files through the textual front-end —
+kcyclic.jdf (k-cyclic views + CTL reduce/broadcast chains, 4 ranks),
+BT_reduction.jdf (interleaved derived locals feeding later range bounds,
+inline-C helper calls, ternary flows), and project_dyn.jdf (%option
+dynamic: runtime-pruned task space + dynamic termination detection,
+reference: ptgpp --dynamic-termdet).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.comm.launch import run_distributed
+from parsec_tpu.data.collection import DataCollection
+from parsec_tpu.data.data import new_data
+from parsec_tpu.data.matrix import TwoDimBlockCyclic, block_cyclic_kview
+from parsec_tpu.dsl.ptg.jdf import jdf_taskpool, parse_jdf
+
+REF = "/root/reference"
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF),
+                               reason="reference tree not present")
+
+
+# -- parser units -----------------------------------------------------------
+
+def test_option_and_multiline_task_props_parse():
+    jdf = parse_jdf("""
+%option dynamic = ON
+%option no_taskpool_instance = true
+
+T (k) [ make_key_fn = mk
+        startup_fn = su ]
+  k = 0 .. 3
+  d = k + 1
+: A(k)
+CTL C -> (d > 1) ? C T(k+1)
+BODY
+END
+""")
+    assert jdf.options["dynamic"] == "ON"
+    assert jdf.options["no_taskpool_instance"] == "true"
+    t = jdf.tasks[0]
+    assert t.props == {"make_key_fn": "mk", "startup_fn": "su"}
+    # declaration order preserved: range k, then derived local d
+    assert [d[:2] for d in t.defs] == [("range", "k"), ("local", "d")]
+
+
+def test_kview_permutation_matches_reference_formula():
+    """kview_compute_m/n (two_dim_rectangle_cyclic.c:441-463) on a 2x2
+    grid with kp=kq=2."""
+    A = TwoDimBlockCyclic(mb=2, nb=2, lm=16, ln=16, nodes=4, myrank=0,
+                          P=2, name="dA")
+    V = block_cyclic_kview(A, 2, 2)
+
+    def ref_perm(x, p, ps, xt):
+        while True:
+            x = x - x % (p * ps) + (x % ps) * p + (x // ps) % p
+            if x < xt:
+                return x
+
+    for m in range(A.mt):
+        assert V._pm(m) == ref_perm(m, 2, 2, A.mt)
+        # a permutation: bijective over the tile range
+    assert sorted(V._pm(m) for m in range(A.mt)) == list(range(A.mt))
+    assert sorted(V._pn(n) for n in range(A.nt)) == list(range(A.nt))
+
+
+# -- kcyclic.jdf: 4-rank golden run -----------------------------------------
+
+def _kcyclic_worker(ctx, rank, nranks):
+    n, mb = 12, 3
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, nodes=nranks,
+                          myrank=rank, P=2, dtype=np.int32, name="dA")
+    CA = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, nodes=nranks,
+                           myrank=rank, P=2, kp=2, kq=2, dtype=np.int32,
+                           name="dCA")
+    VA = block_cyclic_kview(A, 2, 2, name="dVA")
+    errors = []
+
+    def fill_a(A, M, N):
+        A.reshape(-1)[0:3] = (M, N, _a.rank_of(M, N))
+
+    def fill_ca(CA, M, N):
+        CA.reshape(-1)[0:3] = (M, N, _ca.rank_of(M, N))
+
+    def compare(A, CA, VA, M, N):
+        a, ca, va = (A.reshape(-1), CA.reshape(-1), VA.reshape(-1))
+        if a[0] != ca[0] or a[1] != ca[1]:
+            errors.append(("kcyclic", M, N))       # A and CA differ
+        if va[2] != _a.rank_of(int(va[0]), int(va[1])):
+            errors.append(("view", M, N))          # VA not a permutation
+
+    _a, _ca = A, CA
+    tp = jdf_taskpool(f"{REF}/tests/collections/kcyclic.jdf",
+                      data={"dA": A, "dVA": VA, "dCA": CA},
+                      bodies={"FILL_A": fill_a, "FILL_CA": fill_ca,
+                              "READ_VA": lambda VA: None,
+                              "COMPARE": compare})
+    # hidden globals evaluated from the collection shim (dA->super.mt-1)
+    assert tp.globals["MT"] == A.mt - 1 and tp.globals["NT"] == A.nt - 1
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=120)
+    return errors
+
+
+@needs_ref
+def test_kcyclic_jdf_golden_4ranks():
+    res = run_distributed(_kcyclic_worker, 4, timeout=300)
+    assert res == [[], [], [], []]
+
+
+# -- BT_reduction.jdf: generalized binomial-tree reduction ------------------
+
+def _count_bits(N):
+    return bin(N).count("1")
+
+
+def _log_of_tree_size(N, t):
+    cnt = 0
+    for i in range(32):
+        if (1 << i) & N:
+            cnt += 1
+        if cnt == t:
+            return i
+    raise AssertionError(N)
+
+
+def _index_to_tree(N, idx):
+    cnt = 0
+    for i in range(32):
+        if (1 << i) & N:
+            cnt += 1
+            if idx < (1 << i):
+                return cnt
+            idx -= 1 << i
+    raise AssertionError(N)
+
+
+def _global_to_local_index(N, idx):
+    for i in range(32):
+        if (1 << i) & N:
+            if idx < (1 << i):
+                return idx
+            idx -= 1 << i
+    raise AssertionError(N)
+
+
+def _compute_offset(N, t):
+    cnt, offset = 0, 0
+    for i in range(32):
+        if (1 << i) & N:
+            cnt += 1
+        if cnt == t:
+            return offset
+        if (1 << i) & N:
+            offset += 1 << i
+    raise AssertionError(N)
+
+
+@needs_ref
+def test_bt_reduction_jdf_golden():
+    """tests/apps/generalized_reduction/BT_reduction.jdf: NT values are
+    decomposed into power-of-two binomial trees reduced in parallel, then
+    a linear pass chains the tree roots.  Exercises derived locals
+    BETWEEN ranges feeding later bounds (s = 1..sz with sz derived from
+    t) and inline-C calls to prologue helper functions."""
+    NT, NB = 5, 4
+    dataA = TwoDimBlockCyclic(mb=1, nb=NB, lm=NT, ln=NB, dtype=np.int32,
+                              name="dataA")
+    result = []
+
+    def reduction(A, i):
+        A[:] = i
+
+    def bt_reduc(A, B):
+        B += A
+
+    def linear_reduc(B, C, i, tree_count):
+        if tree_count != i and B is not None:
+            C += B
+        if i == 1:
+            result.append(np.array(C).copy())
+
+    tp = jdf_taskpool(
+        f"{REF}/tests/apps/generalized_reduction/BT_reduction.jdf",
+        globals={"NT": NT, "NB": NB, "count_bits": _count_bits,
+                 "log_of_tree_size": _log_of_tree_size,
+                 "index_to_tree": _index_to_tree,
+                 "global_to_local_index": _global_to_local_index,
+                 "compute_offset": _compute_offset},
+        data={"dataA": dataA},
+        bodies={"REDUCTION": reduction, "BT_REDUC": bt_reduc,
+                "LINEAR_REDUC": linear_reduc,
+                "LINE_TERMINATOR": lambda: None})
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    assert len(result) == 1
+    assert (result[0].reshape(-1) == sum(range(NT))).all()
+
+
+# -- project_dyn.jdf: dynamic task discovery --------------------------------
+
+class TreeDist(DataCollection):
+    """Minimal tree collection (the reference's test-local fixture
+    tests/apps/haar_tree/tree_dist.c): (n, l) keys, data created on
+    demand."""
+
+    def __init__(self, nodes=1, myrank=0, name="treeA"):
+        super().__init__(nodes=nodes, myrank=myrank, name=name)
+        self._lock = threading.Lock()
+        self.tiles = {}
+
+    def data_key(self, n, l=0):
+        return (n, l)
+
+    def key_to_indices(self, key):
+        return tuple(key)
+
+    def rank_of(self, n, l=0):
+        return 0 if self.nodes == 1 else (n * 31 + l) % self.nodes
+
+    def data_of(self, n, l=0):
+        with self._lock:
+            d = self.tiles.get((n, l))
+            if d is None:
+                d = new_data(np.zeros(2), key=(self.name, n, l),
+                             collection=self)
+                self.tiles[(n, l)] = d
+            return d
+
+
+@needs_ref
+def test_project_dyn_jdf_dynamic_termdet():
+    """tests/apps/haar_tree/project_dyn.jdf: %option dynamic = ON — the
+    declared space (n = 0..31, l = 0..2^n) is astronomically larger than
+    what runs; a startup_fn seeds PROJECT(0, 0), each task decides AT
+    RUNTIME whether to spawn its two children by overwriting the
+    larger_than_thresh local (this_task->locals in the reference), and
+    the pool terminates by dynamic task counting, not enumeration."""
+    import math
+    tree = TreeDist()
+    ALPHA, THRESH, NMIN = 1.0, 0.02, 4
+    executed, pruned = [], []
+
+    def key_to_x(n, l):
+        L = 10.0
+        return -L + (2.0 * L * 2.0 ** -n) * (0.5 + l)
+
+    def f(x):
+        return math.exp(-(x / ALPHA) * (x / ALPHA))
+
+    def project(task, n, l, NODE):
+        executed.append((n, l))
+        sl = f(key_to_x(n + 1, 2 * l))
+        sr = f(key_to_x(n + 1, 2 * l + 1))
+        d = 0.5 * (sl - sr)
+        err = abs(d) * 2.0 ** (-0.5 * n)
+        if n >= NMIN and err <= THRESH:
+            # prune: kill the output guard (reference body:
+            # this_task->locals.larger_than_thresh.value = 0)
+            task.locals["larger_than_thresh"] = 0
+            pruned.append((n, l))
+        else:
+            NODE[:] = (0.5 * (sl + sr), d)
+
+    tp = jdf_taskpool(
+        f"{REF}/tests/apps/haar_tree/project_dyn.jdf",
+        globals={"NP": 1, "fakeDesc": tree, "thresh": THRESH,
+                 "verbose": 0, "alpha": ALPHA},
+        data={"treeA": tree},
+        bodies={"PROJECT": project},
+        arenas={"default": ((2,), np.float64)},
+        funcs={"project_dyn_make_key":
+               lambda n, l: (n << 32) | l,
+               "my_project_dyn_startup":
+               lambda globals_, rank: [dict(n=0, l=0)] if rank == 0
+               else []})
+    from parsec_tpu.core.taskpool import DynamicTaskpool
+    assert isinstance(tp, DynamicTaskpool)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    # full expansion through the n < NMIN levels...
+    assert len(executed) >= 2 ** (NMIN + 1) - 1
+    # ...then runtime pruning cut the 2^32-task declared space down
+    assert pruned and len(executed) < 4096
+    depths = {n for n, _ in executed}
+    assert max(depths) > NMIN          # some branches went deeper
+    # every non-root task was discovered through its parent edge
+    ex = set(executed)
+    for (n, l) in ex:
+        if n:
+            assert (n - 1, l // 2) in ex
+    # leaves (pruned) spawned no children
+    for (n, l) in pruned:
+        assert (n + 1, 2 * l) not in ex and (n + 1, 2 * l + 1) not in ex
+    # expanded nodes wrote their NODE payload home through -> treeA(n, l)
+    root = tree.tiles[(0, 0)].pull_to_host().payload
+    assert root[0] != 0.0
+
+
+def _project_dyn_worker(ctx, rank, nranks):
+    """Dynamic pool seeded ONLY on rank 0; every task on rank 1 arrives
+    purely by remote discovery — the case that needs the distributed
+    dynamic termdet (the pool-scoped quiescence hold): with plain local
+    counting, rank 1 would terminate at startup with zero tasks."""
+    import math
+    tree = TreeDist(nodes=nranks, myrank=rank)
+    ALPHA, THRESH, NMIN = 1.0, 0.02, 4
+    executed = []
+
+    def key_to_x(n, l):
+        return -10.0 + (20.0 * 2.0 ** -n) * (0.5 + l)
+
+    def f(x):
+        return math.exp(-(x / ALPHA) * (x / ALPHA))
+
+    def project(task, n, l, NODE):
+        executed.append((n, l))
+        sl = f(key_to_x(n + 1, 2 * l))
+        sr = f(key_to_x(n + 1, 2 * l + 1))
+        d = 0.5 * (sl - sr)
+        if n >= NMIN and abs(d) * 2.0 ** (-0.5 * n) <= THRESH:
+            task.locals["larger_than_thresh"] = 0
+        else:
+            NODE[:] = (0.5 * (sl + sr), d)
+
+    tp = jdf_taskpool(
+        f"{REF}/tests/apps/haar_tree/project_dyn.jdf",
+        globals={"NP": nranks, "fakeDesc": tree, "thresh": THRESH,
+                 "verbose": 0, "alpha": ALPHA},
+        data={"treeA": tree}, bodies={"PROJECT": project},
+        arenas={"default": ((2,), np.float64)},
+        funcs={"project_dyn_make_key": lambda n, l: (n << 32) | l,
+               "my_project_dyn_startup":
+               lambda globals_, r: [dict(n=0, l=0)] if r == 0 else []})
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=120)
+    return len(executed)
+
+
+@needs_ref
+def test_project_dyn_distributed_dynamic_termdet():
+    counts = run_distributed(_project_dyn_worker, 2, timeout=300)
+    assert sum(counts) >= 2 ** 5 - 1      # full expansion to NMIN depth
+    assert all(c > 0 for c in counts)     # rank 1 ran discovered tasks
