@@ -42,6 +42,7 @@ from parsec_tpu.data.collection import DataCollection, DataRef
 from parsec_tpu.data.data import (ACCESS_READ, ACCESS_RW, ACCESS_WRITE,
                                   Coherency, Data, new_data)
 from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import warning
 
 
 def _apply_payload(datum: Data, arr: np.ndarray,
@@ -113,12 +114,18 @@ class Region:
     region-free access conflicts with every lane.
 
     ``slices`` (a tuple of python slices, e.g. ``(slice(0, 8),)`` for
-    the tile's top half) declares the lane's byte extent.  It is what
-    lets region lanes work ACROSS RANKS: a remote lane write ships only
-    the lane's sub-array and the receiver applies it read-modify-write,
-    so concurrent writers of disjoint lanes on different ranks cannot
+    the tile's top half) declares the lane's byte extent.  With an
+    extent, a remote lane write ships only the lane's sub-array and the
+    receiver applies it read-modify-write, so concurrent writers of
+    disjoint lanes on different ranks cannot
     clobber each other (the reference's per-region MPI datatypes).
-    Ordering-only regions (no slices) stay shared-memory."""
+    Ordering-only regions (no slices) also work across ranks: the lane
+    id + version keep per-lane ORDERING on the wire, but each payload
+    ships whole-tile (there is no extent to cut), so lanes of one tile
+    written concurrently on DIFFERENT ranks merge at tile granularity —
+    declare ``slices`` when byte-exact disjoint-lane merging matters
+    (the reference's region masks always carry an MPI datatype,
+    insert_function.h:60-78, which is exactly this extent)."""
 
     def __init__(self, rid: Any, slices: Optional[tuple] = None):
         self.rid = rid
@@ -242,6 +249,9 @@ class DTDTaskpool(Taskpool):
         #: identically on every rank by the SPMD insert stream — the
         #: wire carries only the rid)
         self._region_slices: Dict[Any, tuple] = {}
+        #: tiles already warned about concurrent extent-less lane
+        #: writers on different ranks (one warning per tile)
+        self._extless_warned: set = set()
         #: serializes payload read-modify-write spans: two unordered
         #: disjoint-lane appliers interleaving pull/overwrite would lose
         #: one lane's bytes (whole-tile overwrite restores stale data)
@@ -364,13 +374,17 @@ class DTDTaskpool(Taskpool):
             l = tile.lanes.get(lane) if tile.lanes else None
             if l is not None and l.version > ver:
                 return          # a newer write to this lane supersedes
-            self._merge_payload(tile, arr, self._region_slices.get(lane),
-                                [])
-            return
+            sl = self._region_slices.get(lane)
+            if sl is not None:
+                self._merge_payload(tile, arr, sl, [])
+                return
+            # extent-less lane: the payload is whole-tile — fall through
+            # to the whole-tile preserve logic so a NEWER sliced lane's
+            # bytes survive this older snapshot of their extent
         preserve = []
         if tile.lanes:
             for lrid, l in tile.lanes.items():
-                if lrid is not None and l.version > ver:
+                if lrid is not None and lrid != lane and l.version > ver:
                     sl = self._region_slices.get(lrid)
                     if sl is not None:
                         preserve.append(sl)
@@ -598,15 +612,7 @@ class DTDTaskpool(Taskpool):
                 "attach the DTD pool to a context before inserting")
         nargs = _norm(args)
         for *_x, r in nargs:
-            if r is None:
-                continue
-            if self.nranks > 1 and r.slices is None:
-                raise NotImplementedError(
-                    "distributed region lanes need a byte extent: "
-                    "declare Region(rid, slices=...) so lane payloads "
-                    "can ride the wire (ordering-only regions are "
-                    "shared-memory only)")
-            if r.slices is not None:
+            if r is not None and r.slices is not None:
                 self._region_slices[r.rid] = r.slices
         args = [(v, b) for v, b, _f, _r in nargs]
         rank = self._task_rank(args) if self.nranks > 1 else self.myrank
@@ -800,6 +806,7 @@ class DTDTaskpool(Taskpool):
             tile.lanes = {None: _Lane(tile.last_writer,
                                       list(tile.readers), tile.version)}
         lanes = tile.lanes
+        self._warn_extentless_overlap(tile, rid, writer_is_recv=True)
         for _lrid, lane in self._conflict_lanes(tile, rid):
             for r in lane.readers:                     # WAR
                 self._edge(r, d)
@@ -865,14 +872,17 @@ class DTDTaskpool(Taskpool):
         this recv (it conflicts transitively), so its extent still wants
         this payload's bytes and is NOT preserved."""
         if lane is not None:
-            self._merge_payload(tile, arr, self._region_slices.get(lane),
-                                [])
-            return
+            sl = self._region_slices.get(lane)
+            if sl is not None:
+                self._merge_payload(tile, arr, sl, [])
+                return
+            # extent-less lane payload = whole tile: preserve newer
+            # sliced lanes below, exactly as a whole-tile payload would
         preserve = []
         with self._dep_lock:       # lanes mutate under the pool dep lock
             if tile.lanes:
                 for lrid, l in tile.lanes.items():
-                    if lrid is None or l.version <= ver:
+                    if lrid is None or lrid == lane or l.version <= ver:
                         continue
                     lw = l.last_writer
                     # preserve the lane when its newer bytes arrive via
@@ -921,8 +931,14 @@ class DTDTaskpool(Taskpool):
         base = {"tp": self.taskpool_id, "kind": kind,
                 "tile": tile.wire_key, "ver": ver}
         if lane is not None:
-            arr = np.ascontiguousarray(
-                arr[tuple(self._region_slices[lane])])
+            # extent-less (ordering-only) lanes ship the WHOLE tile with
+            # the lane id + version riding for receiver-side ordering
+            # (reference regions always carry a datatype,
+            # insert_function.h:60-78; without one, whole-tile is the
+            # only correct granularity)
+            sl = self._region_slices.get(lane)
+            if sl is not None:
+                arr = np.ascontiguousarray(arr[tuple(sl)])
             base["lane"] = lane
         eager = int(params.get("comm_eager_limit", 65536))
         comm = self.context.comm if self.context is not None else None
@@ -1058,6 +1074,34 @@ class DTDTaskpool(Taskpool):
             tile.last_writer = state
             tile.readers = []
 
+    def _warn_extentless_overlap(self, tile: DTDTile, rid: Any,
+                                 writer_is_recv: bool) -> None:
+        """Extent-less lanes merge across ranks at WHOLE-TILE granularity
+        (no byte extent to cut), so two such lanes of one tile with
+        concurrent writers on different ranks can lose one lane's
+        update.  Make that LOUD at insert time — the r4 guard's
+        diagnostic value without banning the legal serialized patterns
+        (caller holds _dep_lock)."""
+        if self.nranks <= 1 or rid is None or rid in self._region_slices:
+            return
+        for lrid, lane in (tile.lanes or {}).items():
+            if lrid is None or lrid == rid \
+                    or lrid in self._region_slices:
+                continue
+            lw = lane.last_writer
+            if lw is None or lw.done or lw.is_recv == writer_is_recv:
+                continue
+            if tile.wire_key in self._extless_warned:
+                return
+            self._extless_warned.add(tile.wire_key)
+            warning(
+                "tile %s: extent-less region lanes %r and %r have "
+                "concurrent writers on different ranks; payloads ship "
+                "whole-tile, so one lane's bytes may be lost — declare "
+                "Region(..., slices=...) for byte-exact disjoint "
+                "merging", tile.wire_key, rid, lrid)
+            return
+
     def _track_region(self, state: _DTDState, tile: DTDTile, mode: _Mode,
                       rid: Any, to_schedule: List[Task]) -> None:
         """Region-lane dependency tracking.  The first region-flagged
@@ -1099,6 +1143,7 @@ class DTDTaskpool(Taskpool):
             (mine if mine is not None else lanes[None]).readers.append(
                 state)
         else:
+            self._warn_extentless_overlap(tile, rid, writer_is_recv=False)
             for _lrid, lane in conflict:
                 for r in lane.readers:                         # WAR
                     self._edge(r, state)

@@ -252,17 +252,33 @@ def test_region_masks_disjoint_writers_run_unordered():
     np.testing.assert_allclose(out[1:3, :], 0.0)
 
 
-def test_region_masks_rejected_distributed():
+def test_ordering_only_region_accepted_shared_memory():
+    """VERDICT r4 #8: extent-less (ordering-only) regions are legal
+    everywhere — the r4 distributed guard is gone (the cross-rank
+    behavior is covered by test_dtd_distributed's ordering-only case);
+    here the lane semantics in shared memory: disjoint ordering-only
+    lanes do not serialize, a whole-tile access orders against both."""
     from parsec_tpu.dsl.dtd import Region
     A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
     with Context(nb_cores=2) as ctx:
         tp = make_pool(ctx)
-        tp.nranks = 2    # pretend: the guard must fire before any wire op
         t = tp.tile_of(A, 0, 0)
-        with pytest.raises(NotImplementedError, match="region"):
-            tp.insert_task(lambda T: T, (t, INOUT | Region(1)))
-        tp.nranks = 1
+        RX, RY = Region("x"), Region("y")      # no slices
+
+        def wr_x(T):
+            T[0, :] = T[0, :] + 1.0
+
+        def wr_y(T):
+            T[3, :] = T[3, :] + 2.0
+        tp.insert_task(wr_x, (t, INOUT | RX))
+        t2 = tp.insert_task(wr_y, (t, INOUT | RY))
+        assert t2.dtd.remaining == 0           # disjoint lanes: no edge
+        t3 = tp.insert_task(lambda T: None, (t, INPUT))
+        assert t3.dtd.remaining in (1, 2)      # orders after both lanes
         tp.wait()
+    out = np.asarray(A.data_of(0, 0).pull_to_host().payload)
+    np.testing.assert_allclose(out[0, :], 1.0)
+    np.testing.assert_allclose(out[3, :], 2.0)
 
 
 def test_pushout_forces_result_home():

@@ -632,3 +632,47 @@ def _region_four_rank_quarters(ctx, rank, nranks):
 def test_dtd_region_four_rank_quarter_lanes():
     assert run_distributed(_region_four_rank_quarters, 4,
                            timeout=300) == ["ok"] * 4
+
+
+def _region_ordering_only(ctx, rank, nranks):
+    """VERDICT r4 #8: EXTENT-LESS (ordering-only) region lanes across
+    ranks — the reference's region masks need no user byte extent
+    (insert_function.h:60-78).  The lane id + version keep the lane's
+    write chain totally ordered on the wire; payloads ship whole-tile."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, INPUT, OUTPUT, Region
+
+    V = VectorTwoDimCyclic(mb=4, lm=4, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 0.0
+    R = VectorTwoDimCyclic(mb=4, lm=4 * nranks, nodes=nranks,
+                           myrank=rank, name="R")
+    for m, _ in R.local_tiles():
+        R.data_of(m).copy_on(0).payload[:] = -1.0
+
+    tp = _make_pool(ctx)
+    t = tp.tile_of(V, 0)
+    x = Region("x")                      # NO slices: ordering-only
+
+    def step(T):
+        return np.asarray(T) * 2.0 + 1.0
+
+    # order-sensitive chain bouncing between ranks inside one lane:
+    # 0 -> 1 -> 3 -> 7 -> 15 -> 31 -> 63 (any reordering changes it)
+    for i in range(6):
+        tp.insert_task(step, (t, INOUT | x), (i % nranks, AFFINITY))
+    # a whole-tile reader on each rank conflicts with every lane and
+    # must observe the final chained value
+    for r in range(nranks):
+        tp.insert_task(lambda s, out: np.asarray(s).copy(),
+                       (t, INPUT), (tp.tile_of(R, r), OUTPUT))
+    tp.wait(timeout=120)
+    ctx.wait(timeout=120)
+    got = np.asarray(R.data_of(rank).pull_to_host().payload)
+    np.testing.assert_allclose(got, np.full(4, 63.0, np.float32))
+    return "ok"
+
+
+def test_dtd_region_ordering_only_across_ranks():
+    assert run_distributed(_region_ordering_only, 2, timeout=240) \
+        == ["ok"] * 2
