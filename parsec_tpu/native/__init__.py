@@ -92,6 +92,78 @@ def available() -> bool:
     return load() is not None
 
 
+_pinsext = None
+_pinsext_tried = False
+_PINS_SO = os.path.join(_HERE, "pinsext.so")
+
+
+def load_pinsext():
+    """Build (once) and import the CPython trace-sink extension
+    (pinsext.c).  ctypes costs ~1us per crossing — the whole tracer
+    budget — so the per-event path is a real extension module; returns
+    None when disabled or the toolchain/headers are missing."""
+    global _pinsext, _pinsext_tried
+    with _lock:
+        if _pinsext_tried:
+            return _pinsext
+        _pinsext_tried = True
+        if not int(params.get("native_core", 1)):
+            return None
+        src = os.path.join(_HERE, "pinsext.c")
+        if not os.path.exists(_PINS_SO) or \
+                os.path.getmtime(_PINS_SO) < os.path.getmtime(src):
+            import sysconfig
+            inc = sysconfig.get_paths()["include"]
+            tmp = f"{_PINS_SO}.tmp.{os.getpid()}"
+            try:
+                r = subprocess.run(
+                    ["g++", "-O2", "-fPIC", "-shared", f"-I{inc}",
+                     "-o", tmp, src],
+                    capture_output=True, text=True, timeout=120)
+                if r.returncode != 0:
+                    warning("pinsext build failed:\n%s", r.stderr[-2000:])
+                    return None
+                os.replace(tmp, _PINS_SO)
+            except (OSError, subprocess.TimeoutExpired) as exc:
+                warning("pinsext build unavailable: %s", exc)
+                return None
+            finally:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "pinsext", _PINS_SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as exc:   # pragma: no cover - load portability
+            warning("pinsext load failed: %s", exc)
+            return None
+        # the sink stamps with CLOCK_MONOTONIC; only usable if that is
+        # the same timeline Python's perf_counter reads (true on Linux).
+        # Bracket the C read between two Python reads and retry a few
+        # times: a single unlucky deschedule between two reads must not
+        # silently disable the fast path for the whole process.
+        import time as _time
+        same_clock = False
+        for _ in range(5):
+            a = _time.perf_counter()
+            b = mod.now()
+            c = _time.perf_counter()
+            if a - 1e-4 <= b <= c + 1e-4:
+                same_clock = True
+                break
+        if not same_clock:
+            debug_verbose(3, "pinsext clock differs from perf_counter; "
+                          "falling back to the Python event path")
+            return None
+        _pinsext = mod
+        debug_verbose(5, "pinsext loaded: %s", _PINS_SO)
+        return _pinsext
+
+
 def _sign(lib: ctypes.CDLL) -> None:
     C = ctypes
     u64, i64, i32 = C.c_uint64, C.c_int64, C.c_int32
@@ -258,16 +330,30 @@ class NativeTraceBuffer:
     #: (matches ptq_trace_event's parameter types; negative object_ids
     #: fold to two's complement like the per-event path)
     _EVFMT_IN = struct_mod.Struct("<iiQQqd")
+    #: whole-chunk packers, one C pack call per batch instead of one per
+    #: event (the tracer's amortized-ingest cost is dominated by Python
+    #: pack calls otherwise); lazily built per batch length
+    _CHUNK_PACKERS: dict = {}
 
     def events_bulk(self, events) -> None:
         """One boundary crossing for a batch of (key, flags, tp, eid,
         oid, ts) tuples — the tracer hot path's amortized ingest."""
         if not events:
             return
-        pack = self._EVFMT_IN.pack
-        buf = b"".join(pack(k, f, tp & 0xFFFFFFFFFFFFFFFF,
-                            e & 0xFFFFFFFFFFFFFFFF, o, ts)
-                       for k, f, tp, e, o, ts in events)
+        n = len(events)
+        packer = self._CHUNK_PACKERS.get(n)
+        if packer is None:
+            # signed 64-bit: same bit pattern as the Q layout for the
+            # values in range, and it accepts the odd negative id too
+            packer = self._CHUNK_PACKERS[n] = \
+                struct_mod.Struct("<" + "iiqqqd" * n)
+            if len(self._CHUNK_PACKERS) > 64:   # odd tail sizes: bounded
+                self._CHUNK_PACKERS.clear()
+        flat = []
+        ext = flat.extend
+        for ev in events:
+            ext(ev)
+        buf = packer.pack(*flat)
         carr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
         self._lib.ptq_trace_events_bulk(self._h, carr, len(buf))
 

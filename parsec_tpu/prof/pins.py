@@ -35,26 +35,51 @@ class TaskProfilerPins:
     def __init__(self, profile: Profile, with_locals: bool = False):
         self.profile = profile
         self.with_locals = with_locals
-        self._event_ids: Dict[int, int] = {}   # task seq -> trace event id
-        self._closed: set = set()              # eids closed by exec_end
         self._sbs: Dict[int, Any] = {}         # th_id -> StreamBuffer
         self._keys: Dict[str, int] = {}        # class name -> dict key
+        self._tagged: list = []                # objects carrying caches
 
     def install(self, context) -> None:
+        # one task_profiler per context: the interval state rides the
+        # shared Task.prof slot, so two instances would corrupt each
+        # other's streams (the reference's task_profiler is likewise a
+        # per-process singleton — PINS modules are MCA-selected once)
+        cur = getattr(context, "_task_profiler", None)
+        if cur is not None and cur is not self:
+            raise RuntimeError(
+                "a TaskProfilerPins is already installed on this "
+                "context; uninstall it first")
+        context._task_profiler = self
         context.pins_register("exec_begin", self._begin)
         context.pins_register("exec_end", self._end)
         context.pins_register("complete_exec", self._complete)
 
     def uninstall(self, context) -> None:
+        if getattr(context, "_task_profiler", None) is self:
+            context._task_profiler = None
         context.pins_unregister("exec_begin", self._begin)
         context.pins_unregister("exec_end", self._end)
         context.pins_unregister("complete_exec", self._complete)
+        # drop the hot-path caches planted on streams/task classes so an
+        # uninstalled profiler (and its Profile's event buffers) does not
+        # stay reachable for the life of the context
+        for obj, attr in self._tagged:
+            if getattr(obj, attr, (None,))[0] is self:
+                try:
+                    delattr(obj, attr)
+                except AttributeError:
+                    pass
+        self._tagged.clear()
 
     def _sb(self, es):
         sb = self._sbs.get(es.th_id)
         if sb is None:
             sb = self._sbs[es.th_id] = \
                 self.profile.stream(es.th_id, f"worker-{es.th_id}")
+            # hot-path cache, owner-tagged so a second profiler instance
+            # on the same context cannot reuse the wrong stream
+            es._prof_sb = (self, sb)
+            self._tagged.append((es, "_prof_sb"))
         return sb
 
     def _key(self, name: str) -> int:
@@ -63,36 +88,51 @@ class TaskProfilerPins:
             k = self._keys[name] = self.profile.add_event_class(name).key
         return k
 
+    # The per-task state rides the Task.prof slot as
+    # [dict key, event id, object id, closed-by-end] — no module-level
+    # dict/set traffic on the hot path (reference: profiling.c's record
+    # path touches only the per-thread buffer; sp-perf.c is the bar).
+
     def _begin(self, es, event, task) -> None:
         if not self.profile.enabled:
             return
+        tc = task.task_class
+        ck = tc.__dict__.get("_prof_key")
+        if ck is None or ck[0] is not self:
+            k = self._key(tc.name)
+            tc._prof_key = (self, k)
+            self._tagged.append((tc, "_prof_key"))
+        else:
+            k = ck[1]
+        cs = es.__dict__.get("_prof_sb")
+        sb = cs[1] if (cs is not None and cs[0] is self) else self._sb(es)
         eid = self.profile.next_event_id()
-        self._event_ids[task.seq] = eid
+        oid = hash(task.key)
+        task.prof = [k, eid, oid, False]
         info = {"locals": dict(task.locals)} if self.with_locals else None
-        self._sb(es).trace(self._key(task.task_class.name), EV_START,
-                           task.taskpool.taskpool_id, eid,
-                           hash(task.key), info)
+        sb.trace(k, EV_START, task.taskpool.taskpool_id, eid, oid, info)
 
     def _end(self, es, event, task) -> None:
-        if not self.profile.enabled:
+        p = task.prof
+        if p is None or not self.profile.enabled:
             return
-        eid = self._event_ids.get(task.seq, 0)
-        self._closed.add(eid)
-        self._sb(es).trace(self._key(task.task_class.name), EV_END,
-                           task.taskpool.taskpool_id, eid, hash(task.key))
+        p[3] = True
+        cs = es.__dict__.get("_prof_sb")
+        sb = cs[1] if (cs is not None and cs[0] is self) else self._sb(es)
+        sb.trace(p[0], EV_END, task.taskpool.taskpool_id, p[1], p[2])
 
     def _complete(self, es, event, task) -> None:
         # device (ASYNC) tasks never ran exec_end on a worker stream:
-        # close their interval at completion (closed-set membership, not
-        # a buffer scan — END events may live in the native buffer)
-        eid = self._event_ids.pop(task.seq, None)
-        if eid is None:
+        # close their interval at completion
+        p = task.prof
+        if p is None:
             return
-        if eid in self._closed:             # already closed by _end
-            self._closed.discard(eid)
+        task.prof = None
+        if p[3]:                            # already closed by _end
             return
-        self._sb(es).trace(self._key(task.task_class.name), EV_END,
-                           task.taskpool.taskpool_id, eid, hash(task.key))
+        cs = es.__dict__.get("_prof_sb")
+        sb = cs[1] if (cs is not None and cs[0] is self) else self._sb(es)
+        sb.trace(p[0], EV_END, task.taskpool.taskpool_id, p[1], p[2])
 
 
 def install_task_profiler(context, profile: Profile,
@@ -187,6 +227,11 @@ class IteratorsCheckerPins:
                     continue
                 succ_tc = tp.task_classes[end.task_class]
                 for succ_locals in end.instances(task.locals):
+                    # dep instances carry free params only; fill derived
+                    # locals before keying/ranking (mirrors release_deps,
+                    # else every derived-local successor class silently
+                    # escapes validation via the except below)
+                    succ_locals = succ_tc.complete_locals(succ_locals)
                     if succ_tc.rank_of(succ_locals) != myrank:
                         continue
                     want.add((succ_tc.name, succ_tc.make_key(succ_locals),

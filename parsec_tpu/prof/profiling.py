@@ -52,11 +52,12 @@ class StreamBuffer:
     """Per-execution-stream event buffer (reference: per-thread profiling
     buffers; appending never takes a lock).
 
-    Info-less events — the overwhelming majority — append into the
-    NATIVE C++ buffer when the native core is available (reference:
-    profiling.c's fixed-size binary records); events carrying a Python
-    info payload stay in the Python list; both merge, ordered by
-    timestamp, at dump time.
+    Info-less events — the overwhelming majority — take the C trace-sink
+    path when the pinsext extension builds (reference: profiling.c's
+    record path — one fixed-size append, timestamp taken in C); the
+    amortized ctypes-bulk path is the first fallback, a plain Python
+    list the last.  Events carrying a Python info payload stay in the
+    Python list; everything merges, ordered by timestamp, at dump time.
     """
 
     #: pending-list length that triggers a bulk flush into the native
@@ -70,23 +71,43 @@ class StreamBuffer:
         self.events: List[Tuple] = []
         self._pending: List[Tuple] = []
         self._native = None
+        self._sink = None
         try:
-            from parsec_tpu.native import NativeTraceBuffer, available
-            if available():
+            from parsec_tpu.native import (NativeTraceBuffer, available,
+                                           load_pinsext)
+            px = load_pinsext()
+            if px is not None:
+                self._sink = px.TraceSink()
+            elif available():
                 self._native = NativeTraceBuffer()
         except Exception:   # toolchain missing: pure-Python path
             self._native = None
+            self._sink = None
 
     def trace(self, key: int, flags: int, taskpool_id: int, event_id: int,
               object_id: int = 0, info: Any = None,
               timestamp: Optional[float] = None) -> None:
+        if info is None:
+            sink = self._sink
+            if sink is not None:
+                if timestamp is None:
+                    # ONE C call; the timestamp is taken inside, on the
+                    # same CLOCK_MONOTONIC timeline as perf_counter
+                    sink.event(key, flags, taskpool_id, event_id,
+                               object_id)
+                else:
+                    sink.event_at(key, flags, taskpool_id, event_id,
+                                  object_id, timestamp)
+                return
+            if self._native is not None:
+                ts = timestamp if timestamp is not None \
+                    else time.perf_counter()
+                self._pending.append((key, flags, taskpool_id, event_id,
+                                      object_id, ts))
+                if len(self._pending) >= self.FLUSH_CHUNK:
+                    self.flush_native()
+                return
         ts = timestamp if timestamp is not None else time.perf_counter()
-        if info is None and self._native is not None:
-            self._pending.append((key, flags, taskpool_id, event_id,
-                                  object_id, ts))
-            if len(self._pending) >= self.FLUSH_CHUNK:
-                self.flush_native()
-            return
         self.events.append((key, flags, taskpool_id, event_id, object_id,
                             ts, info))
 
@@ -98,7 +119,14 @@ class StreamBuffer:
             self._native.events_bulk(pending)
 
     def merged_events(self) -> List[Tuple]:
-        """All events (native + python), timestamp-ordered."""
+        """All events (C sink / native buffer / python), timestamp-ordered."""
+        if self._sink is not None:
+            merged = [ev + (None,) for ev in self._sink.drain()]
+            # a drained sink would lose events on a second call: keep
+            # them in the python list so dump() stays idempotent
+            self.events = merged + self.events
+            self.events.sort(key=lambda e: e[5])
+            return list(self.events)
         if self._native is None:
             return list(self.events)
         self.flush_native()
