@@ -64,11 +64,37 @@ def _k(name, maker):
 def _mk_geqrt():
     def fn(T, Q):
         import jax.numpy as jnp
+        from jax import lax
         # factor in f32 even under bf16 tile storage (mp mode); results
         # land back in the storage dtype (kernels are dtype-FOLLOWING,
-        # same discipline as apps/potrf.py)
-        q, r = jnp.linalg.qr(T.astype(jnp.float32), mode="reduced")
-        return {"T": r.astype(T.dtype), "Q": q.astype(T.dtype)}
+        # same discipline as apps/potrf.py).
+        # Cholesky-QR fast path (r5): XLA's QR expander runs at ~13 TF/s
+        # on this chip (measured) — ~43ms per diagonal tile — while
+        # gram+chol+tri_inv+matmul is matmul-class; the same
+        # equilibrate-then-guard discipline as TSQRT keeps LAPACK-class
+        # stability behind the cold fallback.  Construction at HIGHEST
+        # precision (cond^2-sensitive; see _mk_tsqrt).
+        import jax
+        hi = jax.lax.Precision.HIGHEST
+        Tf = T.astype(jnp.float32)
+        mb = Tf.shape[0]
+        G = jnp.matmul(Tf.T, Tf, precision=hi)
+        dg = jnp.sqrt(jnp.clip(jnp.diagonal(G), 1e-30, None))
+        Ls = jnp.linalg.cholesky(G / dg[:, None] / dg[None, :])
+        L = Ls * dg[:, None]
+
+        def fast(_):
+            R = L.T
+            Qm = jnp.matmul(Tf, tri_inv(L, precision=hi).T,
+                            precision=hi)
+            return R, Qm
+
+        def stable(_):
+            return jnp.linalg.qr(Tf, mode="reduced")[::-1]
+
+        R, Qm = lax.cond(jnp.all(jnp.isfinite(L)), fast, stable,
+                         operand=None)
+        return {"T": R.astype(T.dtype), "Q": Qm.astype(T.dtype)}
     return fn
 
 
@@ -80,22 +106,31 @@ def _mk_unmqr():
     return fn
 
 
-def _wy_from_L(R, B, L, xp, ti):
+def _wy_from_L(R, B, L, xp, ti, precision=None):
     """Closed-form compact-WY pair from ANY lower-triangular L with
     L L^T = R^T R + B^T B (Cholesky of the Gram matrix, however it was
-    obtained): returns (R', V, T^T)."""
+    obtained): returns (R', V, T^T).
+
+    ``precision``: matmul precision for the CONSTRUCTION (numpy path
+    ignores it).  On TPU this must be HIGHEST: the construction is
+    cond^2-sensitive, and XLA's DEFAULT f32 matmul (bf16 passes, ~1e-3
+    relative) amplifies through the triangular inverses to a DESTROYED
+    factorization — measured residual 1.19 at bench scale vs the
+    algorithm's true-f32 level of ~5e-3 (r5 diagnostic)."""
     mb = R.shape[0]
+    mm = (xp.matmul if precision is None
+          else (lambda a, b: xp.matmul(a, b, precision=precision)))
     # Householder sign choice: R'_jj = -sign(R_jj) * |R'_jj| makes
     # S = R - R' diagonally safe (|S_jj| >= |R'_jj|)
     d = xp.where(xp.diagonal(R) >= 0, -1.0, 1.0).astype(R.dtype)
     Rp = d[:, None] * L.T
     S = R - Rp
     Sinv = ti(S.T).T                  # S upper-tri -> invert transpose
-    V = B @ Sinv
+    V = mm(B, Sinv)
     Linv = ti(L)
     # R'^-T = (R'^T)^-1 = (L d)^-1 ... with the sign fold:
     # R' = D L^T  =>  R'^T = L D  =>  R'^-T = D^-1 L^-1 = D L^-1
-    Tt = xp.eye(mb, dtype=R.dtype) - (d[:, None] * Linv) @ R.T
+    Tt = xp.eye(mb, dtype=R.dtype) - mm(d[:, None] * Linv, R.T)
     return Rp, V, Tt
 
 
@@ -108,6 +143,7 @@ def _tsqrt_wy(R, B, xp, chol, ti):
 
 def _mk_tsqrt():
     def fn(T, B, Q):
+        import jax
         import jax.numpy as jnp
         from jax import lax
         T = T.astype(jnp.float32)      # WY construction runs in f32
@@ -119,8 +155,22 @@ def _mk_tsqrt():
         # reference TSQRT's algorithm: dplasma CORE_dtsqrt) that
         # produces the SAME triangular factor, then rebuild the
         # identical closed-form WY pair from it.
-        G = T.T @ T + B.T @ B
-        L = jnp.linalg.cholesky(G)
+        #
+        # The whole panel CONSTRUCTION runs at HIGHEST matmul precision
+        # (true f32): the Gram matrix, the triangular inverses, and the
+        # WY products are cond^2-sensitive, and DEFAULT's bf16 passes
+        # destroy the factorization (residual 1.19 measured).  Only the
+        # O(nt^2)-many panel tasks pay the ~3x; the O(nt^3) TSMQR bulk
+        # stays at DEFAULT, where errors enter the data linearly.
+        # Jacobi equilibration before the factor: D G D with unit
+        # diagonal keeps the decaying-R dynamic range out of the chol;
+        # the exact factor is recovered as L = D^-1 chol(D G D).
+        hi = jax.lax.Precision.HIGHEST
+        G = (jnp.matmul(T.T, T, precision=hi)
+             + jnp.matmul(B.T, B, precision=hi))
+        dg = jnp.sqrt(jnp.clip(jnp.diagonal(G), 1e-30, None))
+        Ls = jnp.linalg.cholesky(G / dg[:, None] / dg[None, :])
+        L = Ls * dg[:, None]
 
         def stable_L(_):
             Rh = jnp.linalg.qr(jnp.concatenate([T, B], axis=0), mode="r")
@@ -129,7 +179,9 @@ def _mk_tsqrt():
 
         L = lax.cond(jnp.all(jnp.isfinite(L)), lambda _: L, stable_L,
                      operand=None)
-        Rp, V, Tt = _wy_from_L(T, B, L, jnp, tri_inv)
+        Rp, V, Tt = _wy_from_L(T, B, L, jnp,
+                               lambda M: tri_inv(M, precision=hi),
+                               precision=hi)
         dt = Q.dtype                    # NEW-flow arena dtype = storage
         return {"T": Rp.astype(dt), "B": jnp.zeros_like(B, dtype=dt),
                 "Q": jnp.concatenate([V, Tt], axis=0).astype(dt)}

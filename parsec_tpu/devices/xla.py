@@ -53,6 +53,14 @@ def _transient_compile_error(exc: Exception) -> bool:
 
 params.register("device_inflight_depth", 8,
                 "max in-flight device tasks per XLA device")
+params.register("device_fuse_window_ms", 0.0,
+                "how long a manager waits for same-class siblings before "
+                "launching a narrower-than-device_fuse wave (ms).  On "
+                "tunneled TPUs each dispatched program costs ~10-15 ms "
+                "of fixed overhead, so trading a few ms of batching "
+                "window for 4-8x fewer programs wins whenever readiness "
+                "arrives in bursts (eager dep release makes it so); "
+                "0 = launch immediately (the right default off-tunnel)")
 params.register("device_runahead", 256,
                 "max eagerly-completed tasks with unmaterialized outputs "
                 "before the completer blocks (memory safety valve; each "
@@ -169,6 +177,88 @@ class XlaKernel:
     def bind_outputs(self, result: Any) -> Dict[str, Any]:
         from parsec_tpu.core.task import normalize_body_outputs
         return normalize_body_outputs(result, self.writable, what="kernel")
+
+    def fuse_ready(self, donate: bool, n: int, flat: Sequence[Any]) -> bool:
+        """Whether the width-``n`` fused program may be dispatched NOW.
+
+        First use of a fused width triggers a full XLA compile — minutes
+        for tri_inv-class programs on tunneled TPUs — and which widths a
+        run needs depends on nondeterministic wave scheduling, so a cold
+        width mid-measurement stalls the whole pipeline (the r4 geqrf
+        variance).  Instead of blocking, the first request WARMS the
+        width in a background thread (shape-only lower+compile — the
+        expensive XLA server compile lands in the server cache, so the
+        eventual jit call is cheap) and the caller falls back to the
+        already-compiled width-1 program."""
+        if n <= 1:
+            return True
+        key = ("w", donate, n, tuple(
+            (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else a
+            for a in flat))
+        with XlaKernel._jit_lock:
+            st = self._fast.get(key)
+            if st is True:
+                return True
+            if st == "warming":
+                return False
+            self._fast[key] = "warming"
+
+        specs = []
+        try:
+            import jax
+            for a in flat:
+                specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                             if hasattr(a, "shape") else a)
+        except Exception:
+            with XlaKernel._jit_lock:
+                self._fast.pop(key, None)
+            return False
+        _fuse_warmer.submit(self, key, donate, n, specs)
+        return False
+
+
+class _FuseWarmer:
+    """ONE background thread compiling fused-width programs serially:
+    concurrent huge remote compiles pressure the tunnel's compile
+    server (RESOURCE_EXHAUSTED observed with a free-for-all), and a
+    single queue still warms every width well before steady state."""
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._thread = None
+
+    def submit(self, spec, key, donate, n, arg_specs) -> None:
+        with self._cv:
+            self._q.append((spec, key, donate, n, arg_specs))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="xla-fuse-warm")
+                self._thread.start()
+            self._cv.notify()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                if not self._q:
+                    # linger briefly for more work, then retire
+                    self._cv.wait(5.0)
+                    if not self._q:
+                        return
+                spec, key, donate, n, arg_specs = self._q.popleft()
+            try:
+                spec.jitted_fused(donate, n).lower(*arg_specs).compile()
+                ok = True
+            except Exception:
+                ok = False
+            with XlaKernel._jit_lock:
+                if ok:
+                    spec._fast[key] = True
+                else:
+                    spec._fast.pop(key, None)   # retry some other time
+
+
+_fuse_warmer = _FuseWarmer()
 
 
 #: marks an LRU entry as an in-progress adopt claim (distinguishable from
@@ -319,25 +409,50 @@ class XlaDevice(Device):
         their queue order.  Caller holds ``_cond``."""
         first = self._pending.popleft()
         limit = int(params.get("device_fuse", 8))
-        if limit <= 1 or not self._pending:
+        if limit <= 1:
             return [first]
         task, spec, _load = first
         sig = self._fuse_sig(task, spec)
         if sig is None:
             return [first]
+        window = float(params.get("device_fuse_window_ms", 0.0)) * 1e-3
+        if not self._pending and window <= 0:
+            return [first]
         batch = [first]
         rest = []
-        # bound the scan at a small multiple of the fuse width: the lock
-        # is shared with submit()/sync(), so an unbounded walk over a
-        # deep mixed-class queue would serialize workers behind it
-        scan_budget = 4 * limit
-        while self._pending and len(batch) < limit and scan_budget > 0:
-            scan_budget -= 1
-            cand = self._pending.popleft()
-            if cand[1] is spec and self._fuse_sig(cand[0], spec) == sig:
-                batch.append(cand)
-            else:
-                rest.append(cand)
+        import time as _time
+        deadline = _time.monotonic() + window
+        while True:
+            # bound each scan at a small multiple of the fuse width: the
+            # lock is shared with submit()/sync(), so an unbounded walk
+            # over a deep mixed-class queue would serialize workers
+            scan_budget = 4 * limit
+            while self._pending and len(batch) < limit \
+                    and scan_budget > 0:
+                scan_budget -= 1
+                cand = self._pending.popleft()
+                if cand[1] is spec and \
+                        self._fuse_sig(cand[0], spec) == sig:
+                    batch.append(cand)
+                else:
+                    rest.append(cand)
+            if len(batch) >= limit or window <= 0:
+                break
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                break
+            # sibling-batching window: readiness arrives in bursts
+            # (deps release eagerly at dispatch), so a short wait
+            # consolidates the burst into one wide program.  Requeue the
+            # skipped non-matching entries BEFORE waiting — the wait
+            # releases _cond and the other manager must be able to
+            # dispatch those other-class tasks meanwhile.
+            for item in reversed(rest):
+                self._pending.appendleft(item)
+            rest = []
+            self._cond.wait(min(remaining, 0.002))
+            if self._stop:
+                break
         # quantize to the largest power of two <= wave size: each distinct
         # fused width is a separate XLA compile, so arbitrary widths would
         # keep tripping fresh compiles mid-run; powers of two cap the
@@ -463,23 +578,41 @@ class XlaDevice(Device):
                         flat.append(task.taskpool.globals.get(a))
             donate = self._donate and not self._donation_hazard(spec, flat)
 
+            def call1(fn, args):
+                """One jitted call with the transient-flake retry AT THE
+                CALL, never around a partially-executed sequence: an
+                error naming remote_compile died in the COMPILE phase —
+                nothing executed, donated inputs intact — so it retries
+                even with donation; other transient shapes retry only
+                when nothing was donated (a flake after donation leaves
+                the inputs deleted).  Retrying per call keeps the
+                singles-fallback path safe — already-executed siblings
+                consumed their donated buffers and must not replay."""
+                try:
+                    return fn(*args)
+                except Exception as exc:
+                    if not _transient_compile_error(exc) or \
+                            (donate and "remote_compile" not in str(exc)):
+                        raise
+                    warning("%s: transient compile failure (%s); "
+                            "retrying once", self.name, str(exc)[:120])
+                    return fn(*args)   # server-side cache warm now
+
             def dispatch():
                 if n == 1:
-                    return [spec.jitted(donate)(*flat)]
-                return list(spec.jitted_fused(donate, n)(*flat))
+                    return [call1(spec.jitted(donate), flat)]
+                if not spec.fuse_ready(donate, n, flat):
+                    # the fused width is still compiling in the
+                    # background (tri_inv-class programs take minutes
+                    # over the tunnel): dispatch singles now — the wave
+                    # fuses once the width is warm
+                    k = len(spec.arg_names)
+                    return [call1(spec.jitted(donate),
+                                  flat[i * k:(i + 1) * k])
+                            for i in range(n)]
+                return list(call1(spec.jitted_fused(donate, n), flat))
 
-            try:
-                results = dispatch()
-            except Exception as exc:   # transient tunnel compile flake
-                # retry ONLY when nothing was donated: a flake that hit
-                # after donation leaves the inputs deleted, and the
-                # string guard cannot distinguish compile- from
-                # execute-phase failure
-                if donate or not _transient_compile_error(exc):
-                    raise
-                warning("%s: transient compile failure (%s); retrying "
-                        "once", self.name, str(exc)[:120])
-                results = dispatch()   # server-side cache usually warm now
+            results = dispatch()
             if n > 1:
                 self.stats.fused_launches += 1
                 self.stats.fused_tasks += n
