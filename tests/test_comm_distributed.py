@@ -679,3 +679,121 @@ def test_wire_format_guard():
     # the unpicklable frame severed rank 3; the good peer kept talking
     assert 3 in res2["dead"], res2
     assert [m for _s, m in res2["got"]][-1] == "still-here", res2
+
+
+# -- reshape-corpus remote cases (VERDICT r4 missing #3; reference:
+# tests/collections/reshape/remote_read_reshape.jdf + remote_no_re_reshape
+# + the NEW-typed remote case) ---------------------------------------------
+
+def _remote_consumer_reshape(ctx, rank, nranks):
+    """Receiver-side IN dtt on a remote edge: the payload crosses the
+    wire in the producer's type; the CONSUMER's datatype lookup converts
+    on arrival (reference: remote_dep_get_datatypes)."""
+    import ml_dtypes
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.data.reshape import Dtt
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, TASK
+    bf = np.dtype(ml_dtypes.bfloat16)
+    V = VectorTwoDimCyclic(mb=4, lm=4 * nranks, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 3.0
+    seen = {}
+    p = PTG("rcr")
+    p.task("P") \
+        .affinity(lambda V=V: V(0)) \
+        .flow("X", "READ",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(TASK("C", "X", lambda: dict()))) \
+        .body(lambda: None)
+    p.task("C") \
+        .affinity(lambda V=V: V(1)) \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()), dtt=Dtt(dtype=bf))) \
+        .body(lambda X: seen.update(dtype=str(np.asarray(X).dtype),
+                                    val=float(np.asarray(X)[0])))
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=120)
+    return seen
+
+
+def test_remote_consumer_side_reshape():
+    res = run_distributed(_remote_consumer_reshape, 2)
+    assert res[1] == {"dtype": "bfloat16", "val": 3.0}
+
+
+def _remote_no_re_reshape(ctx, rank, nranks):
+    """OUT dtt and IN dtt name the SAME type on a remote edge: the
+    presend conversion must satisfy the receiver without a second
+    conversion (reference: remote_no_re_reshape.jdf)."""
+    import ml_dtypes
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.data.reshape import Dtt
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, TASK
+    bf = np.dtype(ml_dtypes.bfloat16)
+    V = VectorTwoDimCyclic(mb=4, lm=4 * nranks, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 5.0
+    seen = {}
+    p = PTG("rnr")
+    p.task("P") \
+        .affinity(lambda V=V: V(0)) \
+        .flow("X", "READ",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(TASK("C", "X", lambda: dict()), dtt=Dtt(dtype=bf))) \
+        .body(lambda: None)
+    p.task("C") \
+        .affinity(lambda V=V: V(1)) \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()), dtt=Dtt(dtype=bf))) \
+        .body(lambda X: seen.update(dtype=str(np.asarray(X).dtype),
+                                    val=float(np.asarray(X)[0])))
+    tp = p.build()
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=120)
+    # receiver-side: the arrived payload is ALREADY bf16, so the IN dtt
+    # must not convert again
+    return {"seen": seen, "conv": tp.reshape.conversions}
+
+
+def test_remote_no_re_reshape():
+    res = run_distributed(_remote_no_re_reshape, 2)
+    assert res[1]["seen"] == {"dtype": "bfloat16", "val": 5.0}
+    assert res[1]["conv"] == 0      # consumer rank: no re-reshape
+
+
+def _remote_new_flow_reshape(ctx, rank, nranks):
+    """A NEW-flow arena temporary crossing ranks with a consumer-side
+    dtt: the reference's remote reshape-into-NEW case."""
+    import ml_dtypes
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.data.reshape import Dtt
+    from parsec_tpu.dsl.ptg.api import IN, NEW, OUT, PTG, TASK
+    bf = np.dtype(ml_dtypes.bfloat16)
+    V = VectorTwoDimCyclic(mb=4, lm=4 * nranks, nodes=nranks, myrank=rank)
+    seen = {}
+    p = PTG("rnew")
+    p.arena("scratch", (4,), np.float32)
+
+    def produce(X):
+        X[:] = np.arange(4, dtype=np.float32) + 1.0
+    p.task("P") \
+        .affinity(lambda V=V: V(0)) \
+        .flow("X", "RW",
+              IN(NEW("scratch")),
+              OUT(TASK("C", "X", lambda: dict()))) \
+        .body(produce)
+    p.task("C") \
+        .affinity(lambda V=V: V(1)) \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()), dtt=Dtt(dtype=bf))) \
+        .body(lambda X: seen.update(
+            dtype=str(np.asarray(X).dtype),
+            vals=[float(v) for v in np.asarray(X).astype(np.float32)]))
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=120)
+    return seen
+
+
+def test_remote_new_flow_reshape():
+    res = run_distributed(_remote_new_flow_reshape, 2)
+    assert res[1] == {"dtype": "bfloat16", "vals": [1.0, 2.0, 3.0, 4.0]}

