@@ -53,6 +53,16 @@ def _transient_compile_error(exc: Exception) -> bool:
 
 params.register("device_inflight_depth", 8,
                 "max in-flight device tasks per XLA device")
+params.register("device_fuse_bg", 1,
+                "compile fused-width programs in a background thread "
+                "and dispatch singles meanwhile (0 = compile "
+                "synchronously on first use, stalling the wave)")
+params.register("device_fuse_warm_wait_ms", 3000.0,
+                "how long a wave waits for its fused-width program's "
+                "background compile before falling back to de-fused "
+                "singles: long enough to cover a server-cached compile "
+                "(~1-3s), far below a cold tri_inv-class compile "
+                "(minutes)")
 params.register("device_fuse_window_ms", 0.0,
                 "how long a manager waits for same-class siblings before "
                 "launching a narrower-than-device_fuse wave (ms).  On "
@@ -192,6 +202,9 @@ class XlaKernel:
         already-compiled width-1 program."""
         if n <= 1:
             return True
+        if not int(params.get("device_fuse_bg", 1)):
+            return True    # kill-switch: compile widths synchronously
+        import time as _time
         key = ("w", donate, n, tuple(
             (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else a
             for a in flat))
@@ -201,6 +214,10 @@ class XlaKernel:
                 return True
             if st == "warming":
                 return False
+            if isinstance(st, tuple) and st[0] == "failed":
+                if _time.monotonic() - st[1] < 60.0:
+                    return False    # backoff: singles, no wait
+                self._fast.pop(key, None)
             self._fast[key] = "warming"
 
         specs = []
@@ -214,6 +231,23 @@ class XlaKernel:
                 self._fast.pop(key, None)
             return False
         _fuse_warmer.submit(self, key, donate, n, specs)
+        # Bounded wait: when the program is server-cached (steady state,
+        # earlier sessions), the background compile lands in ~1-3s and
+        # dispatching FUSED is far cheaper than a de-fused singles rep
+        # (measured: potrf lost 30% to eager singles).  A genuinely cold
+        # tri_inv-class program blows past the bound and the wave takes
+        # the singles path while the compile finishes in background.
+        wait_s = float(params.get("device_fuse_warm_wait_ms", 3000.0)) \
+            * 1e-3
+        deadline = _time.monotonic() + wait_s
+        while _time.monotonic() < deadline:
+            with XlaKernel._jit_lock:
+                st = self._fast.get(key)
+            if st is True:
+                return True
+            if st != "warming":
+                return False     # warm failed; singles this time
+            _time.sleep(0.05)
         return False
 
 
@@ -227,6 +261,7 @@ class _FuseWarmer:
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._thread = None
+        self._busy = 0
 
     def submit(self, spec, key, donate, n, arg_specs) -> None:
         with self._cv:
@@ -235,30 +270,64 @@ class _FuseWarmer:
                 self._thread = threading.Thread(
                     target=self._run, daemon=True, name="xla-fuse-warm")
                 self._thread.start()
-            self._cv.notify()
+            self._cv.notify_all()
+
+    def wait_idle(self, timeout: float = 600.0) -> bool:
+        """Block until every queued width compile has finished — the
+        bench-warmup hook: a timed rep must not run de-fused because
+        its widths are still warming (see xla.wait_fuse_warm)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        with self._cv:
+            while self._q or self._busy:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.2))
+        return True
 
     def _run(self):
         while True:
             with self._cv:
                 if not self._q:
-                    # linger briefly for more work, then retire
+                    # linger briefly for more work, then retire —
+                    # clearing _thread UNDER THE LOCK first, so a
+                    # submit() racing the unwind sees a dead warmer and
+                    # restarts one (else its item would never compile)
                     self._cv.wait(5.0)
                     if not self._q:
+                        self._thread = None
                         return
                 spec, key, donate, n, arg_specs = self._q.popleft()
+                self._busy += 1
             try:
                 spec.jitted_fused(donate, n).lower(*arg_specs).compile()
                 ok = True
             except Exception:
                 ok = False
+            import time as _time
             with XlaKernel._jit_lock:
                 if ok:
                     spec._fast[key] = True
                 else:
-                    spec._fast.pop(key, None)   # retry some other time
+                    # failure memoization with backoff: a persistently
+                    # failing width must not make every wave re-pay the
+                    # bounded wait (fuse_ready checks the stamp)
+                    spec._fast[key] = ("failed", _time.monotonic())
+            with self._cv:
+                self._busy -= 1
+                self._cv.notify_all()
 
 
 _fuse_warmer = _FuseWarmer()
+
+
+def wait_fuse_warm(timeout: float = 600.0) -> bool:
+    """Wait for all in-flight fused-width background compiles (benches
+    call this between warmup and timed reps, then run ONE more warm
+    pass so the newly-ready widths' client-side jit calls also land in
+    cache — otherwise reps run de-fused singles while widths warm)."""
+    return _fuse_warmer.wait_idle(timeout)
 
 
 #: marks an LRU entry as an in-progress adopt claim (distinguishable from
