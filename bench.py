@@ -410,8 +410,9 @@ def run_potrf_bench(mb: int, nt: int, reps: int = 3,
         ctx.wait()
         _fence(A)
         log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
-        _drain_fuse_warm(ctx, lambda: (reset(), ctx.add_taskpool(
-            potrf_taskpool(A, device="tpu")), ctx.wait(), _fence(A)))
+        _drain_fuse_warm(ctx, lambda: (
+            _discard_device_scratch(ctx), reset(), ctx.add_taskpool(
+                potrf_taskpool(A, device="tpu")), ctx.wait(), _fence(A)))
         rtt0 = _fence_rtt(A)
         log(f"idle fence RTT: {rtt0 * 1e3:.0f} ms")
         floor = flops / (peak_gflops * 1e9) if peak_gflops else 0.0
@@ -1080,8 +1081,9 @@ def _run_geqrf_inner(A, mb, nt, n, flops, reps, peak_gflops, mp):
         ctx.wait()
         _fence(A)
         log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
-        _drain_fuse_warm(ctx, lambda: (reset(), ctx.add_taskpool(
-            qr_taskpool(A, device="tpu")), ctx.wait(), _fence(A)))
+        _drain_fuse_warm(ctx, lambda: (
+            _discard_device_scratch(ctx), reset(), ctx.add_taskpool(
+                qr_taskpool(A, device="tpu")), ctx.wait(), _fence(A)))
         rtt0 = _fence_rtt(A)
         log(f"idle fence RTT: {rtt0 * 1e3:.0f} ms")
         floor = flops / (peak_gflops * 1e9) if peak_gflops else 0.0
@@ -1173,8 +1175,11 @@ def main():
         # and every bench run now records the factorization residual.
         mp = on_tpu and os.environ.get("PARSEC_BENCH_GEQRF_MP", "1") == "1"
         mb = int(os.environ.get("PARSEC_BENCH_MB", 6144 if on_tpu else 16))
+        # nt=8 mp: 4.8GB resident bf16 tiles — nt=10 measured marginally
+        # better when the tunnel server was healthy but OOMs under
+        # server memory pressure; robustness wins for the default
         nt = int(os.environ.get("PARSEC_BENCH_NT",
-                                (10 if mp else 6) if on_tpu else 3))
+                                (8 if mp else 6) if on_tpu else 3))
         from parsec_tpu.utils.mca import params as _params
         _params.set("device_fuse",
                     int(os.environ.get("PARSEC_BENCH_FUSE", 8)))
