@@ -417,6 +417,12 @@ def run_potrf_bench(mb: int, nt: int, reps: int = 3,
         log(f"idle fence RTT: {rtt0 * 1e3:.0f} ms")
         floor = flops / (peak_gflops * 1e9) if peak_gflops else 0.0
         for r in range(reps):
+            # drop the previous rep's dead arena scratch (panel
+            # inverses) BEFORE the timed region: accumulated dead
+            # buffers churn the device allocator and were measured
+            # degrading later reps 96 -> 69 TF/s within one run —
+            # which a median protocol is directly sensitive to
+            _discard_device_scratch(ctx)
             reset()
             _perturb(A, r)   # reset() regenerates IDENTICAL data: make
             t0 = time.perf_counter()   # each rep fresh work (dedup-proof)
@@ -1088,6 +1094,7 @@ def _run_geqrf_inner(A, mb, nt, n, flops, reps, peak_gflops, mp):
         log(f"idle fence RTT: {rtt0 * 1e3:.0f} ms")
         floor = flops / (peak_gflops * 1e9) if peak_gflops else 0.0
         for r in range(reps):
+            _discard_device_scratch(ctx)   # see potrf rep loop
             reset()
             _perturb(A, r)
             t0 = time.perf_counter()
