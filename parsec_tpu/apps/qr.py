@@ -82,18 +82,30 @@ def _mk_geqrt():
         dg = jnp.sqrt(jnp.clip(jnp.diagonal(G), 1e-30, None))
         Ls = jnp.linalg.cholesky(G / dg[:, None] / dg[None, :])
         L = Ls * dg[:, None]
+        # CholeskyQR2: one Cholesky-QR pass loses orthogonality as
+        # cond^2*eps — tiles with cond in ~1e2..3e3 pass the finite
+        # check yet come out visibly non-orthogonal in f32.  A second
+        # Gram+chol pass on Q1 (whose cond is ~1+cond^2*eps, so its
+        # Cholesky is unconditionally benign whenever L was finite)
+        # restores eps-level orthogonality; still pure matmul+chol, so
+        # the whole fast path stays on the MXU.  R folds exactly:
+        # A = Q1 L^T, Q1 = Q2 L2^T  =>  A = Q2 (L2^T L^T).
+        Q1 = jnp.matmul(Tf, tri_inv(L, precision=hi).T, precision=hi)
+        G2 = jnp.matmul(Q1.T, Q1, precision=hi)
+        L2 = jnp.linalg.cholesky(G2)
 
         def fast(_):
-            R = L.T
-            Qm = jnp.matmul(Tf, tri_inv(L, precision=hi).T,
+            R = jnp.matmul(L2.T, L.T, precision=hi)
+            Qm = jnp.matmul(Q1, tri_inv(L2, precision=hi).T,
                             precision=hi)
             return R, Qm
 
         def stable(_):
             return jnp.linalg.qr(Tf, mode="reduced")[::-1]
 
-        R, Qm = lax.cond(jnp.all(jnp.isfinite(L)), fast, stable,
-                         operand=None)
+        ok = jnp.logical_and(jnp.all(jnp.isfinite(L)),
+                             jnp.all(jnp.isfinite(L2)))
+        R, Qm = lax.cond(ok, fast, stable, operand=None)
         return {"T": R.astype(T.dtype), "Q": Qm.astype(T.dtype)}
     return fn
 

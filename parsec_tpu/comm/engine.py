@@ -450,6 +450,7 @@ class SocketCE(CommEngine):
                 time.sleep(0.01)
         peer_host = self._hosts[dst] if self._hosts else "127.0.0.1"
         deadline = time.monotonic() + 30
+        s = None
         while True:
             try:
                 # buffers must be sized BEFORE connect() so the window
@@ -462,10 +463,15 @@ class SocketCE(CommEngine):
                 s.settimeout(None)
                 break
             except OSError:
+                # socket() itself may have raised, leaving s unbound for
+                # this iteration — a bare close() would turn the retry
+                # into a NameError escaping the deadline logic
                 try:
-                    s.close()
+                    if s is not None:
+                        s.close()
                 except OSError:
                     pass
+                s = None
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.05)

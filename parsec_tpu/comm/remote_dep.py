@@ -664,10 +664,12 @@ class RemoteDepEngine:
                 tp.termdet.taskpool_addto_runtime_actions(tp, -1)
         self._dyn_released.set()
 
-    def resolve_dynamic_holds(self, timeout: float = 120.0) -> None:
+    def resolve_dynamic_holds(self, timeout: Optional[float] = None) -> None:
         """Block until every rank's dynamic pools drained with no
         discovery message in flight, then release their holds everywhere
-        (called by Context.wait before the completion wait)."""
+        (called by Context.wait before the completion wait).  ``None``
+        waits indefinitely — Context.wait(timeout=None) must not impose
+        a spurious hard deadline on distributed dynamic pools."""
         with self._term_lock:
             if not self._dyn_holds:
                 return
@@ -687,13 +689,13 @@ class RemoteDepEngine:
                     "rounds": 0})
             threading.Thread(target=kick, daemon=True).start()
         import time
-        deadline = time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         while not self._dyn_released.wait(0.05):
             if self.ce.dead_peers:
                 raise ConnectionError(
                     f"rank {self.rank}: dynamic-pool quiescence with "
                     f"dead peer(s) {sorted(self.ce.dead_peers)}")
-            if time.monotonic() > deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"rank {self.rank}: dynamic-pool termination not "
                     "reached")

@@ -251,8 +251,10 @@ class Context:
         if self.comm is not None:
             # dynamic pools hold a runtime action until the pool-scoped
             # quiescence round proves every rank drained (see
-            # DynamicTaskpool.attach); resolve before waiting on them
-            self.comm.resolve_dynamic_holds(timeout or 120.0)
+            # DynamicTaskpool.attach); resolve before waiting on them.
+            # timeout=None means wait indefinitely, like the completion
+            # wait below — not a default deadline.
+            self.comm.resolve_dynamic_holds(timeout)
         with self._cond:
             ok = self._cond.wait_for(
                 lambda: self._active_taskpools == 0 or self._errors,
@@ -266,10 +268,7 @@ class Context:
         # dispatch (devices/xla.py completer), so pool termination means
         # "all work dispatched" — quiescence means "all work done", and
         # late device-side failures surface here
-        for d in self.device_registry.accelerators:
-            dsync = getattr(d, "sync", None)
-            if dsync is not None:
-                dsync(timeout=timeout)
+        self.sync_devices(timeout=timeout)
         if self._errors:
             exc, task = self._errors[0]
             raise RuntimeError(f"task {task} failed") from exc
@@ -279,6 +278,14 @@ class Context:
             # progressing comm until termdet quiesces the whole run)
             self.comm.wait_quiescence()
 
+    def sync_devices(self, timeout: Optional[float] = None) -> None:
+        """Quiesce accelerator pipelines (shared by wait() and the job
+        service's per-job result path); raises late device failures."""
+        for d in self.device_registry.accelerators:
+            dsync = getattr(d, "sync", None)
+            if dsync is not None:
+                dsync(timeout=timeout)
+
     def record_error(self, exc: Exception, task: Task) -> None:
         from parsec_tpu.utils.debug_history import dump_history, paranoid
         if paranoid(1):
@@ -286,6 +293,17 @@ class Context:
             if marks:
                 debug_verbose(1, "debug history (%d marks, newest last):\n%s",
                               len(marks), "\n".join(marks[-64:]))
+        # per-pool error isolation (job service): a pool carrying an
+        # error_sink keeps its failures to itself — one job's crash must
+        # not poison the context for concurrently-running jobs
+        tp = getattr(task, "taskpool", None)
+        sink = getattr(tp, "error_sink", None) if tp is not None else None
+        if sink is not None:
+            try:
+                sink(exc, task)
+                return
+            except Exception as sink_exc:   # a broken sink falls back to
+                debug_verbose(1, "error_sink failed: %s", sink_exc)
         with self._cond:
             self._errors.append((exc, task))
             self._cond.notify_all()

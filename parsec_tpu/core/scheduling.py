@@ -65,6 +65,22 @@ def execute(es, task: Task) -> HookReturn:
 def task_progress(es, task: Task, distance: int = 0) -> None:
     """Run one task through its lifecycle
     (reference: __parsec_task_progress)."""
+    tp = task.taskpool
+    if tp.cancelled:
+        # cancelled pool (job-service cancellation/deadline): drop the
+        # task without executing or releasing successors; the termdet
+        # was force-quiesced, so this decrement clamps at zero.  The
+        # ready task holds predecessor repo entries (input_sources,
+        # filled at dep delivery) — release them or the warm context
+        # leaks the cancelled frontier's arena tiles
+        task.status = TaskStatus.COMPLETE
+        es.pins("task_discard", task)
+        try:
+            engine.consume_inputs(task)
+        except Exception as exc:
+            debug_verbose(2, "discard %s: consume_inputs: %s", task, exc)
+        tp.termdet.taskpool_addto_nb_tasks(tp, -1)
+        return
     es.pins("exec_begin", task)
     try:
         if task.status < TaskStatus.PREPARED:

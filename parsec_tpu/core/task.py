@@ -350,8 +350,12 @@ class Task:
         self.taskpool = taskpool
         self.locals = dict(locals_)
         self.key = task_class.make_key(self.locals)
+        # class-level priority plus the pool-wide bias (Taskpool.priority;
+        # the job service sets it per job so priority schedulers
+        # interleave concurrent jobs by weight)
         self.priority = (task_class.priority(self.locals)
-                         if task_class.priority else 0)
+                         if task_class.priority else 0) \
+            + getattr(taskpool, "priority", 0)
         self.status = TaskStatus.PENDING
         #: flow name -> DataCopy bound for this execution
         self.data: Dict[str, Optional[DataCopy]] = {}

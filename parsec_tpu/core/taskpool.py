@@ -74,7 +74,21 @@ class Taskpool:
         self.info = InfoObjectArray(taskpool_info, owner=self)
         self._complete_cbs: List[Callable[["Taskpool"], None]] = []
         self._done_event = threading.Event()
+        #: pool-wide priority bias added to every task's priority — the
+        #: job-service fairness lever: per-job priority rides into the
+        #: priority schedulers (sched/local_queues pbq/ltq/lhq) so
+        #: concurrent jobs interleave by weight instead of FIFO order
         self.priority = 0
+        #: cancellation flag: workers discard (not execute) tasks of a
+        #: cancelled pool, and the termdet clamps its counters at zero
+        self.cancelled = False
+        #: owning job id when enqueued through the job service (tags
+        #: PINS events / per-job gauges); None for plain batch pools
+        self.job_id: Optional[int] = None
+        #: per-pool error route: when set, task errors of this pool go
+        #: here instead of poisoning the whole context
+        #: (``sink(exc, task)``; see Context.record_error)
+        self.error_sink: Optional[Callable] = None
 
     # -- construction ------------------------------------------------------
     def add_task_class(self, tc: TaskClass) -> TaskClass:
@@ -127,6 +141,24 @@ class Taskpool:
         if self.context is not None:
             self.context._taskpool_terminated(self)
         self._done_event.set()
+
+    def cancel(self) -> None:
+        """Cancel the pool: undelivered tasks are dropped at selection
+        (scheduling.task_progress discards tasks of cancelled pools) and
+        the termdet is force-quiesced so termination fires without the
+        remaining counts draining naturally.  In-flight tasks finish
+        their current execution; their late counter decrements clamp at
+        zero (termdet tolerates cancelled pools).  Idempotent, callable
+        from any thread."""
+        self.cancelled = True
+        if self.state == TaskpoolState.DONE:
+            return
+        if self.termdet is not None and self.state != TaskpoolState.CREATED:
+            self.termdet.taskpool_force_quiesce(self)
+        else:
+            # never attached: nothing was scheduled, close out locally
+            self.state = TaskpoolState.DONE
+            self._done_event.set()
 
     def wait_local(self, timeout: Optional[float] = None) -> bool:
         return self._done_event.wait(timeout)
@@ -238,13 +270,19 @@ class Compound(Taskpool):
         stack."""
         while True:
             with self._clock:
-                if self._driving or self._idx >= len(self.pools):
+                if self._driving or self._idx >= len(self.pools) \
+                        or self.cancelled:
                     return
                 self._driving = True
                 launched = self._idx
                 pool = self.pools[launched]
             pool.on_complete(self._sub_done)
             self.context.add_taskpool(pool, start=True)
+            # cancel() racing this launch saw the sub-pool CREATED and
+            # skipped it; it set our flag BEFORE reading the state, so
+            # re-checking after attach closes the window
+            if self.cancelled and not pool.cancelled:
+                pool.cancel()
             with self._clock:
                 self._driving = False
                 advanced = self._idx > launched
@@ -258,6 +296,19 @@ class Compound(Taskpool):
         self.termdet.taskpool_addto_runtime_actions(self, -1)
         if not driving:
             self._drive()
+
+    def cancel(self) -> None:
+        """Cancel the composition: the active sub-pool is cancelled,
+        not-yet-launched sub-pools never start (_drive checks the flag),
+        and the compound's own held actions are force-quiesced."""
+        self.cancelled = True
+        with self._clock:
+            active = (self.pools[self._idx]
+                      if self._idx < len(self.pools) else None)
+        if active is not None and active.state not in (
+                TaskpoolState.CREATED, TaskpoolState.DONE):
+            active.cancel()
+        super().cancel()
 
 
 def compose(*pools: Taskpool) -> Compound:

@@ -50,6 +50,14 @@ class Termdet:
     def taskpool_addto_runtime_actions(self, taskpool, delta: int) -> int:
         raise NotImplementedError
 
+    def taskpool_force_quiesce(self, taskpool) -> None:
+        """Cancellation support (job service): zero the counters and fire
+        termination immediately, regardless of undelivered tasks.  After
+        this, late decrements from in-flight tasks of the (cancelled)
+        pool must clamp at zero instead of going negative."""
+        raise NotImplementedError(
+            f"termdet {self.name!r} does not support cancellation")
+
     # message-counting hooks for distributed modules (no-ops locally;
     # reference: termdet.h:171-243)
     def outgoing_message_start(self, taskpool, dst: int) -> None:
@@ -103,8 +111,15 @@ class LocalTermdet(Termdet):
             setattr(taskpool, field, getattr(taskpool, field) + delta)
             val = getattr(taskpool, field)
             if val < 0:
-                raise RuntimeError(
-                    f"{field} of {taskpool} went negative ({val})")
+                if getattr(taskpool, "cancelled", False):
+                    # force_quiesce already zeroed the counters; late
+                    # decrements from tasks that were in flight at
+                    # cancellation clamp instead of going negative
+                    setattr(taskpool, field, 0)
+                    val = 0
+                else:
+                    raise RuntimeError(
+                        f"{field} of {taskpool} went negative ({val})")
             if st is not None and self._check(taskpool, st):
                 st["state"] = TermdetState.TERMINATED
                 fire = True
@@ -117,6 +132,22 @@ class LocalTermdet(Termdet):
 
     def taskpool_addto_runtime_actions(self, taskpool, delta: int) -> int:
         return self._addto(taskpool, "nb_pending_actions", delta)
+
+    def taskpool_force_quiesce(self, taskpool) -> None:
+        """Zero the counters and fire termination now (cancellation; see
+        Taskpool.cancel).  Safe against concurrent normal termination:
+        the state machine fires the callback exactly once."""
+        fire = False
+        with self._lock:
+            st = self._state.get(id(taskpool))
+            taskpool.nb_tasks = 0
+            taskpool.nb_pending_actions = 0
+            if st is not None and st["state"] in (TermdetState.NOT_READY,
+                                                  TermdetState.BUSY):
+                st["state"] = TermdetState.TERMINATED
+                fire = True
+        if fire:
+            st["cb"]()
 
 
 class UserTriggerTermdet(LocalTermdet):

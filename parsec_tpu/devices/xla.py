@@ -669,20 +669,22 @@ class XlaDevice(Device):
 
             def dispatch():
                 if n == 1:
-                    return [call1(spec.jitted(donate), flat)]
+                    return False, [call1(spec.jitted(donate), flat)]
                 if not spec.fuse_ready(donate, n, flat):
                     # the fused width is still compiling in the
                     # background (tri_inv-class programs take minutes
                     # over the tunnel): dispatch singles now — the wave
                     # fuses once the width is warm
                     k = len(spec.arg_names)
-                    return [call1(spec.jitted(donate),
-                                  flat[i * k:(i + 1) * k])
-                            for i in range(n)]
-                return list(call1(spec.jitted_fused(donate, n), flat))
+                    return False, [call1(spec.jitted(donate),
+                                         flat[i * k:(i + 1) * k])
+                                   for i in range(n)]
+                return True, list(call1(spec.jitted_fused(donate, n), flat))
 
-            results = dispatch()
-            if n > 1:
+            fused, results = dispatch()
+            if fused:
+                # count only waves the fused program actually executed —
+                # a de-fused n>1 wave (fuse_ready False) ran singles
                 self.stats.fused_launches += 1
                 self.stats.fused_tasks += n
             outs_per_task = [spec.bind_outputs(r) for r in results]

@@ -14,9 +14,13 @@ from typing import Any, Dict, Optional
 
 from parsec_tpu.prof.profiling import EV_END, EV_POINT, EV_START, Profile
 
-#: lifecycle events emitted by the runtime (scheduling.py / context.py)
+#: lifecycle events emitted by the runtime (scheduling.py / context.py).
+#: ``task_discard`` fires for tasks dropped by pool cancellation; the
+#: ``job_*`` events are emitted by the job service (service/service.py)
+#: with the Job as payload.
 PINS_EVENTS = ("select", "exec_begin", "exec_end", "exec_async",
-               "complete_exec")
+               "complete_exec", "task_discard",
+               "job_submit", "job_start", "job_done")
 
 
 class TaskProfilerPins:

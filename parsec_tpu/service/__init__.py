@@ -1,0 +1,13 @@
+"""Resident job service: multi-tenant concurrent taskpool submission.
+
+One warm Context serves a stream of independent jobs with admission
+control, weighted fairness, per-job lifecycle (cancel/deadline), error
+isolation, and per-job observability — the serving layer over the
+batch runtime (see service/service.py for the design notes, and
+service/server.py + tools/job_client.py for the socket front end).
+"""
+
+from parsec_tpu.service.job import (AdmissionError, JobCancelled,  # noqa: F401
+                                    JobError, JobHandle, JobStatus,
+                                    JobTimeout)
+from parsec_tpu.service.service import JobService  # noqa: F401

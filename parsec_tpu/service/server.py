@@ -1,0 +1,357 @@
+"""Socket front end for the job service: external processes submit jobs.
+
+A small TCP server in front of a JobService so a resident runtime can be
+fed from other processes (tools/job_client.py is the CLI).  The wire
+reuses the framing discipline of comm/engine.py — a fixed struct header
+carrying magic + protocol version + payload length, rejected on
+mismatch — with JSON payloads (requests are control-plane sized, not
+tile data):
+
+    !4sII header: (b"PTJS", version, length) then <length> bytes of JSON
+
+Requests are one JSON object; every request gets one JSON reply with an
+``ok`` flag.  Ops:
+
+    {"op": "submit", "app": "gemm", "params": {...}, "priority": 5,
+     "deadline": 30.0, "client": "cli"}      -> {"ok": true, "job": 7}
+    {"op": "status", "job": 7}               -> {"ok": true, "info": {...}}
+    {"op": "result", "job": 7, "timeout": 60}-> {"ok": true, "result": {...}}
+    {"op": "cancel", "job": 7}               -> {"ok": true, "cancelled": b}
+    {"op": "jobs"} / {"op": "stats"} / {"op": "gauges"} / {"op": "apps"}
+
+Named apps (the multi-tenant demo catalog) build small self-contained
+problems from JSON params and return JSON-able result summaries — the
+server never ships tiles over this socket.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from parsec_tpu.service.job import AdmissionError, JobError
+from parsec_tpu.service.service import JobService
+from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import warning
+
+_HDR = struct.Struct("!4sII")      # (magic, proto version, payload bytes)
+_MAGIC = b"PTJS"
+_VERSION = 1
+_MAX_PAYLOAD = 1 << 20             # control plane: 1 MiB is already huge
+
+params.register("service_port", 41990, "job-server default TCP port")
+
+
+# ---------------------------------------------------------------------------
+# framing (shared by server and client)
+# ---------------------------------------------------------------------------
+
+def send_msg(conn: socket.socket, obj: Dict[str, Any]) -> None:
+    payload = json.dumps(obj).encode()
+    conn.sendall(_HDR.pack(_MAGIC, _VERSION, len(payload)) + payload)
+
+
+def recv_msg(conn: socket.socket) -> Optional[Dict[str, Any]]:
+    hdr = _recv_exact(conn, _HDR.size)
+    if hdr is None:
+        return None
+    magic, ver, n = _HDR.unpack(hdr)
+    if magic != _MAGIC or ver != _VERSION or n > _MAX_PAYLOAD:
+        raise ConnectionError(
+            f"bad job-wire header (magic={magic!r} version={ver} len={n})")
+    payload = _recv_exact(conn, n)
+    if payload is None:
+        return None
+    return json.loads(payload)
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = conn.recv(n - len(buf))
+        except socket.timeout:
+            # distinguish "server is slow" from "server closed" for
+            # clients with a socket timeout (request()); the server's
+            # own sockets are blocking and never hit this
+            raise TimeoutError("job-server reply timed out")
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# named app catalog
+# ---------------------------------------------------------------------------
+
+def _gemm_factory(p: Dict[str, Any]) -> Callable:
+    n = int(p.get("n", 256))
+    nb = int(p.get("nb", 64))
+    seed = int(p.get("seed", 0))
+    device = str(p.get("device", "cpu"))
+
+    def factory():
+        from parsec_tpu.apps.gemm import gemm_taskpool
+        from parsec_tpu.data.matrix import TwoDimBlockCyclic
+        rng = np.random.default_rng(seed)
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        b = rng.standard_normal((n, n)).astype(np.float32)
+        A = TwoDimBlockCyclic(mb=nb, nb=nb, lm=n, ln=n).from_array(a)
+        B = TwoDimBlockCyclic(mb=nb, nb=nb, lm=n, ln=n).from_array(b)
+        C = TwoDimBlockCyclic(mb=nb, nb=nb, lm=n, ln=n).from_array(
+            np.zeros((n, n), np.float32))
+        tp = gemm_taskpool(A, B, C, beta=0.0, device=device)
+
+        def result():
+            out = C.to_array()
+            return {"app": "gemm", "n": n,
+                    "fro_norm": float(np.linalg.norm(out))}
+        return tp, result
+    return factory
+
+
+def _potrf_factory(p: Dict[str, Any]) -> Callable:
+    n = int(p.get("n", 128))
+    nb = int(p.get("nb", 32))
+    seed = int(p.get("seed", 0))
+    device = str(p.get("device", "cpu"))
+
+    def factory():
+        from parsec_tpu.apps.potrf import potrf_taskpool
+        from parsec_tpu.data.matrix import TwoDimBlockCyclic
+        rng = np.random.default_rng(seed)
+        b = rng.standard_normal((n, n)).astype(np.float32)
+        spd = (b @ b.T + n * np.eye(n)).astype(np.float32)
+        A = TwoDimBlockCyclic(mb=nb, nb=nb, lm=n, ln=n).from_array(
+            spd.copy())
+        tp = potrf_taskpool(A, device=device)
+
+        def result():
+            L = np.tril(A.to_array())
+            err = float(np.abs(L @ L.T - spd).max()
+                        / np.abs(spd).max())
+            return {"app": "potrf", "n": n, "residual": err}
+        return tp, result
+    return factory
+
+
+def _stencil_factory(p: Dict[str, Any]) -> Callable:
+    n = int(p.get("n", 256))
+    nb = int(p.get("nb", 64))
+    steps = int(p.get("steps", 8))
+    seed = int(p.get("seed", 0))
+    device = str(p.get("device", "cpu"))
+
+    def factory():
+        from parsec_tpu.apps.stencil import stencil_taskpool
+        from parsec_tpu.data.matrix import VectorTwoDimCyclic
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal(n).astype(np.float32)
+        V = VectorTwoDimCyclic(mb=nb, lm=n).from_array(x)
+        tp = stencil_taskpool(V, steps, device=device)
+
+        def result():
+            return {"app": "stencil", "n": n, "steps": steps,
+                    "norm": float(np.linalg.norm(V.to_array()))}
+        return tp, result
+    return factory
+
+
+#: name -> params-dict -> taskpool factory
+APPS: Dict[str, Callable[[Dict[str, Any]], Callable]] = {
+    "gemm": _gemm_factory,
+    "potrf": _potrf_factory,
+    "stencil": _stencil_factory,
+}
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class JobServer:
+    """TCP front end over a JobService; one handler thread per client
+    connection, requests served sequentially per connection."""
+
+    def __init__(self, service: JobService, host: str = "127.0.0.1",
+                 port: Optional[int] = None):
+        self.service = service
+        self._stop = False
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port if port is not None
+                        else int(params.get("service_port", 41990))))
+        self._srv.listen(16)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="job-server", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.2)
+        while not self._stop:
+            try:
+                conn, _addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop:
+                try:
+                    req = recv_msg(conn)
+                except (ConnectionError, ValueError) as exc:
+                    warning("job-server: dropping connection: %s", exc)
+                    return
+                if req is None:
+                    return
+                try:
+                    reply = self._handle(req)
+                except Exception as exc:   # a bad request must not kill
+                    reply = {"ok": False,  # the handler thread
+                             "error": f"{type(exc).__name__}: {exc}"}
+                try:
+                    send_msg(conn, reply)
+                except OSError:
+                    return
+
+    # -- request handling --------------------------------------------------
+    def _job_of(self, req: Dict[str, Any]):
+        job = self.service.job(int(req["job"]))
+        if job is None:
+            raise KeyError(f"no such job {req.get('job')!r}")
+        return job
+
+    def _handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        if op == "submit":
+            return self._op_submit(req)
+        if op == "status":
+            job = self._job_of(req)
+            return {"ok": True, "info": job.info()}
+        if op == "result":
+            job = self._job_of(req)
+            try:
+                res = job.result(timeout=req.get("timeout", 60.0))
+            except JobError as exc:
+                return {"ok": False, "status": job.status().name,
+                        "error": str(exc)}
+            return {"ok": True, "status": job.status().name,
+                    "result": res}
+        if op == "cancel":
+            job = self._job_of(req)
+            return {"ok": True, "cancelled": job.cancel()}
+        if op == "jobs":
+            return {"ok": True,
+                    "jobs": [j.info() for j in self.service.jobs()]}
+        if op == "stats":
+            return {"ok": True, "stats": self.service.stats()}
+        if op == "gauges":
+            return {"ok": True, "gauges": self.service.gauges.snapshot()}
+        if op == "apps":
+            return {"ok": True, "apps": sorted(APPS)}
+        raise ValueError(f"unknown op {op!r}")
+
+    def _op_submit(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        app = req.get("app")
+        maker = APPS.get(app)
+        if maker is None:
+            raise ValueError(f"unknown app {app!r} (have {sorted(APPS)})")
+        factory = maker(dict(req.get("params") or {}))
+        # coerce numeric wire fields: a string deadline from a sloppy
+        # client must fail THIS request, not poison the deadline sweep
+        deadline = req.get("deadline")
+        timeout = req.get("timeout")
+        try:
+            job = self.service.submit(
+                factory,
+                priority=int(req.get("priority", 0)),
+                deadline=None if deadline is None else float(deadline),
+                client=str(req.get("client", "")),
+                name=str(req.get("name", "") or f"{app}"),
+                block=bool(req.get("block", False)),
+                timeout=None if timeout is None else float(timeout))
+        except AdmissionError as exc:
+            return {"ok": False, "rejected": True, "error": str(exc)}
+        return {"ok": True, "job": job.job_id, "name": job.name}
+
+    def close(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=2)
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# client library (used by tools/job_client.py and tests)
+# ---------------------------------------------------------------------------
+
+def request(host: str, port: int, obj: Dict[str, Any],
+            timeout: float = 120.0) -> Dict[str, Any]:
+    """One request/reply round trip on a fresh connection."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        send_msg(s, obj)
+        reply = recv_msg(s)
+    if reply is None:
+        raise ConnectionError("job server closed the connection")
+    return reply
+
+
+def serve(port: Optional[int] = None, host: str = "127.0.0.1",
+          **service_kwargs) -> Tuple[JobService, JobServer]:
+    """Bring up a resident service + server pair (blocking callers use
+    ``serve_forever``)."""
+    service = JobService(**service_kwargs)
+    server = JobServer(service, host=host, port=port)
+    return service, server
+
+
+def serve_forever(port: Optional[int] = None, host: str = "127.0.0.1",
+                  **service_kwargs) -> None:
+    import time as _time
+    service, server = serve(port=port, host=host, **service_kwargs)
+    print(f"parsec_tpu job server on {server.host}:{server.port} "
+          f"(apps: {', '.join(sorted(APPS))})", flush=True)
+    try:
+        while True:
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+        service.shutdown(timeout=30.0)
+
+
+def main(argv=None) -> None:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="resident parsec_tpu job server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--cores", type=int, default=None,
+                    help="worker streams for the warm context")
+    args, rest = ap.parse_known_args(argv)
+    if rest:
+        params.parse_cmdline(rest)
+    kw = {}
+    if args.cores is not None:
+        kw["nb_cores"] = args.cores
+    serve_forever(port=args.port, host=args.host, **kw)
+
+
+if __name__ == "__main__":
+    main()
