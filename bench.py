@@ -978,6 +978,142 @@ def run_eff_bench():
     return meas_eff, curve, sim_vs_meas
 
 
+# ---------------------------------------------------------------------------
+# flop accounting (ISSUE r6 tentpole c): per-class HIGHEST vs DEFAULT
+# flops of the factorizations, the attainable rate they imply, and how
+# much of it the measured number achieves — so the remaining gap is
+# measured, not guessed.  Rates are calibratable constants: DEFAULT is
+# the measured GEMM-class MXU rate (r5: 155 TF/s on v5e = 0.79 of bf16
+# peak), HIGHEST is its measured ~3x tax; override via
+# PARSEC_BENCH_RATE_DEFAULT / PARSEC_BENCH_RATE_HIGHEST (GFLOP/s).
+# ---------------------------------------------------------------------------
+
+def _accounting_rates(peak_gflops: float):
+    r_lo = float(os.environ.get("PARSEC_BENCH_RATE_DEFAULT",
+                                0.79 * peak_gflops))
+    r_hi = float(os.environ.get("PARSEC_BENCH_RATE_HIGHEST", r_lo / 3.0))
+    return max(r_hi, 1e-9), max(r_lo, 1e-9)
+
+
+def _qr_flop_accounting(mb: int, nt: int, ib: int, peak_gflops: float,
+                        achieved_gflops: float):
+    """Analytic HIGHEST/DEFAULT flop split of the blocked tiled QR
+    (apps/qr.py kernels, flat-tree): per class, per instance, times the
+    instance count.  With inner blocking the HIGHEST work per panel
+    task is O(mb^2*ib); unblocked (ib=0) it is O(mb^3)."""
+    def geqrt_split():
+        if ib:
+            hi = lo = 0.0
+            for s in range(0, mb, ib):
+                rest = mb - s - ib
+                hi += 4.0 * mb * ib * s          # re-projection pass
+                hi += 8.0 * mb * ib * ib         # CholeskyQR2 (2x gram+Q)
+                hi += 2.0 * ib ** 3              # chol/tri_inv/R folds
+                if rest > 0:
+                    lo += 4.0 * mb * ib * rest   # trailing update
+            return hi, lo
+        # whole-tile CholeskyQR2: 2x (gram + Q formation) + inverses
+        return 10.0 * mb ** 3, 0.0
+
+    def tsqrt_split():
+        if ib:
+            hi = lo = 0.0
+            for s in range(0, mb, ib):
+                rest = mb - s - ib
+                hi += 2.0 * ib * ib * (ib + mb)  # gram of [Rjj; Bj]
+                hi += 2.0 * mb * ib * ib + 3.0 * ib ** 3   # V, invs, Tt
+                hi += 2.0 * ib * mb * s + 2.0 * ib * s * s  # T-accum
+                if rest > 0:
+                    lo += (4.0 * mb * ib + 2.0 * ib * ib) * rest  # WY
+            return hi, lo
+        # whole-panel gram + 2 tri_inv + WY products, all HIGHEST
+        return 9.0 * mb ** 3, 0.0
+
+    counts = {
+        "GEQRT": nt,
+        "UNMQR": nt * (nt - 1) // 2,
+        "TSQRT": nt * (nt - 1) // 2,
+        "TSMQR": sum(j * j for j in range(1, nt)),
+    }
+    g = geqrt_split()
+    t = tsqrt_split()
+    per = {"GEQRT": g, "UNMQR": (0.0, 2.0 * mb ** 3), "TSQRT": t,
+           "TSMQR": (0.0, 6.0 * mb ** 3)}
+    from parsec_tpu.apps.qr import geqrf_flops as _gf
+    return _emit_accounting("geqrf", counts, per,
+                            _gf(nt * mb, nt * mb), peak_gflops,
+                            achieved_gflops, extra={"ib": ib})
+
+
+def _potrf_flop_accounting(mb: int, nt: int, peak_gflops: float,
+                           achieved_gflops: float):
+    """Executed-flop accounting of the tiled Cholesky (apps/potrf.py):
+    every class is DEFAULT-precision matmul-class work; the interesting
+    ratio is executed/useful (the TRSM-by-inverse + full-SYRK tax)."""
+    counts = {
+        "POTRF": max(nt - 1, 0) if nt > 1 else 0,
+        "POTRFL": 1,
+        "TRSM": nt * (nt - 1) // 2,
+        "SYRK": nt * (nt - 1) // 2,
+        "GEMM": sum((nt - 1 - k) * (nt - 2 - k) // 2
+                    for k in range(nt - 1)),
+    }
+    per = {"POTRF": (0.0, mb ** 3), "POTRFL": (0.0, mb ** 3 / 3.0),
+           "TRSM": (0.0, 2.0 * mb ** 3), "SYRK": (0.0, 2.0 * mb ** 3),
+           "GEMM": (0.0, 2.0 * mb ** 3)}
+    from parsec_tpu.apps.potrf import potrf_flops as _pf
+    return _emit_accounting("potrf", counts, per, _pf(nt * mb),
+                            peak_gflops, achieved_gflops)
+
+
+def _emit_accounting(name, counts, per, useful, peak_gflops, achieved,
+                     extra=None):
+    """Common tail: totals, attainable rate, table to stderr, JSON
+    dict back to the caller."""
+    r_hi, r_lo = _accounting_rates(peak_gflops)
+    classes = {}
+    hi_tot = lo_tot = 0.0
+    for cls, cnt in counts.items():
+        hi1, lo1 = per[cls]
+        classes[cls] = {
+            "count": cnt,
+            "highest_gflop": round(hi1 * cnt / 1e9, 1),
+            "default_gflop": round(lo1 * cnt / 1e9, 1),
+        }
+        hi_tot += hi1 * cnt
+        lo_tot += lo1 * cnt
+    t_attain = hi_tot / (r_hi * 1e9) + lo_tot / (r_lo * 1e9)
+    attainable = useful / t_attain / 1e9 if t_attain > 0 else 0.0
+    log(f"{name} flop accounting (rates: HIGHEST {r_hi / 1e3:.1f} "
+        f"TF/s, DEFAULT {r_lo / 1e3:.1f} TF/s; useful "
+        f"{useful / 1e12:.1f} TFLOP):")
+    log(f"  {'class':8s} {'count':>6s} {'HIGHEST GF':>12s} "
+        f"{'DEFAULT GF':>12s}")
+    for cls, row in classes.items():
+        log(f"  {cls:8s} {row['count']:6d} {row['highest_gflop']:12.1f} "
+            f"{row['default_gflop']:12.1f}")
+    log(f"  executed/useful = {(hi_tot + lo_tot) / max(useful, 1):.2f}, "
+        f"HIGHEST share = "
+        f"{hi_tot / max(hi_tot + lo_tot, 1) * 100:.1f}%, attainable "
+        f"{attainable / 1e3:.1f} TF/s, achieved {achieved / 1e3:.1f} "
+        f"TF/s ({achieved / max(attainable, 1e-9) * 100:.0f}% of "
+        f"attainable)")
+    out = {
+        "classes": classes,
+        "rates_gflops": {"highest": round(r_hi, 1),
+                         "default": round(r_lo, 1)},
+        "executed_vs_useful": round((hi_tot + lo_tot) / max(useful, 1),
+                                    3),
+        "highest_share": round(hi_tot / max(hi_tot + lo_tot, 1), 4),
+        "attainable_gflops": round(attainable, 1),
+        "achieved_vs_attainable": round(
+            achieved / max(attainable, 1e-9), 4),
+    }
+    if extra:
+        out.update(extra)
+    return out
+
+
 def run_geqrf_bench(mb: int, nt: int, reps: int = 3,
                     peak_gflops: float = 0.0, mp: bool = False):
     """Tiled QR (BASELINE.md names dgeqrf-class drivers alongside
@@ -1004,11 +1140,37 @@ def run_geqrf_bench(mb: int, nt: int, reps: int = 3,
     from parsec_tpu.utils.mca import params as _params
     fw = float(os.environ.get("PARSEC_BENCH_GEQRF_FUSEWIN", "4"))
     _params.set("device_fuse_window_ms", fw)
+    # inner blocking (apps/qr.py ib discipline): HIGHEST panel work
+    # drops O(mb^3) -> O(mb^2*ib); PARSEC_BENCH_GEQRF_IB=0 reproduces
+    # the unblocked r5 construction for A/B attribution
+    ib = int(os.environ.get("PARSEC_BENCH_GEQRF_IB", 512))
+    _params.set("qr_ib", ib)
     try:
         return _run_geqrf_inner(A, mb, nt, n, flops, reps, peak_gflops,
                                 mp)
     finally:
         _params.unset("device_fuse_window_ms")
+        _params.unset("qr_ib")
+
+
+def _geqrf_orig_fn(A, last_rep: int):
+    """Regenerator of the geqrf bench's pre-factorization tiles — the
+    prestage generator (Gaussian 0.05 + identity bump) plus the last
+    rep's dedup perturbation on the first local tile.  ONE definition
+    shared by the residual check and the LS-refine ladder, so both
+    always validate the exact operand that was factored."""
+    import jax.numpy as jnp
+    gen = _tile_generator(A, 0.05)
+    tiles = list(A.local_tiles())
+    first = tiles[0]
+    lin_of = {t: i for i, t in enumerate(tiles)}
+
+    def orig(m, nn):
+        t = gen(float(lin_of[(m, nn)]), 1.0).astype(jnp.float32)
+        if (m, nn) == first:
+            t = t + jnp.float32(_pert_value(last_rep))
+        return t
+    return orig
 
 
 def _geqrf_residual_check(A, ctx, last_rep: int) -> float:
@@ -1022,18 +1184,11 @@ def _geqrf_residual_check(A, ctx, last_rep: int) -> float:
     import jax.numpy as jnp
     dev = ctx.device_registry.accelerators[0]
     nt_, mb_ = A.mt, A.mb
-    gen = _tile_generator(A, 0.05)
     tiles = list(A.local_tiles())
-    first = tiles[0]
     rng = np.random.default_rng(123)
     z = [jax.device_put(rng.standard_normal(mb_).astype(np.float32),
                         dev.jdev) for _ in range(nt_)]
-
-    def orig(lin, m, nn):
-        t = gen(float(lin), 1.0).astype(jnp.float32)
-        if (m, nn) == first:
-            t = t + jnp.float32(_pert_value(last_rep))
-        return t
+    orig = _geqrf_orig_fn(A, last_rep)
 
     def rtile(m, nn):
         d = A.data_of(m, nn)
@@ -1044,14 +1199,14 @@ def _geqrf_residual_check(A, ctx, last_rep: int) -> float:
     mtv = jax.jit(lambda t, v: t.T @ v)
     w = [jnp.zeros(mb_, jnp.float32) for _ in range(nt_)]
     v = [jnp.zeros(mb_, jnp.float32) for _ in range(nt_)]
-    for lin, (m, nn) in enumerate(tiles):
-        w[m] = w[m] + mv(orig(lin, m, nn), z[nn])
+    for m, nn in tiles:
+        w[m] = w[m] + mv(orig(m, nn), z[nn])
         if m <= nn:
             v[m] = v[m] + mv(rtile(m, nn), z[nn])
     y1 = [jnp.zeros(mb_, jnp.float32) for _ in range(nt_)]
     y2 = [jnp.zeros(mb_, jnp.float32) for _ in range(nt_)]
-    for lin, (m, nn) in enumerate(tiles):
-        y2[nn] = y2[nn] + mtv(orig(lin, m, nn), w[m])
+    for m, nn in tiles:
+        y2[nn] = y2[nn] + mtv(orig(m, nn), w[m])
         if m <= nn:
             y1[nn] = y1[nn] + mtv(rtile(m, nn), v[m])
     num = float(jnp.sqrt(sum(jnp.sum((a - b) ** 2)
@@ -1117,14 +1272,26 @@ def _run_geqrf_inner(A, mb, nt, n, flops, reps, peak_gflops, mp):
             if d.stats.executed_tasks:
                 log(f"{d.name}: {d.stats.as_dict()}")
         residual = None
+        ladder = None
         if on_acc and reps and \
                 os.environ.get("PARSEC_BENCH_ERRCHECK", "last") != "0":
             residual = _geqrf_residual_check(A, ctx, reps - 1)
             log(f"factorization residual ||R'Rz-A'Az||/||A'Az|| = "
                 f"{residual:.3e}")
+            # mp-QR accuracy ladder (VERDICT r5 #9, apps/qr_check.py):
+            # CSNE solve with the factored R as preconditioner — the
+            # HPL-AI contract for the QR driver, recorded like potrf's
+            # ir_residuals.  O(n^2) per step, untimed; validates the
+            # SAME regenerated operand the residual check diffed.
+            from parsec_tpu.apps.qr_check import ls_refine
+            steps = int(os.environ.get("PARSEC_BENCH_LS_STEPS", 4))
+            ladder = ls_refine(A, _geqrf_orig_fn(A, reps - 1),
+                               steps=steps)
+            log("LS-refine errors (CSNE direct, then +1 refinement "
+                f"step each): {['%.3e' % h for h in ladder]}")
         _discard_device_tiles(A)
         _discard_device_scratch(ctx)
-    return best, residual
+    return best, residual, ladder
 
 
 def main():
@@ -1197,11 +1364,24 @@ def main():
                     int(os.environ.get("PARSEC_BENCH_RUNAHEAD", 20)))
         _params.set("device_inflight_depth",
                     int(os.environ.get("PARSEC_BENCH_DEPTH", 12)))
-        log(f"geqrf config: mb={mb} nt={nt} mixed-precision={mp}")
+        # ONE clamp rule (qr.effective_ib) decides what the kernels run
+        # AND what the log/accounting/JSON report — set the param first,
+        # exactly as run_geqrf_bench will
+        from parsec_tpu.apps.qr import effective_ib
+        from parsec_tpu.utils.mca import params as _p
+        _p.set("qr_ib", int(os.environ.get("PARSEC_BENCH_GEQRF_IB", 512)))
+        try:
+            ib = effective_ib(mb)
+        finally:
+            _p.unset("qr_ib")
+        fuse_panel = os.environ.get("PARSEC_MCA_DEVICE_FUSE_PANEL", "1")
+        log(f"geqrf config: mb={mb} nt={nt} mixed-precision={mp} "
+            f"ib={ib} fuse_panel={fuse_panel}")
         peak = _PEAKS.get(platform, 100.0)
-        value, residual = run_geqrf_bench(
+        value, residual, ladder = run_geqrf_bench(
             mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 3)),
             peak_gflops=peak, mp=mp)
+        accounting = _qr_flop_accounting(mb, nt, ib, peak, value)
         print(json.dumps({
             "metric": "tiled_geqrf_mp_gflops" if mp
                       else "tiled_geqrf_gflops",
@@ -1209,76 +1389,17 @@ def main():
             "unit": "GFLOP/s",
             "vs_baseline": round(value / (0.55 * peak), 4),
             "storage": "bfloat16" if mp else "float32",
+            "ib": ib,
+            "fuse_panel": fuse_panel not in ("0", "false"),
             **({"factorization_residual": float(f"{residual:.3e}")}
                if residual is not None else {}),
+            **({"ls_refine_errors": [float(f"{h:.3e}") for h in ladder]}
+               if ladder else {}),
+            "flop_accounting": accounting,
         }))
         return
     if os.environ.get("PARSEC_BENCH_APP", "gemm") == "potrf":
-        # r3: TRSM runs as matmul against the POTRF-emitted triangular
-        # inverse (apps/potrf.py tri_inv — jsl trsm measured ~18 TF/s vs
-        # matmul ~150 TF/s on v5e) and same-class waves ride fused
-        # launches (devices/xla.py device_fuse), so larger tile grids now
-        # pay off: the r2 sweep (4096/8 -> 33.7, 6144/8 -> 40.0 TFLOP/s)
-        # was launch-latency-bound on the serialized panel chain
-        # bf16-panel mixed precision by default on TPU: fits nt=16 at
-        # mb=6144 in HBM, where the executed/useful flop ratio (the
-        # TRSM-by-inverse + full-SYRK tax) drops to ~1.2 and compute
-        # dominates the tunnel's per-launch latency
-        mp = on_tpu and os.environ.get("PARSEC_BENCH_POTRF_MP", "1") == "1"
-        mb = int(os.environ.get("PARSEC_BENCH_MB", 6144 if on_tpu else 32))
-        # nt=16 mp: 10.3GB resident bf16 tiles + ~2.5GB fused-launch
-        # transients on a 16GB v5e
-        nt = int(os.environ.get("PARSEC_BENCH_NT",
-                                (16 if mp else 12) if on_tpu else 4))
-        from parsec_tpu.utils.mca import params as _params
-        _params.set("device_fuse",
-                    int(os.environ.get("PARSEC_BENCH_FUSE", 8)))
-        # a tight run-ahead window: eager completion would otherwise keep
-        # every unfinalized output (each panel inverse, every fused-wave
-        # operand set) referenced until the end of the pool — at nt=14
-        # that overflows the 16GB HBM; finalizing promptly lets donation
-        # and GC recycle chain buffers
-        _params.set("device_runahead",
-                    int(os.environ.get("PARSEC_BENCH_RUNAHEAD", 48)))
-        # one width-8 fused launch fills the default inflight depth of 8
-        # (entries are TASKS, not launches): deepen so dispatch pipelines
-        _params.set("device_inflight_depth",
-                    int(os.environ.get("PARSEC_BENCH_DEPTH", 32)))
-        log(f"potrf config: mb={mb} nt={nt} mixed-precision={mp}")
-        peak = _PEAKS.get(platform, 100.0)
-        # 4 reps: the first timed rep still hits a few fresh fused-width
-        # compiles; best-of converges by rep 2-3
-        # median-of-5 protocol (VERDICT r4 #6): tunnel-state variance
-        # spans ~20% run to run, so the RECORDED value is the median
-        # with the observed band alongside — one lucky (or unlucky)
-        # rep no longer moves the headline
-        value_best, bwd_err, ir_hist, rep_gfs = run_potrf_bench(
-            mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 5)),
-            peak_gflops=peak, mp=mp)
-        import statistics
-        value = statistics.median(rep_gfs) if rep_gfs else value_best
-        # the mp (bf16-storage) variant reports under its OWN metric name
-        # with the storage precision and measured backward error in the
-        # JSON — not apples-to-apples with the full-precision dpotrf
-        # contract (ADVICE r3 medium)
-        out = {
-            "metric": "tiled_potrf_mp_gflops" if mp
-                      else "tiled_potrf_gflops",
-            "value": round(value, 1),
-            "unit": "GFLOP/s",
-            "vs_baseline": round(value / (0.55 * peak), 4),
-            "storage": "bfloat16" if mp else "float32",
-        }
-        if rep_gfs:
-            out["rep_band_gflops"] = [round(min(rep_gfs), 1),
-                                      round(max(rep_gfs), 1)]
-            out["best_gflops"] = round(value_best, 1)
-            out["protocol"] = "median-of-%d" % len(rep_gfs)
-        if bwd_err is not None:
-            out["backward_error"] = float(f"{bwd_err:.4e}")
-        if ir_hist is not None:
-            out["ir_residuals"] = [float(f"{h:.3e}") for h in ir_hist]
-        print(json.dumps(out))
+        print(json.dumps(_potrf_headline(platform, on_tpu)))
         return
     # Big MXU-friendly tiles on TPU, small ones on CPU CI.  12288 tiles
     # carry ~3.7 TFLOP of MXU work each, amortizing the ~2.4ms/launch
@@ -1297,12 +1418,110 @@ def main():
                            else __import__("ml_dtypes").bfloat16,
                            peak_gflops=peak)
     target = 0.55 * peak
-    print(json.dumps({
+    out = {
         "metric": "tiled_gemm_gflops",
         "value": round(value, 1),
         "unit": "GFLOP/s",
         "vs_baseline": round(value / target, 4),
-    }))
+    }
+    # driver-capture the north star (VERDICT r5 #6): the default mode
+    # ALSO runs the potrf median-of-5 headline and folds it into the
+    # same (single) JSON line, so the driver-recorded BENCH_r*.json
+    # carries tiled_potrf_mp_gflops — the metric that gates COMPLETE —
+    # every round, not only when a builder runs the potrf mode by hand.
+    # PARSEC_BENCH_NORTHSTAR=0 restores the gemm-only default.
+    if os.environ.get("PARSEC_BENCH_NORTHSTAR", "1") != "0":
+        log("--- north-star leg: potrf median-of-5 ---")
+        try:
+            ns = _potrf_headline(platform, on_tpu)
+            out[ns["metric"]] = ns["value"]
+            for key in ("rep_band_gflops", "best_gflops", "protocol",
+                        "backward_error", "ir_residuals", "storage",
+                        "fuse_panel"):
+                if key in ns:
+                    out["potrf_" + key] = ns[key]
+            out["potrf_vs_baseline"] = ns["vs_baseline"]
+        except Exception as exc:     # the headline must still publish
+            log(f"north-star potrf leg FAILED: {exc!r}")
+            out["potrf_error"] = str(exc)[:200]
+    print(json.dumps(out))
+
+
+def _potrf_headline(platform, on_tpu):
+    """The north-star potrf headline (median-of-5 protocol): returns
+    the JSON-ready dict; the potrf mode prints it as-is and the default
+    (gemm) mode folds it into its own line so the driver artifact
+    always records ``tiled_potrf_mp_gflops``."""
+    # r3: TRSM runs as matmul against the POTRF-emitted triangular
+    # inverse (apps/potrf.py tri_inv — jsl trsm measured ~18 TF/s vs
+    # matmul ~150 TF/s on v5e) and same-class waves ride fused
+    # launches (devices/xla.py device_fuse), so larger tile grids now
+    # pay off: the r2 sweep (4096/8 -> 33.7, 6144/8 -> 40.0 TFLOP/s)
+    # was launch-latency-bound on the serialized panel chain
+    # bf16-panel mixed precision by default on TPU: fits nt=16 at
+    # mb=6144 in HBM, where the executed/useful flop ratio (the
+    # TRSM-by-inverse + full-SYRK tax) drops to ~1.2 and compute
+    # dominates the tunnel's per-launch latency
+    mp = on_tpu and os.environ.get("PARSEC_BENCH_POTRF_MP", "1") == "1"
+    mb = int(os.environ.get("PARSEC_BENCH_MB", 6144 if on_tpu else 32))
+    # nt=16 mp: 10.3GB resident bf16 tiles + ~2.5GB fused-launch
+    # transients on a 16GB v5e
+    nt = int(os.environ.get("PARSEC_BENCH_NT",
+                            (16 if mp else 12) if on_tpu else 4))
+    from parsec_tpu.utils.mca import params as _params
+    _params.set("device_fuse",
+                int(os.environ.get("PARSEC_BENCH_FUSE", 8)))
+    # a tight run-ahead window: eager completion would otherwise keep
+    # every unfinalized output (each panel inverse, every fused-wave
+    # operand set) referenced until the end of the pool — at nt=14
+    # that overflows the 16GB HBM; finalizing promptly lets donation
+    # and GC recycle chain buffers
+    _params.set("device_runahead",
+                int(os.environ.get("PARSEC_BENCH_RUNAHEAD", 48)))
+    # one width-8 fused launch fills the default inflight depth of 8
+    # (entries are TASKS, not launches): deepen so dispatch pipelines
+    _params.set("device_inflight_depth",
+                int(os.environ.get("PARSEC_BENCH_DEPTH", 32)))
+    fuse_panel = os.environ.get("PARSEC_MCA_DEVICE_FUSE_PANEL", "1")
+    log(f"potrf config: mb={mb} nt={nt} mixed-precision={mp} "
+        f"fuse_panel={fuse_panel}")
+    peak = _PEAKS.get(platform, 100.0)
+    # 4 reps: the first timed rep still hits a few fresh fused-width
+    # compiles; best-of converges by rep 2-3
+    # median-of-5 protocol (VERDICT r4 #6): tunnel-state variance
+    # spans ~20% run to run, so the RECORDED value is the median
+    # with the observed band alongside — one lucky (or unlucky)
+    # rep no longer moves the headline
+    value_best, bwd_err, ir_hist, rep_gfs = run_potrf_bench(
+        mb, nt, reps=int(os.environ.get("PARSEC_BENCH_REPS", 5)),
+        peak_gflops=peak, mp=mp)
+    import statistics
+    value = statistics.median(rep_gfs) if rep_gfs else value_best
+    # the mp (bf16-storage) variant reports under its OWN metric name
+    # with the storage precision and measured backward error in the
+    # JSON — not apples-to-apples with the full-precision dpotrf
+    # contract (ADVICE r3 medium)
+    out = {
+        "metric": "tiled_potrf_mp_gflops" if mp
+                  else "tiled_potrf_gflops",
+        "value": round(value, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(value / (0.55 * peak), 4),
+        "storage": "bfloat16" if mp else "float32",
+        "fuse_panel": fuse_panel not in ("0", "false"),
+    }
+    if rep_gfs:
+        out["rep_band_gflops"] = [round(min(rep_gfs), 1),
+                                  round(max(rep_gfs), 1)]
+        out["best_gflops"] = round(value_best, 1)
+        out["protocol"] = "median-of-%d" % len(rep_gfs)
+    if bwd_err is not None:
+        out["backward_error"] = float(f"{bwd_err:.4e}")
+    if ir_hist is not None:
+        out["ir_residuals"] = [float(f"{h:.3e}") for h in ir_hist]
+    out["flop_accounting"] = _potrf_flop_accounting(mb, nt, peak,
+                                                    value)
+    return out
 
 
 if __name__ == "__main__":

@@ -121,3 +121,91 @@ def use_pallas_gemm() -> bool:
         return bool(int(params.get("gemm_pallas", 0)))
     except (TypeError, ValueError):
         return False
+
+
+# ---------------------------------------------------------------------------
+# blocked Gram kernel: the HIGHEST-precision hot spot of the
+# inner-blocked QR panels (apps/qr.py _cholqr2 — G = X^T X of an
+# mb x ib column block, computed per ib-block of every GEQRT/TSQRT)
+# ---------------------------------------------------------------------------
+
+params.register("qr_pallas_gram", 0,
+                "use the hand-written Pallas blocked Gram kernel for "
+                "the inner-blocked QR panel construction (apps/qr.py) "
+                "instead of the fused XLA matmul")
+
+
+@functools.lru_cache(maxsize=None)
+def _blocked_gram(bn: int, bk: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    scratch = [pltpu.VMEM((bn, bn), jnp.float32)]
+
+    def kernel(xi_ref, xj_ref, o_ref, acc_ref):
+        k = pl.program_id(2)
+        nk = pl.num_programs(2)
+
+        @pl.when(k == 0)
+        def _init():
+            acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+        # X_i^T X_j with f32 accumulation; HIGHEST so the Gram matrix —
+        # the cond^2-sensitive input of the panel Cholesky — never rides
+        # the MXU's bf16 passes (apps/qr.py precision discipline)
+        acc_ref[:, :] += jax.lax.dot_general(
+            xi_ref[:, :], xj_ref[:, :], (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+
+        @pl.when(k == nk - 1)
+        def _fin():
+            o_ref[:, :] = acc_ref[:, :].astype(o_ref.dtype)
+
+    def run(X):
+        m, n = X.shape
+        grid = (n // bn, n // bn, m // bk)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bk, bn), lambda i, j, k: (k, i)),
+                pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            ],
+            out_specs=pl.BlockSpec((bn, bn), lambda i, j, k: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(X, X)
+
+    return run
+
+
+def pallas_gram_tile(bn: int = 256, bk: int = 512):
+    """``fn(X) -> X^T X`` (f32, HIGHEST) as a blocked Pallas program:
+    K-innermost grid over X's rows with an f32 VMEM accumulator, the
+    same shape discipline as :func:`pallas_gemm_tile`.  Unaligned
+    shapes fall back to the fused XLA matmul with identical
+    semantics."""
+
+    def fn(X):
+        import jax
+        import jax.numpy as jnp
+        m, n = X.shape
+        cbn, cbk = min(bn, n), min(bk, m)
+        aligned = m % 128 == 0 and n % 128 == 0
+        if not aligned or m % cbk or n % cbn:
+            return jnp.matmul(X.T, X,
+                              precision=jax.lax.Precision.HIGHEST,
+                              preferred_element_type=jnp.float32)
+        return _blocked_gram(cbn, cbk, _interpret())(X)
+
+    return fn
+
+
+def use_pallas_qr_gram() -> bool:
+    try:
+        return bool(int(params.get("qr_pallas_gram", 0)))
+    except (TypeError, ValueError):
+        return False

@@ -293,6 +293,18 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
                                   "TRSM": 2.0 * mb ** 3,
                                   "SYRK": 2.0 * mb ** 3,
                                   "GEMM": 2.0 * mb ** 3}[name]
+    # cross-panel fused dispatch (devices/xla.py chain fusion): the
+    # POTRF(k) -> TRSM(*,k) panel is the dispatch-latency-bound spine of
+    # the DAG (each TRSM's only missing input is W) — the device layer
+    # holds POTRF(k) and traces it INTO the TRSM wave's launch, so the
+    # panel chain costs ONE dispatch round trip instead of two plus the
+    # Python scheduling latency between them.  TRSM co-locates on the
+    # diagonal tile's device so the whole panel is one wave there.
+    # A/B knob: PARSEC_MCA_DEVICE_FUSE_PANEL=0 restores the per-kernel
+    # panel path.
+    tp.task_classes["POTRF"].properties["fuse_chain"] = ("W", "TRSM")
+    tp.task_classes["TRSM"].properties["coaffinity"] = \
+        lambda loc, A=A: A(loc["k"], loc["k"])
     return tp
 
 

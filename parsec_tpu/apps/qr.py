@@ -37,6 +37,35 @@ and TSMQR is five mb^3-class matmuls:
 Everything lowers to the systolic array; the Q edges shrink from
 (2mb)^2 dense factors to the (2mb x mb) [V; T^T] pair.  R ends in the
 upper triangle; tiles below are zeroed.
+
+INNER BLOCKING (ib; the DPLASMA dgeqrf panel discipline, r6): the
+panel CONSTRUCTION is cond^2-sensitive and must run at HIGHEST matmul
+precision (true f32 — DEFAULT's bf16 passes destroy the factorization,
+measured residual 1.19; BENCH.md geqrf note), but HIGHEST is ~3x
+DEFAULT on the MXU.  Factoring the panel in ib-wide column blocks
+confines the HIGHEST-precision math (per-block Gram, Cholesky,
+triangular inverses, WY assembly) to O(mb^2*ib) per panel instead of
+O(mb^3), while the O(mb^3) intra-panel trailing updates — where errors
+enter the data LINEARLY, like TSMQR — run at DEFAULT precision:
+
+    GEQRT: blocked CholeskyQR2 (BCGS2-flavored: each block is
+           re-projected once against the accumulated basis at HIGHEST
+           before its own two Cholesky-QR passes), trailing columns
+           updated at DEFAULT.
+    TSQRT: per-block compact-WY from the ib x ib Gram of [R_jj; B_j],
+           trailing columns of [R; B] updated by the 5-matmul WY
+           application at DEFAULT, and the per-block (V_j, T_j^T)
+           pairs aggregated into ONE panel-wide (V, T^T) with the
+           standard T-accumulation
+               T^T[J, :s] = -T_j^T (V_j^T V[:, :s]) T^T[:s, :s]
+           (block lower triangular), so TSMQR's 5-matmul application
+           and the q2 edge layout are UNCHANGED.
+
+Knobs: --mca qr_ib N (0 = unblocked; ignored unless 0 < ib < mb and
+ib | mb) and --mca qr_update_precision {default,highest} for the
+intra-panel trailing updates.  Per-block Cholesky failures fall back
+to the unblocked construction (which carries its own Householder-QR
+guard), keeping LAPACK-class robustness behind the fast path.
 """
 
 from __future__ import annotations
@@ -49,8 +78,58 @@ from parsec_tpu.apps.potrf import tri_inv
 from parsec_tpu.core.taskpool import ParameterizedTaskpool
 from parsec_tpu.data.matrix import TiledMatrix
 from parsec_tpu.dsl.ptg.api import DATA, IN, NEW, OUT, PTG, Range, TASK
+from parsec_tpu.utils.mca import params
+
+params.register("qr_ib", 512,
+                "inner blocking of the QR panel construction: the "
+                "HIGHEST-precision work per panel drops from O(mb^3) "
+                "to O(mb^2*ib) (DPLASMA dgeqrf ib discipline); 0 "
+                "disables — ignored unless 0 < ib < mb and ib | mb")
+params.register("qr_update_precision", "default",
+                "matmul precision of the intra-panel trailing updates "
+                "(errors enter linearly there): 'default' rides the "
+                "MXU's fast path, 'highest' forces true f32")
 
 _kernels = {}
+
+
+def effective_ib(mb: int) -> int:
+    """The inner blocking actually used for an mb-wide panel: the
+    ``qr_ib`` MCA param, clamped to 0 (unblocked) when it does not
+    evenly block the panel."""
+    try:
+        ib = int(params.get("qr_ib", 512))
+    except (TypeError, ValueError):
+        return 0
+    if ib <= 0 or ib >= mb or mb % ib:
+        return 0
+    return ib
+
+
+def _update_precision():
+    """Precision of intra-panel trailing updates (None = DEFAULT)."""
+    import jax
+    val = str(params.get("qr_update_precision", "default")).lower()
+    return jax.lax.Precision.HIGHEST if val == "highest" else None
+
+
+def _cholqr2(cols, jnp, hi, gram=None):
+    """CholeskyQR2 of one mb x ib column block at HIGHEST precision:
+    returns (Q, R) with Q orthonormal (two Gram+Cholesky passes — one
+    pass loses orthogonality as cond^2*eps) and R = L2^T L^T upper
+    triangular.  NaNs from an ill-conditioned block propagate to the
+    caller's finiteness guard.  ``gram`` swaps the Gram products for a
+    hand-written kernel (apps/pallas_kernels.pallas_gram_tile)."""
+    gram = gram or (lambda X: jnp.matmul(X.T, X, precision=hi))
+    G = gram(cols)
+    dg = jnp.sqrt(jnp.clip(jnp.diagonal(G), 1e-30, None))
+    L = jnp.linalg.cholesky(G / dg[:, None] / dg[None, :]) * dg[:, None]
+    Q1 = jnp.matmul(cols, tri_inv(L, precision=hi).T, precision=hi)
+    G2 = gram(Q1)
+    L2 = jnp.linalg.cholesky(G2)
+    Q = jnp.matmul(Q1, tri_inv(L2, precision=hi).T, precision=hi)
+    R = jnp.matmul(L2.T, L.T, precision=hi)
+    return Q, R
 
 
 def _k(name, maker):
@@ -61,8 +140,9 @@ def _k(name, maker):
     return fn
 
 
-def _mk_geqrt():
+def _mk_geqrt(ib: int = 0, pallas_gram: bool = False):
     def fn(T, Q):
+        import jax
         import jax.numpy as jnp
         from jax import lax
         # factor in f32 even under bf16 tile storage (mp mode); results
@@ -74,10 +154,55 @@ def _mk_geqrt():
         # equilibrate-then-guard discipline as TSQRT keeps LAPACK-class
         # stability behind the cold fallback.  Construction at HIGHEST
         # precision (cond^2-sensitive; see _mk_tsqrt).
-        import jax
         hi = jax.lax.Precision.HIGHEST
         Tf = T.astype(jnp.float32)
         mb = Tf.shape[0]
+
+        def stable(_):
+            return jnp.linalg.qr(Tf, mode="reduced")[::-1]
+
+        if 0 < ib < mb and mb % ib == 0:
+            # inner-blocked panel (module docstring): per-block
+            # CholeskyQR2 + one re-projection against the accumulated
+            # basis at HIGHEST (O(mb^2*ib) total), trailing columns
+            # updated at DEFAULT (errors enter linearly).  Q comes out
+            # explicit — the blocks ARE its orthonormal columns — so
+            # the q1 edge and UNMQR are unchanged.
+            up = _update_precision()
+            gram = None
+            if pallas_gram:
+                from parsec_tpu.apps.pallas_kernels import pallas_gram_tile
+                gram = pallas_gram_tile()
+            A = Tf
+            R = jnp.zeros((mb, mb), jnp.float32)
+            Qacc = None
+            for s in range(0, mb, ib):
+                cols = A[:, s:s + ib]
+                if Qacc is not None:
+                    # BCGS2-flavored reorthogonalization: the trailing
+                    # updates already projected this block, but rounding
+                    # reintroduces ~eps*cond components; one extra
+                    # HIGHEST-precision pass restores inter-block
+                    # orthogonality.  The coefficients fold into R
+                    # exactly.
+                    prj = jnp.matmul(Qacc.T, cols, precision=hi)
+                    cols = cols - jnp.matmul(Qacc, prj, precision=hi)
+                    R = R.at[:s, s:s + ib].add(prj)
+                Qj, Rjj = _cholqr2(cols, jnp, hi, gram=gram)
+                R = R.at[s:s + ib, s:s + ib].set(Rjj)
+                if s + ib < mb:
+                    rest = A[:, s + ib:]
+                    Rjk = jnp.matmul(Qj.T, rest, precision=up)
+                    A = A.at[:, s + ib:].set(
+                        rest - jnp.matmul(Qj, Rjk, precision=up))
+                    R = R.at[s:s + ib, s + ib:].set(Rjk)
+                Qacc = Qj if Qacc is None else \
+                    jnp.concatenate([Qacc, Qj], axis=1)
+            ok = jnp.logical_and(jnp.all(jnp.isfinite(R)),
+                                 jnp.all(jnp.isfinite(Qacc)))
+            R, Qm = lax.cond(ok, lambda o: o, stable, operand=(R, Qacc))
+            return {"T": R.astype(T.dtype), "Q": Qm.astype(T.dtype)}
+
         G = jnp.matmul(Tf.T, Tf, precision=hi)
         dg = jnp.sqrt(jnp.clip(jnp.diagonal(G), 1e-30, None))
         Ls = jnp.linalg.cholesky(G / dg[:, None] / dg[None, :])
@@ -99,9 +224,6 @@ def _mk_geqrt():
             Qm = jnp.matmul(Q1, tri_inv(L2, precision=hi).T,
                             precision=hi)
             return R, Qm
-
-        def stable(_):
-            return jnp.linalg.qr(Tf, mode="reduced")[::-1]
 
         ok = jnp.logical_and(jnp.all(jnp.isfinite(L)),
                              jnp.all(jnp.isfinite(L2)))
@@ -153,7 +275,56 @@ def _tsqrt_wy(R, B, xp, chol, ti):
     return _wy_from_L(R, B, chol(G), xp, ti)
 
 
-def _mk_tsqrt():
+def _tsqrt_blocked(T, B, ib, jnp, hi, up):
+    """Inner-blocked TSQRT construction (module docstring): returns the
+    panel-wide (R', V, T^T) with T^T block lower triangular.  HIGHEST
+    work is O(mb^2*ib); the trailing updates of [R; B] run at ``up``
+    precision.  NaNs from an ill-conditioned block propagate to the
+    caller's finiteness guard."""
+    mb = T.shape[0]
+    Rc, Bc = T, B
+    V = jnp.zeros((mb, mb), jnp.float32)
+    Tt = jnp.zeros((mb, mb), jnp.float32)
+    for s in range(0, mb, ib):
+        Rjj = Rc[s:s + ib, s:s + ib]
+        Bj = Bc[:, s:s + ib]
+        G = (jnp.matmul(Rjj.T, Rjj, precision=hi)
+             + jnp.matmul(Bj.T, Bj, precision=hi))
+        dg = jnp.sqrt(jnp.clip(jnp.diagonal(G), 1e-30, None))
+        L = jnp.linalg.cholesky(G / dg[:, None] / dg[None, :]) \
+            * dg[:, None]
+        Rpjj, Vj, Tjt = _wy_from_L(Rjj, Bj, L, jnp,
+                                   lambda M: tri_inv(M, precision=hi),
+                                   precision=hi)
+        Rc = Rc.at[s:s + ib, s:s + ib].set(Rpjj)
+        if s + ib < mb:
+            # 5-matmul WY application to the trailing columns of the
+            # stacked panel (same shape as TSMQR, errors enter linearly)
+            C1 = Rc[s:s + ib, s + ib:]
+            C2 = Bc[:, s + ib:]
+            Z = jnp.matmul(Tjt,
+                           C1 + jnp.matmul(Vj.T, C2, precision=up),
+                           precision=up)
+            Rc = Rc.at[s:s + ib, s + ib:].set(C1 - Z)
+            Bc = Bc.at[:, s + ib:].set(
+                C2 - jnp.matmul(Vj, Z, precision=up))
+        if s:
+            # T-accumulation: Q^T = Q_j^T Q_prev^T collapses to one
+            # compact-WY pair with the block-lower-triangular
+            # T^T[J, :s] = -T_j^T (W_j^T W_prev) T^T[:s, :s]; the unit
+            # tops of W are disjoint identity columns, so W_j^T W_prev
+            # = V_j^T V[:, :s]
+            cross = jnp.matmul(Vj.T, V[:, :s], precision=hi)
+            Tt = Tt.at[s:s + ib, :s].set(
+                -jnp.matmul(Tjt, jnp.matmul(cross, Tt[:s, :s],
+                                            precision=hi),
+                            precision=hi))
+        V = V.at[:, s:s + ib].set(Vj)
+        Tt = Tt.at[s:s + ib, s:s + ib].set(Tjt)
+    return Rc, V, Tt
+
+
+def _mk_tsqrt(ib: int = 0):
     def fn(T, B, Q):
         import jax
         import jax.numpy as jnp
@@ -178,22 +349,38 @@ def _mk_tsqrt():
         # diagonal keeps the decaying-R dynamic range out of the chol;
         # the exact factor is recovered as L = D^-1 chol(D G D).
         hi = jax.lax.Precision.HIGHEST
-        G = (jnp.matmul(T.T, T, precision=hi)
-             + jnp.matmul(B.T, B, precision=hi))
-        dg = jnp.sqrt(jnp.clip(jnp.diagonal(G), 1e-30, None))
-        Ls = jnp.linalg.cholesky(G / dg[:, None] / dg[None, :])
-        L = Ls * dg[:, None]
+        mb = T.shape[0]
 
-        def stable_L(_):
-            Rh = jnp.linalg.qr(jnp.concatenate([T, B], axis=0), mode="r")
-            s = jnp.where(jnp.diagonal(Rh) >= 0, 1.0, -1.0).astype(T.dtype)
-            return (s[:, None] * Rh).T   # positive-diag lower factor
+        def unblocked(_):
+            G = (jnp.matmul(T.T, T, precision=hi)
+                 + jnp.matmul(B.T, B, precision=hi))
+            dg = jnp.sqrt(jnp.clip(jnp.diagonal(G), 1e-30, None))
+            L = jnp.linalg.cholesky(G / dg[:, None] / dg[None, :]) \
+                * dg[:, None]
 
-        L = lax.cond(jnp.all(jnp.isfinite(L)), lambda _: L, stable_L,
-                     operand=None)
-        Rp, V, Tt = _wy_from_L(T, B, L, jnp,
-                               lambda M: tri_inv(M, precision=hi),
-                               precision=hi)
+            def stable_L(_):
+                Rh = jnp.linalg.qr(jnp.concatenate([T, B], axis=0),
+                                   mode="r")
+                s = jnp.where(jnp.diagonal(Rh) >= 0, 1.0,
+                              -1.0).astype(T.dtype)
+                return (s[:, None] * Rh).T   # positive-diag lower factor
+
+            L = lax.cond(jnp.all(jnp.isfinite(L)), lambda _: L, stable_L,
+                         operand=None)
+            return _wy_from_L(T, B, L, jnp,
+                              lambda M: tri_inv(M, precision=hi),
+                              precision=hi)
+
+        if 0 < ib < mb and mb % ib == 0:
+            # inner-blocked fast path; an ill-conditioned BLOCK (NaN
+            # anywhere in the result) falls back to the unblocked
+            # construction, which carries its own Householder-QR guard
+            res = _tsqrt_blocked(T, B, ib, jnp, hi, _update_precision())
+            ok = jnp.all(jnp.array([jnp.all(jnp.isfinite(x))
+                                    for x in res]))
+            Rp, V, Tt = lax.cond(ok, lambda o: o, unblocked, operand=res)
+        else:
+            Rp, V, Tt = unblocked(None)
         dt = Q.dtype                    # NEW-flow arena dtype = storage
         return {"T": Rp.astype(dt), "B": jnp.zeros_like(B, dtype=dt),
                 "Q": jnp.concatenate([V, Tt], axis=0).astype(dt)}
@@ -234,6 +421,12 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
     NT = A.mt
     mb = A.mb
     use_device = device in ("tpu", "xla", "gpu")
+    # inner blocking + trailing-update precision resolve ONCE per build;
+    # they key the kernel memo so an MCA change cannot alias a stale jit
+    ib = effective_ib(mb)
+    upd = str(params.get("qr_update_precision", "default")).lower()
+    from parsec_tpu.apps.pallas_kernels import use_pallas_qr_gram
+    pg = use_pallas_qr_gram()
     # Owner-computes discipline for the final R tiles: the LAST TSQRT of
     # column k (and the last TSMQR of each row-k tile) runs where
     # A(NT-1, k) lives, but its R output belongs home at A(k, *).  On
@@ -277,7 +470,8 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
     def cpu_geqrt(T, Q):
         q, r = np.linalg.qr(np.asarray(T), mode="complete")
         return {"T": r, "Q": q}
-    bodies(tb, _k("geqrt", _mk_geqrt), cpu_geqrt)
+    bodies(tb, _k(("geqrt", ib, upd, pg), lambda: _mk_geqrt(ib, pg)),
+           cpu_geqrt)
 
     # UNMQR(k, n): apply Q1^T across the k-th block row
     tb = p.task("UNMQR", k=Range(0, NT - 2), n=Range(lambda k: k + 1,
@@ -342,7 +536,7 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
         dt = np.asarray(T).dtype
         return {"T": Rp.astype(dt), "B": np.zeros_like(np.asarray(B)),
                 "Q": np.concatenate([V, Tt], axis=0).astype(dt)}
-    bodies(tb, _k("tsqrt", _mk_tsqrt), cpu_tsqrt)
+    bodies(tb, _k(("tsqrt", ib, upd), lambda: _mk_tsqrt(ib)), cpu_tsqrt)
 
     # TSMQR(m, n, k): apply Q2^T to the [A(k,n); A(m,n)] pair
     tb = p.task("TSMQR", k=Range(0, NT - 2),
@@ -415,6 +609,17 @@ def qr_taskpool(A: TiledMatrix, device: str = "tpu") -> ParameterizedTaskpool:
                                   "UNMQR": 2.0 * mb ** 3,
                                   "TSQRT": 6.0 * mb ** 3,
                                   "TSMQR": 10.0 * mb ** 3}.get(name, 1.0)
+    # cross-panel fused dispatch (devices/xla.py chain fusion): the
+    # GEQRT(k) -> TSQRT(k+1,k) -> ... -> TSQRT(NT-1,k) column is the
+    # serial spine of the DAG — each link's only missing input is its
+    # predecessor's T, so the device layer holds the head and traces the
+    # whole column INTO its consumers' launch (one dispatch round trip
+    # instead of one per link).  TSQRT co-locates on the diagonal tile's
+    # device so the column is a chain on ONE device.
+    tp.task_classes["GEQRT"].properties["fuse_chain"] = ("T", "TSQRT")
+    tp.task_classes["TSQRT"].properties["fuse_chain"] = ("T", "TSQRT")
+    tp.task_classes["TSQRT"].properties["coaffinity"] = \
+        lambda loc, A=A: A(loc["k"], loc["k"])
     return tp
 
 

@@ -263,7 +263,10 @@ class IciEngine:
         consumer's stage-in finds the tile resident (zero further
         movement).  Returns the number of devices the tile landed on."""
         datum = copy.data
-        if datum is None or copy.payload is None:
+        if datum is None or copy.payload is None \
+                or getattr(copy.payload, "parsec_deferred", False):
+            # chain-held placeholder (devices/xla.py Deferred): the value
+            # does not exist yet — consumers lazily stage (and force) it
             return 0
         spaces = sorted({s for s in target_spaces
                          if s in self._jdev})
@@ -307,7 +310,8 @@ class IciEngine:
         point-to-point dep edge, parsec_mpi_funnelled.c:793; on TPU a
         device-to-device ICI hop)."""
         datum = copy.data
-        if datum is None or copy.payload is None or space not in self._jdev:
+        if datum is None or copy.payload is None or space not in self._jdev \
+                or getattr(copy.payload, "parsec_deferred", False):
             return False
         if copy.device == space or copy.device not in self._jdev:
             return False      # host-resident payloads stage in normally
@@ -478,8 +482,10 @@ class IciEngine:
 
     def device_resident(self, copy: DataCopy) -> bool:
         """Cheap hot-path gate: only device-resident produced copies are
-        candidates for collective placement."""
-        return copy.device in self._jdev and copy.payload is not None
+        candidates for collective placement (chain-held placeholders —
+        devices/xla.py Deferred — are not: the value does not exist)."""
+        return copy.device in self._jdev and copy.payload is not None \
+            and not getattr(copy.payload, "parsec_deferred", False)
 
     def _adopt(self, datum, placed) -> None:
         """Register externally-attached copies with their device's HBM

@@ -186,6 +186,11 @@ def stage_in_host(task: Task) -> None:
         copy = task.data.get(flow.name)
         if copy is None or copy.data is None:
             continue
+        p = copy.payload
+        if getattr(p, "parsec_deferred", False):
+            # a chain-held device task's output reached a CPU body:
+            # dispatch the held chain now (devices/xla.py Deferred)
+            copy.payload = p.force()
         datum = copy.data
         with datum._lock:
             if copy.flags & FLAG_COW:
@@ -207,6 +212,9 @@ def stage_in_host(task: Task) -> None:
                 host = datum.create_copy(0)
             src = datum.transfer_ownership(0, flow.access)
             if src is not None:
+                sp_ = src.payload
+                if getattr(sp_, "parsec_deferred", False):
+                    src.payload = sp_.force()
                 arr = np.asarray(src.payload)
                 if host.payload is None or \
                         not isinstance(host.payload, np.ndarray) or \

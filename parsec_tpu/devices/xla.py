@@ -90,6 +90,17 @@ params.register("device_fuse", 8,
                 "SYRK/GEMM trailing-update wave of a dense factorization "
                 "rides a single dispatch, amortizing per-launch latency; "
                 "1 disables)")
+params.register("device_fuse_panel", 1,
+                "cross-panel chain fusion: a task class carrying a "
+                "'fuse_chain' property (POTRF->TRSM, GEQRT/TSQRT->TSQRT) "
+                "is HELD at dispatch — its outputs become deferred "
+                "placeholders, its deps release eagerly as usual — and "
+                "its kernel is traced INTO the consumer wave's XLA "
+                "program, so the factorization panel chain costs ONE "
+                "dispatch round trip instead of one per link plus the "
+                "Python scheduling latency between them (the measured "
+                "potrf tunnel-state sensitivity).  0 restores the "
+                "per-kernel panel path (the A/B attribution knob)")
 params.register("device_dispatchers", 2,
                 "manager (launch) threads per XLA device: each dispatch "
                 "blocks on the transport ack (milliseconds through a "
@@ -330,6 +341,128 @@ def wait_fuse_warm(timeout: float = 600.0) -> bool:
     return _fuse_warmer.wait_idle(timeout)
 
 
+class Deferred:
+    """Placeholder payload of a chain-held task's output (cross-panel
+    fused dispatch; reference analog: the panel chains DPLASMA keeps on
+    one CUDA stream so POTRF->TRSM never round-trips through the host).
+
+    A held task's deps release eagerly — consumers instantiate and reach
+    the device with Deferred payloads — and the held kernel is traced
+    into the first consuming launch (XlaDevice._dispatch_chained), which
+    resolves ``array`` for every other consumer.  Foreign consumers (a
+    CPU body, another device, the ICI layer) call :meth:`force`, which
+    dispatches the held chain on its owning device."""
+
+    __slots__ = ("hold", "flow", "_shape", "_dtype", "array")
+
+    #: duck-typing marker for layers that must not touch placeholder
+    #: payloads (engine.stage_in_host, comm/ici.py)
+    parsec_deferred = True
+
+    def __init__(self, hold, flow, shape, dtype):
+        self.hold = hold
+        self.flow = flow
+        self._shape = shape
+        self._dtype = dtype
+        self.array = None      # filled at resolution
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nbytes(self):
+        if self.array is not None:
+            return getattr(self.array, "nbytes", 0)
+        try:
+            n = 1
+            for d in self._shape:
+                n *= int(d)
+            return n * np.dtype(self._dtype).itemsize
+        except Exception:
+            return 0
+
+    def force(self):
+        """Dispatch the held chain now (owning device) and return the
+        real array."""
+        if self.array is None:
+            self.hold.device._force_deferred(self)
+        return self.array
+
+    def is_ready(self):
+        a = self.array
+        if a is None:
+            return False
+        r = getattr(a, "is_ready", None)
+        try:
+            return bool(r()) if r is not None else True
+        except Exception:
+            return True
+
+    def block_until_ready(self):
+        import jax
+        a = self.array if self.array is not None else self.force()
+        return jax.block_until_ready(a)
+
+
+class _Hold:
+    """One chain-held device task: staged inputs + deferred outputs.
+    ``state`` moves held -> launching -> resolved under the device's
+    ``_chain_cv``."""
+
+    __slots__ = ("device", "task", "spec", "flat", "outputs", "state",
+                 "seq")
+
+
+_chain_jit_lock = threading.Lock()
+#: (node structure, wave structure) -> jitted combined program.  Keys
+#: hold the kernel function objects, so entries die only with the app's
+#: memoized kernels; chain structures repeat per panel index, so steady
+#: state compiles each shape once.
+_chain_jit_cache: Dict[Any, Any] = {}
+
+
+def _chain_jitted(key, node_specs, node_descs, wave_spec, wave_descs,
+                  donate=()):
+    """One XLA program executing the held chain nodes in topological
+    order, then the consumer wave, wiring arguments by descriptor:
+    ("l", i) = leaf input, ("n", j, flow) = node j's output, ("s", v) =
+    static value closed over (part of the cache key)."""
+    with _chain_jit_lock:
+        jf = _chain_jit_cache.get(key)
+        if jf is not None:
+            return jf
+
+    def resolve(d, leaves, node_outs):
+        tag = d[0]
+        if tag == "l":
+            return leaves[d[1]]
+        if tag == "n":
+            return node_outs[d[1]][d[2]]
+        return d[1]
+
+    def prog(*leaves):
+        node_outs = []
+        for sp, ds in zip(node_specs, node_descs):
+            args = [resolve(d, leaves, node_outs) for d in ds]
+            node_outs.append(sp.bind_outputs(sp.fn(*args)))
+        waves = []
+        if wave_spec is not None:
+            for ds in wave_descs:
+                args = [resolve(d, leaves, node_outs) for d in ds]
+                waves.append(wave_spec.bind_outputs(wave_spec.fn(*args)))
+        return node_outs, waves
+
+    import jax
+    jf = jax.jit(prog, donate_argnums=tuple(donate))
+    with _chain_jit_lock:
+        return _chain_jit_cache.setdefault(key, jf)
+
+
 #: marks an LRU entry as an in-progress adopt claim (distinguishable from
 #: a real accounted entry even at nbytes == 0)
 _PLACEHOLDER = object()
@@ -337,7 +470,7 @@ _PLACEHOLDER = object()
 
 class _Inflight:
     __slots__ = ("es", "task", "spec", "outputs", "pinned", "load",
-                 "release_after")
+                 "release_after", "prepublished")
 
     def __init__(self, es, task, spec, outputs, pinned, load, release_after):
         self.es = es
@@ -349,6 +482,9 @@ class _Inflight:
         #: host arena copies to return to their freelist once the kernel
         #: (and therefore the H2D transfer reading them) has completed
         self.release_after = release_after
+        #: chain-held tasks already planted their (Deferred) payloads at
+        #: hold time; the completer must not overwrite the resolution
+        self.prepublished = False
 
 
 class XlaDevice(Device):
@@ -402,6 +538,11 @@ class XlaDevice(Device):
 
         self._pending: deque = deque()
         self._inflight: deque = deque()
+        #: chain-held tasks (cross-panel fused dispatch): id(task) ->
+        #: _Hold, resolved when a consumer launch traces them in
+        self._held: "OrderedDict[int, _Hold]" = OrderedDict()
+        self._chain_cv = threading.Condition()
+        self._hold_seq = 0
         #: eagerly-completed tasks whose outputs are not yet materialized
         #: on device; finalized (pins/load/arena released) as they become
         #: ready, oldest-first
@@ -645,49 +786,28 @@ class XlaDevice(Device):
                         flat.append(task.locals[a])
                     else:
                         flat.append(task.taskpool.globals.get(a))
-            donate = self._donate and not self._donation_hazard(spec, flat)
-
-            def call1(fn, args):
-                """One jitted call with the transient-flake retry AT THE
-                CALL, never around a partially-executed sequence: an
-                error naming remote_compile died in the COMPILE phase —
-                nothing executed, donated inputs intact — so it retries
-                even with donation; other transient shapes retry only
-                when nothing was donated (a flake after donation leaves
-                the inputs deleted).  Retrying per call keeps the
-                singles-fallback path safe — already-executed siblings
-                consumed their donated buffers and must not replay."""
-                try:
-                    return fn(*args)
-                except Exception as exc:
-                    if not _transient_compile_error(exc) or \
-                            (donate and "remote_compile" not in str(exc)):
-                        raise
-                    warning("%s: transient compile failure (%s); "
-                            "retrying once", self.name, str(exc)[:120])
-                    return fn(*args)   # server-side cache warm now
-
-            def dispatch():
-                if n == 1:
-                    return False, [call1(spec.jitted(donate), flat)]
-                if not spec.fuse_ready(donate, n, flat):
-                    # the fused width is still compiling in the
-                    # background (tri_inv-class programs take minutes
-                    # over the tunnel): dispatch singles now — the wave
-                    # fuses once the width is warm
-                    k = len(spec.arg_names)
-                    return False, [call1(spec.jitted(donate),
-                                         flat[i * k:(i + 1) * k])
-                                   for i in range(n)]
-                return True, list(call1(spec.jitted_fused(donate, n), flat))
-
-            fused, results = dispatch()
+            # already-resolved chain placeholders substitute transparently
+            flat = [a.array if isinstance(a, Deferred)
+                    and a.array is not None else a for a in flat]
+            if n == 1 and spec.writable \
+                    and self._chain_eligible(batch[0][0], spec):
+                # chain head (POTRF(k), TSQRT(m,k)...): hold instead of
+                # dispatching — deps release eagerly through the normal
+                # completer path with Deferred payloads, and the kernel
+                # is traced into the consumer wave's launch
+                self._hold_task(batch[0], flat, pinned_per[0],
+                                release_per[0])
+                return
+            if any(isinstance(a, Deferred) for a in flat):
+                outs_per_task = self._dispatch_chained(spec, n, flat)
+                fused = False
+            else:
+                fused, outs_per_task = self._dispatch_plain(spec, n, flat)
             if fused:
                 # count only waves the fused program actually executed —
                 # a de-fused n>1 wave (fuse_ready False) ran singles
                 self.stats.fused_launches += 1
                 self.stats.fused_tasks += n
-            outs_per_task = [spec.bind_outputs(r) for r in results]
         except Exception:
             for pinned in pinned_per:
                 for d in pinned:
@@ -714,6 +834,319 @@ class XlaDevice(Device):
                     _Inflight(self.es, task, spec, outs_per_task[i],
                               pinned_per[i], load, release_per[i]))
             self._cond.notify_all()
+
+    def _dispatch_plain(self, spec: XlaKernel, n: int, flat: List[Any]):
+        """The pre-existing dispatch path: one (possibly width-fused)
+        jitted call over real arrays.  Returns (fused, bound outputs per
+        task)."""
+        donate = self._donate and not self._donation_hazard(spec, flat)
+
+        def call1(fn, args):
+            """One jitted call with the transient-flake retry AT THE
+            CALL, never around a partially-executed sequence: an
+            error naming remote_compile died in the COMPILE phase —
+            nothing executed, donated inputs intact — so it retries
+            even with donation; other transient shapes retry only
+            when nothing was donated (a flake after donation leaves
+            the inputs deleted).  Retrying per call keeps the
+            singles-fallback path safe — already-executed siblings
+            consumed their donated buffers and must not replay."""
+            try:
+                return fn(*args)
+            except Exception as exc:
+                if not _transient_compile_error(exc) or \
+                        (donate and "remote_compile" not in str(exc)):
+                    raise
+                warning("%s: transient compile failure (%s); "
+                        "retrying once", self.name, str(exc)[:120])
+                return fn(*args)   # server-side cache warm now
+
+        def dispatch():
+            if n == 1:
+                return False, [call1(spec.jitted(donate), flat)]
+            if not spec.fuse_ready(donate, n, flat):
+                # the fused width is still compiling in the
+                # background (tri_inv-class programs take minutes
+                # over the tunnel): dispatch singles now — the wave
+                # fuses once the width is warm
+                k = len(spec.arg_names)
+                return False, [call1(spec.jitted(donate),
+                                     flat[i * k:(i + 1) * k])
+                               for i in range(n)]
+            return True, list(call1(spec.jitted_fused(donate, n), flat))
+
+        fused, results = dispatch()
+        return fused, [spec.bind_outputs(r) for r in results]
+
+    # ------------------------------------------------------------------
+    # cross-panel chain fusion (device_fuse_panel): hold chain heads,
+    # trace them into their consumer wave's launch
+    # ------------------------------------------------------------------
+    def _chain_eligible(self, task: Task, spec: XlaKernel) -> bool:
+        """Whether this task may be chain-held: the knob is on, its
+        class names a 'fuse_chain' (flow, successor class), the run is
+        single-rank (remote activations must never see a Deferred
+        payload), and the chain flow has at least one task successor to
+        force the eventual launch."""
+        try:
+            if not int(params.get("device_fuse_panel", 1)):
+                return False
+        except (TypeError, ValueError):
+            return False
+        fc = task.task_class.properties.get("fuse_chain")
+        if not fc:
+            return False
+        tp = task.taskpool
+        ctx = getattr(tp, "context", None)
+        if ctx is None or getattr(ctx, "nranks", 1) > 1:
+            return False
+        flow_name = fc[0] if isinstance(fc, (tuple, list)) else fc
+        flow = task.task_class.flow(flow_name)
+        if flow is None:
+            return False
+        from parsec_tpu.core.task import ToTask
+        try:
+            for dep in flow.active_outputs(task.locals):
+                if isinstance(dep.end, ToTask):
+                    for _ in dep.end.instances(task.locals):
+                        return True
+        except Exception:
+            return False
+        return False
+
+    def _hold_task(self, item, flat, pinned, release_after) -> None:
+        """Park a chain head: its outputs become Deferred payloads on
+        the already-staged copies, and the task completes eagerly
+        through the normal completer path (deps release, successors
+        instantiate) without any dispatch."""
+        task, spec, load = item
+        h = _Hold()
+        h.device = self
+        h.task = task
+        h.spec = spec
+        h.flat = list(flat)
+        h.state = "held"
+        h.outputs = {}
+        for fl in spec.writable:
+            dc = task.data.get(fl)
+            p = dc.payload if dc is not None else None
+            d = Deferred(h, fl, tuple(getattr(p, "shape", ()) or ()),
+                         getattr(p, "dtype", None))
+            h.outputs[fl] = d
+            if dc is not None:
+                dc.payload = d
+        with self._chain_cv:
+            self._hold_seq += 1
+            h.seq = self._hold_seq
+            self._held[id(task)] = h
+        inf = _Inflight(self.es, task, spec, h.outputs, pinned, load,
+                        release_after)
+        inf.prepublished = True
+        with self._cond:
+            room = max(self._depth - 1, 0)
+            while len(self._inflight) > room and not self._stop:
+                self._cond.wait(0.1)
+            self._inflight.append(inf)
+            self._cond.notify_all()
+
+    def _claim_chain(self, roots: List[Deferred]) -> List[_Hold]:
+        """Claim the transitive closure of held tasks the given
+        placeholders depend on, all-or-nothing (two concurrent claimers
+        can never wait on each other, so no deadlock): returns the
+        claimed holds in topological (creation) order, or [] once
+        everything resolved while waiting."""
+        while True:
+            with self._chain_cv:
+                need: List[_Hold] = []
+                seen = set()
+
+                def visit(d):
+                    if d.array is not None:
+                        return
+                    hd = d.hold
+                    if id(hd) in seen or hd.state == "resolved":
+                        return
+                    seen.add(id(hd))
+                    for a in hd.flat or ():
+                        if isinstance(a, Deferred):
+                            visit(a)
+                    need.append(hd)   # post-order = dependencies first
+
+                for d in roots:
+                    visit(d)
+                if not need:
+                    return []
+                if all(hd.state == "held" for hd in need):
+                    for hd in need:
+                        hd.state = "launching"
+                    return sorted(need, key=lambda hd: hd.seq)
+                # part of the chain is being launched by another thread:
+                # wait for its resolution, then recompute the closure
+                self._chain_cv.wait(0.1)
+
+    def _run_chain(self, claimed: List[_Hold], wave_spec=None, n=0,
+                   flat=None):
+        """Trace the claimed chain (and optional consumer wave) into ONE
+        jitted program and dispatch it.  A leaf is donated to XLA only
+        when it feeds a WRITTEN flow position and appears exactly once
+        in the whole program (the usage count is the chained analog of
+        _donation_hazard) — in-place tile updates keep their HBM
+        headroom on chained panel waves too."""
+        leaves: List[Any] = []
+        leaf_ix: Dict[int, int] = {}
+        leaf_uses: Dict[int, int] = {}
+        donatable: set = set()
+        node_ix = {id(hd): i for i, hd in enumerate(claimed)}
+
+        def desc(a, writable=False):
+            if isinstance(a, Deferred):
+                if a.array is not None:
+                    a = a.array
+                else:
+                    return ("n", node_ix[id(a.hold)], a.flow)
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                j = leaf_ix.get(id(a))
+                if j is None:
+                    j = leaf_ix[id(a)] = len(leaves)
+                    leaves.append(a)
+                leaf_uses[j] = leaf_uses.get(j, 0) + 1
+                if writable:
+                    donatable.add(j)
+                return ("l", j)
+            return ("s", a)
+
+        def spec_descs(sp, args):
+            wr = [a in sp.flow_names and a in sp.writable
+                  for a in sp.arg_names]
+            return tuple(desc(a, wr[i]) for i, a in enumerate(args))
+
+        node_descs = [spec_descs(hd.spec, hd.flat) for hd in claimed]
+        wave_descs = ()
+        if wave_spec is not None and n:
+            k = len(wave_spec.arg_names)
+            wave_descs = tuple(
+                spec_descs(wave_spec, flat[t * k:(t + 1) * k])
+                for t in range(n))
+        donate = tuple(sorted(j for j in donatable
+                              if leaf_uses.get(j) == 1)) \
+            if self._donate else ()
+        key = (tuple((hd.spec.fn, d)
+                     for hd, d in zip(claimed, node_descs)),
+               wave_spec.fn if wave_spec is not None else None,
+               wave_descs, donate)
+        hash(key)    # unhashable static -> the caller's failure path
+        jf = _chain_jitted(key, [hd.spec for hd in claimed], node_descs,
+                           wave_spec, wave_descs, donate)
+        try:
+            node_outs, wave_outs = jf(*leaves)
+        except Exception as exc:
+            # transient tunneled compile flake: an error naming
+            # remote_compile died in the COMPILE phase — donated inputs
+            # intact — so it retries even with donation; other transient
+            # shapes retry only when nothing was donated (call1's rule)
+            if not _transient_compile_error(exc) or \
+                    (donate and "remote_compile" not in str(exc)):
+                raise
+            warning("%s: transient compile failure in chained launch "
+                    "(%s); retrying once", self.name, str(exc)[:120])
+            node_outs, wave_outs = jf(*leaves)
+        self.stats.chained_launches += 1
+        self.stats.chained_tasks += len(claimed) + \
+            (n if wave_spec is not None else 0)
+        return node_outs, wave_outs
+
+    def _resolve_holds(self, claimed: List[_Hold], node_outs) -> None:
+        """Publish a dispatched chain's outputs: fill every Deferred and
+        swap the placeholder payloads for the real (asynchronous)
+        arrays, then wake claim-waiters."""
+        with self._chain_cv:
+            for hd, outs in zip(claimed, node_outs):
+                for fl, arr in outs.items():
+                    d = hd.outputs.get(fl)
+                    if d is not None:
+                        d.array = arr
+                    dc = hd.task.data.get(fl)
+                    # identity check, not isinstance: on an RW chain the
+                    # SAME copy carries successive holds' placeholders
+                    # (TSQRT column T), and resolving an earlier link
+                    # must not regress the payload over a later one
+                    if dc is not None and dc.payload is d:
+                        dc.payload = arr
+                hd.state = "resolved"
+                hd.flat = None          # release the leaf input buffers
+                self._held.pop(id(hd.task), None)
+            self._chain_cv.notify_all()
+
+    def _unclaim(self, claimed: List[_Hold]) -> None:
+        with self._chain_cv:
+            for hd in claimed:
+                if hd.state == "launching":
+                    hd.state = "held"
+            self._chain_cv.notify_all()
+
+    def _dispatch_chained(self, spec: XlaKernel, n: int,
+                          flat: List[Any]) -> List[Dict[str, Any]]:
+        """Launch a wave whose inputs include unresolved chain
+        placeholders: claim the chain, trace it in front of the wave in
+        one program, resolve the held tasks' outputs from the same
+        launch.  Returns the wave's bound outputs per task."""
+        while True:
+            claimed = self._claim_chain(
+                [a for a in flat if isinstance(a, Deferred)
+                 and a.array is None])
+            # chains resolved while waiting substitute transparently
+            flat = [a.array if isinstance(a, Deferred)
+                    and a.array is not None else a for a in flat]
+            if not claimed:
+                if any(isinstance(a, Deferred) for a in flat):
+                    continue          # raced a fresh hold: re-claim
+                _f, outs = self._dispatch_plain(spec, n, flat)
+                return outs
+            try:
+                node_outs, wave_outs = self._run_chain(claimed, spec, n,
+                                                       flat)
+            except Exception:
+                self._unclaim(claimed)
+                raise
+            self._resolve_holds(claimed, node_outs)
+            return wave_outs
+
+    def _force_deferred(self, d: Deferred) -> None:
+        """Dispatch the chain behind one placeholder without a consumer
+        wave (foreign-device/CPU consumers, sync, teardown)."""
+        while d.array is None:
+            claimed = self._claim_chain([d])
+            if not claimed:
+                continue              # resolved concurrently
+            try:
+                node_outs, _ = self._run_chain(claimed)
+            except Exception:
+                self._unclaim(claimed)
+                raise
+            self._resolve_holds(claimed, node_outs)
+
+    def _resolve_all_held(self) -> None:
+        """Force every remaining hold (sync/teardown): consumers that
+        never reached this device must not leave a panel chain
+        undispatched."""
+        import time as _time
+        deadline = _time.monotonic() + 60.0
+        while True:
+            with self._chain_cv:
+                pending = [hd for hd in self._held.values()
+                           if hd.state == "held"]
+                busy = any(hd.state == "launching"
+                           for hd in self._held.values())
+            if pending:
+                # newest first: its closure covers its predecessors
+                self._force_deferred(next(iter(pending[-1].outputs.values())))
+                continue
+            if not busy:
+                return
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"device {self.name}: chain holds stuck in launch")
+            _time.sleep(0.002)
 
     @staticmethod
     def _donation_hazard(spec: XlaKernel, flat: List[Any]) -> bool:
@@ -749,6 +1182,20 @@ class XlaDevice(Device):
         and re-stages from the datum's newest valid copy below.)"""
         import jax
         datum = copy.data
+        p0 = copy.payload
+        if isinstance(p0, Deferred):
+            if p0.array is not None:
+                copy.payload = p0.array     # resolved: unwrap in place
+            elif p0.hold.device is not self:
+                # produced by a chain held on ANOTHER device: force that
+                # chain there, then stage the real array normally (D2D)
+                copy.payload = p0.force()
+            elif copy.flags & FLAG_COW or copy.is_pinned_snapshot(pinned):
+                # snapshot/COW paths materialize private buffers from
+                # the payload — they need the real array
+                copy.payload = p0.force()
+            # else: leave the placeholder — this device's launch traces
+            # the chain into the consuming program (_dispatch_chained)
         if copy.flags & FLAG_SCRATCH and copy.version == 0 \
                 and access & ACCESS_WRITE and copy.arena is not None:
             # NEW-flow scratch straight from the arena: the np.empty host
@@ -872,10 +1319,13 @@ class XlaDevice(Device):
                 self._completing += 1
                 self._cond.notify_all()
             try:
-                for fname, arr in inf.outputs.items():
-                    dc = inf.task.data.get(fname)
-                    if dc is not None:
-                        dc.payload = arr
+                if not inf.prepublished:
+                    # chain-held tasks planted their Deferred payloads at
+                    # hold time; rewriting here could clobber a resolution
+                    for fname, arr in inf.outputs.items():
+                        dc = inf.task.data.get(fname)
+                        if dc is not None:
+                            dc.payload = arr
                 scheduling.complete_execution(inf.es, inf.task)
             except Exception as exc:
                 self.stats.faults += 1
@@ -1049,6 +1499,11 @@ class XlaDevice(Device):
                 timeout=timeout)
             if not ok:
                 raise TimeoutError(f"device {self.name}: sync timed out")
+        # chain holds whose consumer never launched here (tail of the
+        # last panel, cancelled pools) dispatch now — quiescence means
+        # every held kernel has actually run
+        self._resolve_all_held()
+        with self._cond:
             entries = list(self._retire)
             self._retire.clear()
         if not entries:
@@ -1127,9 +1582,18 @@ class XlaDevice(Device):
                         return off
                     victim = None
                     for key in self._lru.keys():
-                        if self._pins.get(key, 0) <= 0:
-                            victim = key
-                            break
+                        if self._pins.get(key, 0) > 0:
+                            continue
+                        dcv = self._lru[key][0]()
+                        if dcv is not None and \
+                                isinstance(dcv.payload, Deferred) and \
+                                dcv.payload.array is None:
+                            # an unresolved chain placeholder holds no
+                            # bytes yet and its value exists nowhere
+                            # else: never a victim
+                            continue
+                        victim = key
+                        break
                     if victim is None:
                         break   # all pinned right now: wait outside
                     dcref, sz, voff = self._lru.pop(victim)
@@ -1228,6 +1692,14 @@ class XlaDevice(Device):
             self._cond.notify_all()
         for m in self._managers:
             m.join(timeout=5)
+        try:
+            # undispatched chain holds would poison flush() with
+            # placeholder payloads; the completer's final drain then
+            # finds real arrays to block on
+            self._resolve_all_held()
+        except Exception as exc:
+            warning("device %s: chain resolution at fini failed: %s",
+                    self.name, exc)
         self._completer.join(timeout=5)
         self.flush()
         debug_verbose(5, "device %s: %s", self.name, self.stats.as_dict())

@@ -111,6 +111,51 @@ static PyObject *sink_event_at(PyObject *self_, PyObject *const *args,
     Py_RETURN_NONE;
 }
 
+/* interval(key, tp, eid, oid, ts_begin, fstart, fend) — append BOTH
+ * edges of one task interval in a single crossing: the START record
+ * carries the caller-captured begin timestamp (a perf_counter() read,
+ * same CLOCK_MONOTONIC timeline), the END record is stamped here in C.
+ * One C call per task instead of two (VERDICT r5 #5: the begin/end
+ * pairing moves C-side; prof/pins.py keeps the two-call fallback). */
+static PyObject *sink_interval(PyObject *self_, PyObject *const *args,
+                               Py_ssize_t nargs) {
+    SinkObject *s = (SinkObject *)self_;
+    if (nargs != 7) {
+        PyErr_SetString(PyExc_TypeError,
+                        "interval(key, tp, eid, oid, ts_begin, fstart, "
+                        "fend)");
+        return NULL;
+    }
+    long long k = PyLong_AsLongLong(args[0]);
+    long long tp = PyLong_AsLongLong(args[1]);
+    long long e = PyLong_AsLongLong(args[2]);
+    long long o = PyLong_AsLongLong(args[3]);
+    double t0 = PyFloat_AsDouble(args[4]);
+    long long fs = PyLong_AsLongLong(args[5]);
+    long long fe = PyLong_AsLongLong(args[6]);
+    if (PyErr_Occurred())
+        return NULL;
+    while (s->len + 2 > s->cap) {
+        if (sink_grow(s) < 0)
+            return NULL;
+    }
+    pe_t *ev = &s->buf[s->len];
+    ev[0].key = (int32_t)k;
+    ev[0].flags = (int32_t)fs;
+    ev[0].tp = tp;
+    ev[0].eid = e;
+    ev[0].oid = o;
+    ev[0].ts = t0;
+    ev[1].key = (int32_t)k;
+    ev[1].flags = (int32_t)fe;
+    ev[1].tp = tp;
+    ev[1].eid = e;
+    ev[1].oid = o;
+    ev[1].ts = now_monotonic();
+    s->len += 2;
+    Py_RETURN_NONE;
+}
+
 static PyObject *sink_drain(PyObject *self_, PyObject *noargs) {
     (void)noargs;
     SinkObject *s = (SinkObject *)self_;
@@ -161,6 +206,9 @@ static PyMethodDef sink_methods[] = {
      "append one record, timestamped in C"},
     {"event_at", (PyCFunction)(void (*)(void))sink_event_at,
      METH_FASTCALL, "append one record with a caller timestamp"},
+    {"interval", (PyCFunction)(void (*)(void))sink_interval,
+     METH_FASTCALL,
+     "append a START (caller ts) + END (C ts) pair in one crossing"},
     {"drain", (PyCFunction)sink_drain, METH_NOARGS,
      "return all records as tuples and reset"},
     {NULL, NULL, 0, NULL}};

@@ -10,6 +10,7 @@ here subscribe to them.
 
 from __future__ import annotations
 
+from time import perf_counter as _now
 from typing import Any, Dict, Optional
 
 from parsec_tpu.prof.profiling import EV_END, EV_POINT, EV_START, Profile
@@ -42,6 +43,12 @@ class TaskProfilerPins:
         self._sbs: Dict[int, Any] = {}         # th_id -> StreamBuffer
         self._keys: Dict[str, int] = {}        # class name -> dict key
         self._tagged: list = []                # objects carrying caches
+        # hot-path bindings: the raw event-id counter and (per stream,
+        # below) the C sink's interval FASTCALL — each skipped Python
+        # frame is ~0.1us of the 1us/task tracer budget
+        ids = getattr(profile, "_event_ids", None)
+        self._next_eid = ids.__next__ if ids is not None \
+            else profile.next_event_id
 
     def install(self, context) -> None:
         # one task_profiler per context: the interval state rides the
@@ -80,9 +87,17 @@ class TaskProfilerPins:
         if sb is None:
             sb = self._sbs[es.th_id] = \
                 self.profile.stream(es.th_id, f"worker-{es.th_id}")
-            # hot-path cache, owner-tagged so a second profiler instance
-            # on the same context cannot reuse the wrong stream
-            es._prof_sb = (self, sb)
+        # hot-path cache, owner-tagged so a second profiler instance
+        # on the same context cannot reuse the wrong stream; the third
+        # slot is the C sink's interval FASTCALL (or None), called
+        # directly from _end/_complete — no Python frame.  The tag is
+        # (re)planted on EVERY slow-path call, not only on stream
+        # creation: _end/_complete re-read es._prof_sb after calling
+        # here, and a tag left behind by a previous profiler must not
+        # route our END records into its streams
+        cs = es.__dict__.get("_prof_sb")
+        if cs is None or cs[0] is not self or cs[1] is not sb:
+            es._prof_sb = (self, sb, getattr(sb, "_sink_interval", None))
             self._tagged.append((es, "_prof_sb"))
         return sb
 
@@ -93,9 +108,15 @@ class TaskProfilerPins:
         return k
 
     # The per-task state rides the Task.prof slot as
-    # [dict key, event id, object id, closed-by-end] — no module-level
-    # dict/set traffic on the hot path (reference: profiling.c's record
-    # path touches only the per-thread buffer; sp-perf.c is the bar).
+    # [dict key, event id, object id, closed-by-end, taskpool id,
+    # begin-timestamp] — no module-level dict/set traffic on the hot
+    # path (reference: profiling.c's record path touches only the
+    # per-thread buffer; sp-perf.c is the bar).  Info-less intervals
+    # DEFER the begin record: _begin only captures a perf_counter()
+    # read, and the closing edge writes BOTH records through ONE C
+    # crossing (StreamBuffer.interval -> pinsext interval, VERDICT r5
+    # #5).  Events carrying an info payload keep the eager two-record
+    # path.
 
     def _begin(self, es, event, task) -> None:
         if not self.profile.enabled:
@@ -108,13 +129,18 @@ class TaskProfilerPins:
             self._tagged.append((tc, "_prof_key"))
         else:
             k = ck[1]
+        eid = self._next_eid()
+        oid = hash(task.key)
+        tpid = task.taskpool.taskpool_id
+        if not self.with_locals:
+            # the timestamp is the last thing taken: it marks the edge
+            task.prof = [k, eid, oid, False, tpid, _now()]
+            return
         cs = es.__dict__.get("_prof_sb")
         sb = cs[1] if (cs is not None and cs[0] is self) else self._sb(es)
-        eid = self.profile.next_event_id()
-        oid = hash(task.key)
-        task.prof = [k, eid, oid, False]
-        info = {"locals": dict(task.locals)} if self.with_locals else None
-        sb.trace(k, EV_START, task.taskpool.taskpool_id, eid, oid, info)
+        task.prof = [k, eid, oid, False, tpid, None]
+        sb.trace(k, EV_START, tpid, eid, oid,
+                 {"locals": dict(task.locals)})
 
     def _end(self, es, event, task) -> None:
         p = task.prof
@@ -122,8 +148,15 @@ class TaskProfilerPins:
             return
         p[3] = True
         cs = es.__dict__.get("_prof_sb")
-        sb = cs[1] if (cs is not None and cs[0] is self) else self._sb(es)
-        sb.trace(p[0], EV_END, task.taskpool.taskpool_id, p[1], p[2])
+        if cs is None or cs[0] is not self:
+            self._sb(es)
+            cs = es._prof_sb
+        if p[5] is not None and cs[2] is not None:
+            cs[2](p[0], p[4], p[1], p[2], p[5], EV_START, EV_END)
+        elif p[5] is not None:
+            cs[1].interval(p[0], p[4], p[1], p[2], p[5])
+        else:
+            cs[1].trace(p[0], EV_END, p[4], p[1], p[2])
 
     def _complete(self, es, event, task) -> None:
         # device (ASYNC) tasks never ran exec_end on a worker stream:
@@ -132,11 +165,18 @@ class TaskProfilerPins:
         if p is None:
             return
         task.prof = None
-        if p[3]:                            # already closed by _end
+        if p[3] or not self.profile.enabled:    # closed by _end already
             return
         cs = es.__dict__.get("_prof_sb")
-        sb = cs[1] if (cs is not None and cs[0] is self) else self._sb(es)
-        sb.trace(p[0], EV_END, task.taskpool.taskpool_id, p[1], p[2])
+        if cs is None or cs[0] is not self:
+            self._sb(es)
+            cs = es._prof_sb
+        if p[5] is not None and cs[2] is not None:
+            cs[2](p[0], p[4], p[1], p[2], p[5], EV_START, EV_END)
+        elif p[5] is not None:
+            cs[1].interval(p[0], p[4], p[1], p[2], p[5])
+        else:
+            cs[1].trace(p[0], EV_END, p[4], p[1], p[2])
 
 
 def install_task_profiler(context, profile: Profile,

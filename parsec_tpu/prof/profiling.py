@@ -72,12 +72,17 @@ class StreamBuffer:
         self._pending: List[Tuple] = []
         self._native = None
         self._sink = None
+        self._sink_interval = None
         try:
             from parsec_tpu.native import (NativeTraceBuffer, available,
                                            load_pinsext)
             px = load_pinsext()
             if px is not None:
                 self._sink = px.TraceSink()
+                # one-crossing interval append (VERDICT r5 #5); absent
+                # on a stale prebuilt extension -> two-call fallback
+                self._sink_interval = getattr(self._sink, "interval",
+                                              None)
             elif available():
                 self._native = NativeTraceBuffer()
         except Exception:   # toolchain missing: pure-Python path
@@ -111,6 +116,22 @@ class StreamBuffer:
         self.events.append((key, flags, taskpool_id, event_id, object_id,
                             ts, info))
 
+    def interval(self, key: int, taskpool_id: int, event_id: int,
+                 object_id: int, t_begin: float) -> None:
+        """Both edges of one task interval in ONE call: the START record
+        carries the caller-captured begin timestamp (perf_counter), the
+        END record is stamped at call time.  With the C sink extension
+        this is a single boundary crossing (pinsext interval, VERDICT
+        r5 #5); otherwise it degrades to two plain records."""
+        iv = self._sink_interval
+        if iv is not None:
+            iv(key, taskpool_id, event_id, object_id, t_begin,
+               EV_START, EV_END)
+            return
+        self.trace(key, EV_START, taskpool_id, event_id, object_id,
+                   timestamp=t_begin)
+        self.trace(key, EV_END, taskpool_id, event_id, object_id)
+
     def flush_native(self) -> None:
         """Bulk-load pending info-less events into the native packed
         buffer (one boundary crossing per chunk)."""
@@ -128,7 +149,9 @@ class StreamBuffer:
             self.events.sort(key=lambda e: e[5])
             return list(self.events)
         if self._native is None:
-            return list(self.events)
+            # deferred-begin intervals append their START (earlier
+            # timestamp) at END time: order by timestamp here too
+            return sorted(self.events, key=lambda e: e[5])
         self.flush_native()
         merged = [ev + (None,) for ev in self._native.drain()]
         merged.extend(self.events)

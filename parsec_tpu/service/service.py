@@ -404,13 +404,18 @@ class JobService:
     # -- plumbing ----------------------------------------------------------
     def _sync_devices(self) -> None:
         """Quiesce accelerator pipelines before a job result is read
-        (deps release eagerly on dispatch; see Context.wait).  Device
-        errors here belong to whichever job dispatched them — swallow
-        for the reading job, the owning job's error_sink already fired."""
+        (deps release eagerly on dispatch; see Context.wait).  Task
+        errors routed through record_error belong to whichever job
+        dispatched them — its error_sink already fired — but a SYNC
+        failure (timeout, wedged device, stuck chain hold) has no
+        error_sink route: the reader's data is not materialized, so it
+        must not silently read stale tiles."""
         try:
             self.context.sync_devices(timeout=60.0)
         except Exception as exc:
             debug_verbose(2, "service device sync: %s", exc)
+            raise RuntimeError(
+                "device sync failed before result read") from exc
 
     def _emit(self, event: str, job: JobHandle) -> None:
         """Job-lifecycle PINS events (payload: the JobHandle)."""

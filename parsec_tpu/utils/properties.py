@@ -109,7 +109,25 @@ def install_taskpool_properties(ctx, tp) -> None:
     ps = ctx.properties
     ps.register(f"{base}/nb_tasks",
                 lambda tp=tp: getattr(tp, "nb_tasks", None))
+    import inspect
     classes = getattr(tp, "task_classes", None) or {}
     for cname, tc in classes.items():
         for pname, pval in getattr(tc, "properties", {}).items():
+            if callable(pval):
+                # zero-arg callables are live providers (the dictionary
+                # contract); parameterized per-task expressions (flops /
+                # coaffinity lambdas over task locals) cannot be sampled
+                # without an instance — register their description
+                try:
+                    sig = inspect.signature(pval)
+                    needs_args = any(
+                        p.default is p.empty and p.kind in
+                        (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                        for p in sig.parameters.values())
+                except (TypeError, ValueError):
+                    needs_args = False
+                if needs_args:
+                    ps.register(f"{base}/classes/{cname}/{pname}",
+                                f"<per-task expression {pname}>")
+                    continue
             ps.register(f"{base}/classes/{cname}/{pname}", pval)

@@ -1,0 +1,100 @@
+"""tools/bench_guard.py: the pre-merge bench regression smoke — diff a
+fresh bench JSON against the previous BENCH_r*.json artifact, exit
+non-zero on >threshold regression of any shared recorded metric."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+import bench_guard  # noqa: E402
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def _artifact(tmp_path, r, parsed):
+    return _write(tmp_path, f"BENCH_r{r:02d}.json",
+                  {"n": r, "rc": 0, "tail": "...", "parsed": parsed})
+
+
+def test_pass_when_within_threshold(tmp_path):
+    _artifact(tmp_path, 5, {"metric": "task_throughput",
+                            "value": 60000.0, "unit": "tasks/s",
+                            "vs_baseline": 6.0})
+    new = _write(tmp_path, "new.json",
+                 {"metric": "task_throughput", "value": 55000.0,
+                  "unit": "tasks/s", "vs_baseline": 5.5})
+    assert bench_guard.main([new, "--repo", str(tmp_path)]) == 0
+
+
+def test_fails_on_throughput_regression(tmp_path):
+    _artifact(tmp_path, 5, {"metric": "task_throughput",
+                            "value": 60000.0, "unit": "tasks/s",
+                            "vs_baseline": 6.0})
+    new = _write(tmp_path, "new.json",
+                 {"metric": "task_throughput", "value": 30000.0,
+                  "unit": "tasks/s", "vs_baseline": 3.0})
+    assert bench_guard.main([new, "--repo", str(tmp_path)]) == 1
+
+
+def test_latency_metrics_regress_upward(tmp_path):
+    _artifact(tmp_path, 4, {"metric": "task_rtt", "value": 600.0,
+                            "unit": "us/hop", "vs_baseline": 1.7})
+    # latency DROPPED 20%: an improvement, must pass
+    new = _write(tmp_path, "new.json",
+                 {"metric": "task_rtt", "value": 480.0,
+                  "unit": "us/hop", "vs_baseline": 2.1})
+    assert bench_guard.main([new, "--repo", str(tmp_path)]) == 0
+    # latency ROSE 50%: regression
+    worse = _write(tmp_path, "worse.json",
+                   {"metric": "task_rtt", "value": 900.0,
+                    "unit": "us/hop", "vs_baseline": 1.1})
+    assert bench_guard.main([worse, "--repo", str(tmp_path)]) == 1
+
+
+def test_cross_mode_compares_shared_keys_only(tmp_path):
+    """A tasks-probe run against a gemm-mode artifact shares no keys:
+    nothing to fail on (new metrics are reported, not punished)."""
+    _artifact(tmp_path, 3, {"metric": "tiled_gemm_gflops",
+                            "value": 155191.0, "unit": "GFLOP/s",
+                            "vs_baseline": 1.43})
+    new = _write(tmp_path, "new.json",
+                 {"metric": "task_throughput", "value": 10.0,
+                  "unit": "tasks/s", "vs_baseline": 0.001})
+    assert bench_guard.main([new, "--repo", str(tmp_path)]) == 0
+
+
+def test_picks_highest_round_artifact(tmp_path):
+    _artifact(tmp_path, 2, {"metric": "task_throughput", "value": 1.0,
+                            "unit": "tasks/s", "vs_baseline": 1.0})
+    _artifact(tmp_path, 10, {"metric": "task_throughput",
+                             "value": 60000.0, "unit": "tasks/s",
+                             "vs_baseline": 6.0})
+    new = _write(tmp_path, "new.json",
+                 {"metric": "task_throughput", "value": 30000.0,
+                  "unit": "tasks/s", "vs_baseline": 3.0})
+    # vs r10 (60000): -50% -> fail; would pass vs the stale r02
+    assert bench_guard.main([new, "--repo", str(tmp_path)]) == 1
+
+
+def test_merged_northstar_keys_compare(tmp_path):
+    """The r6 default mode folds tiled_potrf_mp_gflops into the gemm
+    line; the guard compares the north-star key across rounds."""
+    _artifact(tmp_path, 6, {"metric": "tiled_gemm_gflops",
+                            "value": 155000.0, "unit": "GFLOP/s",
+                            "vs_baseline": 1.43,
+                            "tiled_potrf_mp_gflops": 110.0e3,
+                            "potrf_vs_baseline": 1.01})
+    new = _write(tmp_path, "new.json",
+                 {"metric": "tiled_gemm_gflops", "value": 154000.0,
+                  "unit": "GFLOP/s", "vs_baseline": 1.42,
+                  "tiled_potrf_mp_gflops": 60.0e3,
+                  "potrf_vs_baseline": 0.55})
+    assert bench_guard.main([new, "--repo", str(tmp_path)]) == 1

@@ -797,3 +797,103 @@ def _remote_new_flow_reshape(ctx, rank, nranks):
 def test_remote_new_flow_reshape():
     res = run_distributed(_remote_new_flow_reshape, 2)
     assert res[1] == {"dtype": "bfloat16", "vals": [1.0, 2.0, 3.0, 4.0]}
+
+
+def _remote_multi_outs_worker(ctx, rank, nranks):
+    """Reference corpus: remote_multiple_outs_same_pred_flow.jdf — ONE
+    predecessor flow with SEVERAL differently-typed outputs shipped
+    remotely: each remote consumer declares its own edge dtt, so the
+    same produced payload travels twice in two different wire types."""
+    import ml_dtypes
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.data.reshape import Dtt
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, TASK
+    bf = np.dtype(ml_dtypes.bfloat16)
+    half = Dtt(transform=lambda a: a * 0.5, inverse=lambda a: a * 2.0,
+               name="half")
+    V = VectorTwoDimCyclic(mb=4, lm=4 * nranks, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 6.0
+    seen = {}
+    p = PTG("rmo")
+    p.task("P") \
+        .affinity(lambda V=V: V(0)) \
+        .flow("X", "READ",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(TASK("CB", "X", lambda: dict()), dtt=Dtt(dtype=bf)),
+              OUT(TASK("CH", "X", lambda: dict()), dtt=half)) \
+        .body(lambda: None)
+    # consumers take each edge's wire type as shipped (the corpus case
+    # declares the types on the PRODUCER's outputs; an IN re-declaring
+    # the transform would mean "convert again")
+    p.task("CB") \
+        .affinity(lambda V=V: V(1)) \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()))) \
+        .body(lambda X: seen.update(b_dtype=str(np.asarray(X).dtype),
+                                    b_val=float(np.asarray(X)[0])))
+    p.task("CH") \
+        .affinity(lambda V=V: V(1)) \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()))) \
+        .body(lambda X: seen.update(h_dtype=str(np.asarray(X).dtype),
+                                    h_val=float(np.asarray(X)[0])))
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=120)
+    return seen
+
+
+def test_remote_multiple_outs_same_pred_flow():
+    res = run_distributed(_remote_multi_outs_worker, 2)
+    assert res[1] == {"b_dtype": "bfloat16", "b_val": 6.0,
+                      "h_dtype": "float32", "h_val": 3.0}
+
+
+def _remote_multi_outs_multi_deps_worker(ctx, rank, nranks):
+    """Reference corpus: remote_multiple_outs_same_pred_flow_multiple_
+    deps.jdf — the SAME predecessor flow additionally fans a RANGE dep
+    over several instances of one remote consumer class (its own dtt)
+    next to the differently-typed single deps, all shipped remotely."""
+    import ml_dtypes
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.data.reshape import Dtt
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    bf = np.dtype(ml_dtypes.bfloat16)
+    V = VectorTwoDimCyclic(mb=4, lm=4 * nranks, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 8.0
+    seen = {}
+    p = PTG("rmomd", N=2)
+    p.task("P") \
+        .affinity(lambda V=V: V(0)) \
+        .flow("X", "READ",
+              IN(DATA(lambda V=V: V(0))),
+              OUT(TASK("CB", "X", lambda: dict()), dtt=Dtt(dtype=bf)),
+              OUT(TASK("CR", "X",
+                       lambda: [dict(i=i) for i in range(2)]),
+                  dtt=Dtt(transform=lambda a: a + 1.0,
+                          inverse=lambda a: a - 1.0, name="p1"))) \
+        .body(lambda: None)
+    p.task("CB") \
+        .affinity(lambda V=V: V(1)) \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()))) \
+        .body(lambda X: seen.update(b_dtype=str(np.asarray(X).dtype),
+                                    b_val=float(np.asarray(X)[0])))
+
+    def cr_body(X, i):
+        seen[f"r{i}"] = float(np.asarray(X)[0])
+    p.task("CR", i=Range(0, 1)) \
+        .affinity(lambda i, V=V: V(1)) \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda i: dict()))) \
+        .body(cr_body)
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=120)
+    return seen
+
+
+def test_remote_multiple_outs_same_pred_flow_multiple_deps():
+    res = run_distributed(_remote_multi_outs_multi_deps_worker, 2)
+    assert res[1] == {"b_dtype": "bfloat16", "b_val": 8.0,
+                      "r0": 9.0, "r1": 9.0}

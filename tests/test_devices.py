@@ -293,3 +293,96 @@ def test_wavefront_fusion_batches_same_class_waves():
         np.testing.assert_allclose(
             np.asarray(A.data_of(0, n).pull_to_host().payload), ref[n],
             rtol=1e-6)
+
+
+def test_cross_panel_chain_fusion_potrf():
+    """r6 tentpole: cross-panel fused dispatch — POTRF(k) is HELD at
+    the device (its deps release eagerly with Deferred payloads) and
+    its kernel is traced INTO the TRSM wave's launch, so the panel
+    chain rides one dispatch.  The result must match numpy and the
+    chained counters must show the fusion actually ran; the A/B knob
+    (PARSEC_MCA_DEVICE_FUSE_PANEL=0) must reproduce the per-kernel
+    path with zero chained launches."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+
+    def run(fuse_panel):
+        mb, nt = 16, 5
+        n = nt * mb
+        rng = np.random.default_rng(21)
+        B = rng.standard_normal((n, n)).astype(np.float32)
+        spd = (B @ B.T + n * np.eye(n)).astype(np.float32)
+        A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n,
+                              ln=n).from_array(spd.copy())
+        params.set("device_fuse_panel", fuse_panel)
+        try:
+            with Context(nb_cores=4) as ctx:
+                if not ctx.device_registry.accelerators:
+                    pytest.skip("no accelerator attached")
+                ctx.add_taskpool(potrf_taskpool(A, device="tpu"))
+                ctx.wait(timeout=120)
+                st = ctx.device_registry.accelerators[0].stats
+                chained = (st.chained_launches, st.chained_tasks)
+        finally:
+            params.unset("device_fuse_panel")
+        L = np.tril(A.to_array())
+        err = np.abs(L @ L.T - spd).max() / np.abs(spd).max()
+        assert err < 1e-4, err
+        return chained
+
+    launches, tasks = run(1)
+    assert launches > 0 and tasks > launches   # chains really fused
+    launches, tasks = run(0)                   # A/B attribution knob
+    assert launches == 0 and tasks == 0
+
+
+def test_cross_panel_chain_fusion_qr_column():
+    """The GEQRT -> TSQRT column chain: successive holds stack their
+    placeholders on the SAME RW copy; the TSMQR/UNMQR waves force the
+    chain and the factorization stays exact (regression for the
+    resolution identity check)."""
+    from parsec_tpu.apps.qr import qr_taskpool
+    mb, nt = 8, 5
+    n = nt * mb
+    rng = np.random.default_rng(22)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n).from_array(a.copy())
+    with Context(nb_cores=4) as ctx:
+        if not ctx.device_registry.accelerators:
+            pytest.skip("no accelerator attached")
+        ctx.add_taskpool(qr_taskpool(A, device="tpu"))
+        ctx.wait(timeout=120)
+        st = ctx.device_registry.accelerators[0].stats
+        assert st.chained_launches > 0
+    out = A.to_array()
+    R = np.triu(out)
+    ata = a.T @ a
+    assert np.abs(np.tril(out, -1)).max() < 1e-4
+    assert np.abs(R.T @ R - ata).max() / np.abs(ata).max() < 1e-4
+
+
+def test_chain_hold_resolves_at_sync_without_consumer():
+    """A held chain whose consumers run on the CPU incarnation (or
+    never arrive) must still dispatch: stage_in_host forces the
+    Deferred, and device sync resolves any straggler holds."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    mb, nt = 8, 3
+    n = nt * mb
+    rng = np.random.default_rng(23)
+    B = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (B @ B.T + n * np.eye(n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n).from_array(spd.copy())
+    p = potrf_taskpool(A, device="tpu")
+    # force every TRSM to the cpu incarnation: the held POTRF's W
+    # output reaches a CPU body as a Deferred payload
+    trsm = p.task_classes["TRSM"]
+    for idx, (dev_type, _hook) in enumerate(trsm.incarnations):
+        if dev_type != "cpu":
+            trsm.chore_disabled_mask |= 1 << idx
+    with Context(nb_cores=2) as ctx:
+        if not ctx.device_registry.accelerators:
+            pytest.skip("no accelerator attached")
+        ctx.add_taskpool(p)
+        ctx.wait(timeout=120)
+    L = np.tril(A.to_array())
+    err = np.abs(L @ L.T - spd).max() / np.abs(spd).max()
+    assert err < 1e-4, err

@@ -408,3 +408,69 @@ def test_aggregator_nonnumeric_ingest_and_clean_close():
     finally:
         agg.close()
     assert not agg._thread.is_alive()
+
+
+def test_interval_single_crossing_pairs_edges():
+    """VERDICT r5 #5: the begin/end pairing rides ONE C call
+    (pinsext interval) — both edges must land with the caller's begin
+    timestamp on the START record and a C-side END stamp, pairing by
+    event id like the two-call path."""
+    import time
+    from parsec_tpu.prof.profiling import (EV_END, EV_START, Profile)
+    prof = Profile()
+    sb = prof.stream(0, "t")
+    t0 = time.perf_counter()
+    time.sleep(0.002)
+    sb.interval(7, 3, 42, 99, t0)
+    evs = sb.merged_events()
+    assert len(evs) == 2
+    (k1, f1, tp1, e1, o1, ts1, _i1), (k2, f2, tp2, e2, o2, ts2, _i2) = evs
+    assert (k1, tp1, e1, o1) == (7, 3, 42, 99)
+    assert (k2, tp2, e2, o2) == (7, 3, 42, 99)
+    assert f1 == EV_START and f2 == EV_END
+    assert ts1 == t0 and ts2 >= t0 + 0.002
+
+
+def test_interval_python_fallback_matches():
+    """Without the C sink the same call degrades to two plain records."""
+    import time
+    from parsec_tpu.prof.profiling import (EV_END, EV_START,
+                                           StreamBuffer)
+    sb = StreamBuffer(1, "t")
+    sb._sink = None
+    sb._sink_interval = None
+    sb._native = None
+    t0 = time.perf_counter()
+    sb.interval(5, 2, 10, 0, t0)
+    evs = sb.merged_events()
+    assert [e[1] for e in evs] == [EV_START, EV_END]
+    assert evs[0][5] == t0 and evs[1][5] >= t0
+
+
+def test_task_profiler_deferred_begin_intervals_pair():
+    """The task profiler's deferred-begin path: a traced run still
+    yields one well-formed (START, END) interval per task."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.dsl.ptg.api import PTG, Range
+    from parsec_tpu.prof.pins import install_task_profiler
+    from parsec_tpu.prof.profiling import EV_END, EV_START, Profile
+
+    N = 16
+    p = PTG("iv", N=N)
+    p.task("E", i=Range(0, N - 1)).flow("x", "CTL").body(lambda: None)
+    prof = Profile()
+    with Context(nb_cores=2) as ctx:
+        mod = install_task_profiler(ctx, prof)
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=60)
+        mod.uninstall(ctx)
+    opened = {}
+    closed = 0
+    for sb in prof._streams.values():
+        for key, flags, _tp, eid, _oid, ts, _info in sb.merged_events():
+            if flags & EV_START:
+                opened[eid] = ts
+            elif flags & EV_END:
+                assert eid in opened and ts >= opened[eid]
+                closed += 1
+    assert closed == N

@@ -250,6 +250,37 @@ def test_edge_both_sides_dtt_consumer_wins():
     assert tp.reshape.conversions == 1
 
 
+def test_avoidable_reshape_ships_zero_conversions():
+    """Reference corpus: avoidable_reshape.jdf — when the declared edge
+    dtt already MATCHES the payload's type (producer OUT dtt and
+    consumer IN dtt both naming the tile's own f32 layout), the reshape
+    engine must detect the no-op and ship the original copy: zero
+    conversions, payload identity preserved."""
+    mb = 4
+    base = np.arange(1.0, mb + 1, dtype=np.float32)
+    V = VectorTwoDimCyclic(mb=mb, lm=mb).from_array(base.copy())
+    f32 = Dtt(dtype=np.float32)
+    seen = {}
+    p = PTG("avoid")
+    p.task("P") \
+        .flow("X", "READ",
+              IN(DATA(lambda V=V: V(0)), dtt=f32),
+              OUT(TASK("C", "X", lambda: dict()), dtt=f32)) \
+        .body(lambda: None)
+    p.task("C") \
+        .flow("X", "READ",
+              IN(TASK("P", "X", lambda: dict()), dtt=f32)) \
+        .body(lambda X: seen.update(dtype=np.asarray(X).dtype,
+                                    val=float(np.asarray(X)[0])))
+    tp = p.build()
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert seen == {"dtype": np.dtype(np.float32), "val": 1.0}
+    # the whole point of the corpus case: the no-op path converts NOTHING
+    assert tp.reshape.conversions == 0
+
+
 def test_local_new_flow_edge_reshape():
     """A NEW-flow arena temporary rides a dtt edge to its consumer: the
     reference's reshape-into-NEW case, locally (the arena defines the
