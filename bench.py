@@ -483,7 +483,40 @@ def run_potrf_bench(mb: int, nt: int, reps: int = 3,
 def _pp_worker(ctx, rank, nranks, nbytes, hops):
     from parsec_tpu.apps.pingpong import run_pingpong
     run_pingpong(ctx, nbytes, 8)          # warm the link + code paths
-    return run_pingpong(ctx, nbytes, hops)
+    before = ctx.comm.stats()
+    res = run_pingpong(ctx, nbytes, hops)
+    after = ctx.comm.stats()
+    delta = {k: after[k] - before[k] for k, v in after.items()
+             if isinstance(v, (int, float)) and not isinstance(v, bool)
+             and isinstance(before.get(k), (int, float))}
+    delta["transport"] = after.get("transport")
+    return res[0], res[1], delta
+
+
+def _protocol_breakdown(res) -> dict:
+    """Aggregate the per-rank comm stats deltas of a pingpong run into
+    the JSON protocol breakdown bench_guard watches: frames + syscalls
+    per MB moved, and the eager/rdv/inline activation mix."""
+    agg: dict = {}
+    for _hop, _mbps, delta in res:
+        for k, v in delta.items():
+            if isinstance(v, (int, float)):
+                agg[k] = agg.get(k, 0) + v
+    mb = max(agg.get("bytes_sent", 0) + agg.get("bytes_recv", 0), 1) / 1e6
+    out = {
+        "transport": res[0][2].get("transport"),
+        "frames_sent": int(agg.get("frames_sent", 0)),
+        "act_eager": int(agg.get("act_eager", 0)),
+        "act_rdv": int(agg.get("act_rdv", 0)),
+        "act_inline": int(agg.get("act_inline", 0)),
+        "coalesced_msgs": int(agg.get("coalesced_msgs", 0)),
+        "wakeups": int(agg.get("wakeups", 0)),
+        "partial_writes": int(agg.get("partial_writes", 0)),
+        "syscalls_per_mb": round(
+            (agg.get("syscalls_send", 0) + agg.get("syscalls_recv", 0))
+            / mb, 3),
+    }
+    return out
 
 
 def run_rtt_bench(hops: int = 400):
@@ -491,7 +524,8 @@ def run_rtt_bench(hops: int = 400):
     seconds per dataflow hop, reported in microseconds."""
     from parsec_tpu.comm.launch import run_distributed
     res = run_distributed(_pp_worker, 2, args=(8, hops), timeout=300)
-    return float(np.mean([r[0] for r in res])) * 1e6
+    value = float(np.mean([r[0] for r in res])) * 1e6
+    return value, {"protocol": _protocol_breakdown(res)}
 
 
 def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
@@ -504,17 +538,24 @@ def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
     same choice bandwidth.jdf runs make via MCA."""
     from parsec_tpu.comm.launch import run_distributed
     prior = os.environ.get("PARSEC_MCA_comm_eager_limit")
+    prior_ad = os.environ.get("PARSEC_MCA_comm_adaptive_eager")
     os.environ.setdefault("PARSEC_MCA_comm_eager_limit",
                           str(nbytes * 2))
+    # the probe PINS its protocol: adaptation would let a loaded host
+    # demote hops to rendezvous mid-run and flip what is being measured
+    os.environ.setdefault("PARSEC_MCA_comm_adaptive_eager", "0")
     try:
         res = run_distributed(_pp_worker, 2, args=(nbytes, hops),
                               timeout=300)
     finally:
-        if prior is None:
-            os.environ.pop("PARSEC_MCA_comm_eager_limit", None)
-        else:
-            os.environ["PARSEC_MCA_comm_eager_limit"] = prior
-    return float(np.mean([r[1] for r in res]))
+        for key, val in (("PARSEC_MCA_comm_eager_limit", prior),
+                         ("PARSEC_MCA_comm_adaptive_eager", prior_ad)):
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+    value = float(np.mean([r[1] for r in res]))
+    return value, {"protocol": _protocol_breakdown(res)}
 
 
 def _empty_pool(n):
@@ -1331,12 +1372,16 @@ def main():
     if app in _AUX_MODES:
         fn, metric, unit, target, higher = _AUX_MODES[app]
         value = fn()
+        extras = {}
+        if isinstance(value, tuple):
+            value, extras = value
         vs = (value / target) if higher else (target / value if value else 0)
         print(json.dumps({
             "metric": metric,
             "value": round(value, 3),
             "unit": unit,
             "vs_baseline": round(vs, 4),
+            **extras,
         }))
         return
     if app == "geqrf":

@@ -16,6 +16,7 @@ messages and host-side data movement, exactly the split the reference
 makes between its AM layer and its one-sided put/get.
 """
 
-from parsec_tpu.comm.engine import CommEngine, SocketCE  # noqa: F401
+from parsec_tpu.comm.engine import (CommEngine, EventLoopCE,  # noqa: F401
+                                    SocketCE, make_ce)
 from parsec_tpu.comm.remote_dep import RemoteDepEngine  # noqa: F401
 from parsec_tpu.comm.launch import run_distributed  # noqa: F401

@@ -21,10 +21,13 @@ from __future__ import annotations
 
 import os
 import pickle
+import selectors
 import socket
 import struct
 import threading
 import time
+from collections import deque
+from itertools import islice
 from typing import Any, Callable, Dict, List, Optional
 
 from parsec_tpu.utils.debug_history import mark
@@ -83,14 +86,30 @@ params.register("comm_sockbuf_mb", 4,
                 "buffers — sender/receiver ping-pong on a small window; "
                 "MB-class buffers let the kernel stream the frame")
 
+params.register("comm_sockbuf_bytes", 0,
+                "exact SO_SNDBUF/SO_RCVBUF request in BYTES (overrides "
+                "comm_sockbuf_mb when > 0).  Test hook: a tiny send "
+                "buffer forces the event-loop transport through its "
+                "partial-write resume path")
+
+params.register("comm_transport", "evloop",
+                "socket transport module: 'evloop' (single-threaded "
+                "nonblocking event loop owning every peer socket — the "
+                "reference's dedicated-comm-thread analog) or 'threads' "
+                "(one blocking receiver thread per peer + per-peer send "
+                "locks; the pre-r6 path, kept for A/B attribution)")
+
 
 def _bump_sockbufs(s: socket.socket) -> None:
-    mb = int(params.get("comm_sockbuf_mb", 4))
-    if mb <= 0:
-        return
+    nbytes = int(params.get("comm_sockbuf_bytes", 0))
+    if nbytes <= 0:
+        mb = int(params.get("comm_sockbuf_mb", 4))
+        if mb <= 0:
+            return
+        nbytes = mb << 20
     for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
         try:
-            s.setsockopt(socket.SOL_SOCKET, opt, mb << 20)
+            s.setsockopt(socket.SOL_SOCKET, opt, nbytes)
         except OSError:
             pass    # best-effort: the kernel clamps to its limits
 
@@ -117,6 +136,81 @@ def parse_dtype(spec: str):
     except TypeError:
         import ml_dtypes  # noqa: F401  (registers bfloat16 et al.)
         return _np.dtype(spec)
+
+
+class CommStats:
+    """Transport-level counters (both transports bump them), the wire
+    side of the bench's bw/rtt protocol breakdown."""
+
+    FIELDS = ("frames_sent", "frames_recv", "bytes_sent", "bytes_recv",
+              "syscalls_send", "syscalls_recv", "partial_writes",
+              "wakeups")
+
+    __slots__ = FIELDS
+
+    def __init__(self):
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def as_dict(self) -> Dict[str, int]:
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+
+def _dial_peer(host: str, port: int, myrank: int,
+               deadline_s: float = 30.0) -> socket.socket:
+    """Connect-with-retry + handshake write — the wire setup shared by
+    BOTH transports (buffers sized BEFORE connect so the TCP window
+    negotiates large; the peer may not be listening yet)."""
+    deadline = time.monotonic() + deadline_s
+    s = None
+    while True:
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            _bump_sockbufs(s)
+            s.settimeout(5)
+            s.connect((host, port))
+            s.settimeout(None)
+            break
+        except OSError:
+            # socket() itself may have raised, leaving s unbound for
+            # this iteration — a bare close() would turn the retry
+            # into a NameError escaping the deadline logic
+            try:
+                if s is not None:
+                    s.close()
+            except OSError:
+                pass
+            s = None
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    s.sendall(_HANDSHAKE.pack(_WIRE_MAGIC, _WIRE_VERSION, myrank))
+    return s
+
+
+def _frame_parts(tag: int, payload: Any) -> List[Any]:
+    """Serialize one AM into its wire parts (header, pickle body, then
+    per-buffer length + raw buffer).  Large array payloads ride OUT OF
+    BAND (pickle protocol 5) — no full-payload serialization copy."""
+    bufs: List[Any] = []
+    raws: List[Any] = []
+    if payload is not None:
+        data = pickle.dumps(payload, protocol=5,
+                            buffer_callback=bufs.append)
+        try:
+            raws = [pb.raw() for pb in bufs]
+        except BufferError:
+            # a non-contiguous exporter: fall back to in-band
+            data = pickle.dumps(payload, protocol=5)
+            raws = []
+    else:
+        data = b""
+    parts: List[Any] = [_LEN.pack(tag, len(data), len(raws)), data]
+    for raw in raws:
+        parts.append(_BUFLEN.pack(raw.nbytes))
+        parts.append(raw)
+    return parts
 
 
 class CommEngine:
@@ -151,6 +245,19 @@ class CommEngine:
         # its own application-message counters for termination detection)
         self.sent_msgs = 0
         self.recv_msgs = 0
+        self.stats = CommStats()
+        # flat generation-numbered barrier state (gather-to-0 + release;
+        # reference: ce.sync) — shared by every transport
+        self._bar_lock = threading.Lock()
+        self._bar_cond = threading.Condition(self._bar_lock)
+        self._bar_gen = 0
+        self._bar_arrived: Dict[int, int] = {}
+        self._bar_released: set = set()
+        self._bar_aborted: set = set()
+        # registered HERE, next to the state it serves: a transport
+        # that forgot the registration would hang every barrier to its
+        # timeout with nothing pointing at the cause
+        self.tag_register(TAG_BARRIER, self._barrier_cb)
         #: set by the remote-dep layer: fatal handler errors fail the rank
         #: fast instead of silently dropping the message
         self.on_error: Optional[Callable[[Exception], None]] = None
@@ -173,11 +280,103 @@ class CommEngine:
     def send_am(self, tag: int, dst: int, payload: Any) -> None:
         raise NotImplementedError
 
-    def barrier(self) -> None:
-        raise NotImplementedError
-
     def fini(self) -> None:
         pass
+
+    # -- collective: flat barrier, generation-numbered (gather-to-0 +
+    # release; reference: ce.sync) --------------------------------------
+    def _barrier_cb(self, src: int, payload: Any) -> None:
+        kind, gen = payload
+        with self._bar_cond:
+            if kind == "arrive":
+                self._bar_arrived[gen] = self._bar_arrived.get(gen, 0) + 1
+            elif kind == "abort":
+                self._bar_aborted.add(gen)
+            else:
+                self._bar_released.add(gen)
+            self._bar_cond.notify_all()
+
+    def barrier(self, timeout: float = 60.0) -> None:
+        self._bar_gen += 1
+        gen = self._bar_gen
+        if self.nranks == 1:
+            return
+        with self._bar_cond:
+            # GC residue of past generations (stragglers landing after a
+            # waiter gave up re-add entries nobody will consume — a
+            # resident engine must not accumulate them across failed
+            # rounds)
+            self._bar_arrived = {g: c for g, c in self._bar_arrived.items()
+                                 if g >= gen}
+            self._bar_released = {g for g in self._bar_released if g >= gen}
+            self._bar_aborted = {g for g in self._bar_aborted if g >= gen}
+        if self.rank == 0:
+            with self._bar_cond:
+                ok = self._bar_cond.wait_for(
+                    lambda: self._bar_arrived.get(gen, 0) == self.nranks - 1
+                    or self.dead_peers,
+                    timeout=timeout)
+                failed = (self.dead_peers and
+                          self._bar_arrived.get(gen, 0) != self.nranks - 1)
+                if not failed:
+                    if not ok:
+                        self._bar_arrived.pop(gen, None)
+                        raise TimeoutError("rank 0: barrier timeout")
+                    del self._bar_arrived[gen]
+                else:
+                    # failure paths must not leak this generation (a
+                    # resident service keeps the engine alive across
+                    # failed barriers)
+                    self._bar_arrived.pop(gen, None)
+            if failed:
+                # a peer died before arriving: fail the SURVIVORS fast
+                # too — an abort releases their wait with the cause
+                # instead of letting them ride out the full timeout
+                for r in range(1, self.nranks):
+                    try:
+                        self.send_am(TAG_BARRIER, r, ("abort", gen))
+                    except OSError:
+                        pass
+                raise ConnectionError(
+                    f"rank 0: barrier with dead peer(s) "
+                    f"{sorted(self.dead_peers)}")
+            for r in range(1, self.nranks):
+                try:
+                    self.send_am(TAG_BARRIER, r, ("release", gen))
+                except OSError:
+                    # a rank that arrived and then died must not strand
+                    # the release of later-ranked survivors
+                    warning("rank 0: barrier release to dead rank %d "
+                            "skipped", r)
+        else:
+            self.send_am(TAG_BARRIER, 0, ("arrive", gen))
+            with self._bar_cond:
+                # A SIBLING that passed this barrier and exited before
+                # our release arrived is orderly shutdown (final-barrier
+                # race), so sibling death alone does not fail us — rank
+                # 0 aborts the round if a sibling died mid-barrier, and
+                # only rank 0's own death can strand our release.
+                ok = self._bar_cond.wait_for(
+                    lambda: gen in self._bar_released
+                    or gen in self._bar_aborted
+                    or 0 in self.dead_peers,
+                    timeout=timeout)
+                if gen not in self._bar_released and \
+                        (gen in self._bar_aborted or 0 in self.dead_peers):
+                    aborted = gen in self._bar_aborted
+                    self._bar_aborted.discard(gen)
+                    raise ConnectionError(
+                        f"rank {self.rank}: barrier with dead peer(s) "
+                        f"{sorted(self.dead_peers)}"
+                        + (" (aborted by rank 0)" if aborted else ""))
+                if not ok:
+                    self._bar_released.discard(gen)
+                    self._bar_aborted.discard(gen)
+                    raise TimeoutError(
+                        f"rank {self.rank}: barrier timeout "
+                        f"(dead peers: {sorted(self.dead_peers) or None})")
+                self._bar_released.discard(gen)
+                self._bar_aborted.discard(gen)
 
     # -- pack/unpack (reference: ce.pack/unpack) ------------------------
     @staticmethod
@@ -374,12 +573,6 @@ class SocketCE(CommEngine):
         self._plock = threading.Lock()
         self._stop = False
         self._threads: List[threading.Thread] = []
-        self._bar_lock = threading.Lock()
-        self._bar_cond = threading.Condition(self._bar_lock)
-        self._bar_gen = 0
-        self._bar_arrived: Dict[int, int] = {}
-        self._bar_released: set = set()
-        self.tag_register(TAG_BARRIER, self._barrier_cb)
         self._register_onesided()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -449,34 +642,7 @@ class SocketCE(CommEngine):
                         f"rank {self.rank}: no connection from {dst}")
                 time.sleep(0.01)
         peer_host = self._hosts[dst] if self._hosts else "127.0.0.1"
-        deadline = time.monotonic() + 30
-        s = None
-        while True:
-            try:
-                # buffers must be sized BEFORE connect() so the window
-                # is negotiated large (man 7 tcp) — hence no
-                # create_connection here
-                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-                _bump_sockbufs(s)
-                s.settimeout(5)
-                s.connect((peer_host, self.port_base + dst))
-                s.settimeout(None)
-                break
-            except OSError:
-                # socket() itself may have raised, leaving s unbound for
-                # this iteration — a bare close() would turn the retry
-                # into a NameError escaping the deadline logic
-                try:
-                    if s is not None:
-                        s.close()
-                except OSError:
-                    pass
-                s = None
-                if time.monotonic() > deadline:
-                    raise
-                time.sleep(0.05)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        s.sendall(_HANDSHAKE.pack(_WIRE_MAGIC, _WIRE_VERSION, self.rank))
+        s = _dial_peer(peer_host, self.port_base + dst, self.rank)
         with self._plock:
             self._peers[dst] = s
             self._send_locks.setdefault(dst, threading.Lock())
@@ -487,8 +653,7 @@ class SocketCE(CommEngine):
         return s
 
     # -- framing -----------------------------------------------------------
-    @staticmethod
-    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytes]:
         buf = b""
         while len(buf) < n:
             try:
@@ -497,11 +662,12 @@ class SocketCE(CommEngine):
                 return None
             if not chunk:
                 return None
+            self.stats.syscalls_recv += 1
+            self.stats.bytes_recv += len(chunk)
             buf += chunk
         return buf
 
-    @staticmethod
-    def _recv_into(conn: socket.socket, n: int) -> Optional[bytearray]:
+    def _recv_into(self, conn: socket.socket, n: int) -> Optional[bytearray]:
         """Receive ``n`` bytes straight into one buffer (no quadratic
         bytes-concatenation; the out-of-band payload path)."""
         buf = bytearray(n)
@@ -514,6 +680,8 @@ class SocketCE(CommEngine):
                 return None
             if r == 0:
                 return None
+            self.stats.syscalls_recv += 1
+            self.stats.bytes_recv += r
             got += r
         return buf
 
@@ -558,6 +726,7 @@ class SocketCE(CommEngine):
                 self._peer_corrupt(src, conn, corrupt)
                 return
             self.recv_msgs += 1
+            self.stats.frames_recv += 1
             try:
                 payload = pickle.loads(data, buffers=oob) if data else None
             except Exception as exc:
@@ -612,35 +781,21 @@ class SocketCE(CommEngine):
             self.recv_msgs += 1
             self._dispatch(tag, self.rank, payload)
             return
-        bufs: List[Any] = []
-        raws: List[Any] = []
-        if payload is not None:
-            data = pickle.dumps(payload, protocol=5,
-                                buffer_callback=bufs.append)
-            try:
-                raws = [pb.raw() for pb in bufs]
-            except BufferError:
-                # a non-contiguous exporter: fall back to in-band
-                data = pickle.dumps(payload, protocol=5)
-                raws = []
-        else:
-            data = b""
-        parts: List[Any] = [_LEN.pack(tag, len(data), len(raws)), data]
-        for raw in raws:
-            parts.append(_BUFLEN.pack(raw.nbytes))
-            parts.append(raw)
+        parts = _frame_parts(tag, payload)
         s = self._connect(dst)
         with self._send_locks[dst]:
             self.sent_msgs += 1
+            self.stats.frames_sent += 1
             self._sendmsg_all(s, parts)
 
-    @staticmethod
-    def _sendmsg_all(s: socket.socket, parts: List[Any]) -> None:
+    def _sendmsg_all(self, s: socket.socket, parts: List[Any]) -> None:
         """Gather-send every part (scatter-gather keeps large array
         buffers out of any join copy); loops on partial sends."""
         views = [memoryview(p) for p in parts if len(p)]
         while views:
             sent = s.sendmsg(views)
+            self.stats.syscalls_send += 1
+            self.stats.bytes_sent += sent
             while sent and views:
                 head = views[0]
                 if sent >= head.nbytes:
@@ -650,60 +805,16 @@ class SocketCE(CommEngine):
                     views[0] = head[sent:]
                     sent = 0
 
-    # -- collective: flat barrier, generation-numbered (gather-to-0 +
-    # release; reference: ce.sync) -----------------------------------------
-    def _barrier_cb(self, src: int, payload: Any) -> None:
-        kind, gen = payload
-        with self._bar_cond:
-            if kind == "arrive":
-                self._bar_arrived[gen] = self._bar_arrived.get(gen, 0) + 1
-            else:
-                self._bar_released.add(gen)
-            self._bar_cond.notify_all()
-
-    def barrier(self, timeout: float = 60.0) -> None:
-        self._bar_gen += 1
-        gen = self._bar_gen
-        if self.nranks == 1:
-            return
-        if self.rank == 0:
-            with self._bar_cond:
-                ok = self._bar_cond.wait_for(
-                    lambda: self._bar_arrived.get(gen, 0) == self.nranks - 1
-                    or self.dead_peers,
-                    timeout=timeout)
-                if self.dead_peers and \
-                        self._bar_arrived.get(gen, 0) != self.nranks - 1:
-                    raise ConnectionError(
-                        f"rank 0: barrier with dead peer(s) "
-                        f"{sorted(self.dead_peers)}")
-                if not ok:
-                    raise TimeoutError("rank 0: barrier timeout")
-                del self._bar_arrived[gen]
-            for r in range(1, self.nranks):
-                try:
-                    self.send_am(TAG_BARRIER, r, ("release", gen))
-                except OSError:
-                    # a rank that arrived and then died must not strand
-                    # the release of later-ranked survivors
-                    warning("rank 0: barrier release to dead rank %d "
-                            "skipped", r)
-        else:
-            self.send_am(TAG_BARRIER, 0, ("arrive", gen))
-            with self._bar_cond:
-                ok = self._bar_cond.wait_for(
-                    lambda: gen in self._bar_released or self.dead_peers,
-                    timeout=timeout)
-                if self.dead_peers and gen not in self._bar_released:
-                    raise ConnectionError(
-                        f"rank {self.rank}: barrier with dead peer(s) "
-                        f"{sorted(self.dead_peers)}")
-                if not ok:
-                    raise TimeoutError(f"rank {self.rank}: barrier timeout")
-                self._bar_released.discard(gen)
-
     def fini(self) -> None:
         self._stop = True
+        try:
+            # close() alone leaves the port LISTENING while the accept
+            # thread is blocked in accept() (the kernel socket ref is
+            # held by the syscall): shutdown() wakes it so the port is
+            # actually released before fini returns
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
@@ -715,5 +826,783 @@ class SocketCE(CommEngine):
                 except OSError:
                     pass
             self._peers.clear()
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=1)
         debug_verbose(5, "rank %d CE down: sent=%d recv=%d",
                       self.rank, self.sent_msgs, self.recv_msgs)
+
+
+# ---------------------------------------------------------------------------
+# event-loop transport (the single-threaded comm engine)
+# ---------------------------------------------------------------------------
+
+#: control-plane tags jump the per-peer output queue ahead of bulk data
+#: frames (a termination token or GET request must not wait behind a
+#: multi-MB payload drain); a partially-written frame is never preempted
+_CTL_TAGS = frozenset((TAG_TERMDET, TAG_BARRIER, TAG_GET_REQ, TAG_UTRIG))
+
+#: receive state machine stages
+_ST_HS, _ST_HDR, _ST_BODY, _ST_BLEN, _ST_BUF = range(5)
+
+_IOV_CAP = 64          # views gathered per sendmsg (Linux IOV_MAX=1024)
+_RECV_BUDGET = 4 << 20  # bytes drained per readable event before yielding
+_EWMA = 0.2            # feedback smoothing for the adaptive protocol
+
+
+class _EvPeer:
+    """Per-connection state of the event loop: an incremental receive
+    parser (frames assemble across partial reads, large payloads
+    ``recv_into`` their own preallocated buffer directly) plus
+    priority-ordered output queues with partial-write resume."""
+
+    __slots__ = (
+        "rank", "sock", "born", "registered",
+        # receive state machine
+        "r_stage", "r_want", "r_got", "r_view", "r_buf", "r_small",
+        "r_tag", "r_ln", "r_nbufs", "r_body", "r_oob",
+        # send side: queued frames -> wire-committed views -> kernel
+        "q_ctl", "q_bulk", "wire", "marks", "out_bytes", "want_write",
+        # adaptive-protocol feedback (updated as frames drain)
+        "delay_ewma", "rate_ewma",
+    )
+
+    def __init__(self, rank: Optional[int], sock: Optional[socket.socket]):
+        self.rank = rank
+        self.sock = sock
+        self.born = time.monotonic()
+        self.registered = False
+        self.r_small = bytearray(_LEN.size)
+        self.r_stage = _ST_HDR
+        self.r_want = _LEN.size
+        self.r_got = 0
+        self.r_view = memoryview(self.r_small)
+        self.r_buf: Optional[bytearray] = None
+        self.r_tag = self.r_ln = self.r_nbufs = 0
+        self.r_body: Any = b""
+        self.r_oob: List[bytearray] = []
+        self.q_ctl: deque = deque()
+        self.q_bulk: deque = deque()
+        self.wire: deque = deque()   # memoryviews committed to wire order
+        self.marks: deque = deque()  # [bytes_left, t_enq, total] per frame
+        self.out_bytes = 0
+        self.want_write = False
+        self.delay_ewma: Optional[float] = None
+        self.rate_ewma: Optional[float] = None
+
+
+class EventLoopCE(CommEngine):
+    """Single-threaded nonblocking event-loop transport: ONE comm thread
+    owns accept, recv, AND send for every peer socket through a
+    ``selectors`` loop — the reference's dedicated-comm-thread model
+    (parsec_remote_dep.c progress thread making nonblocking MPI progress
+    over all peers) rebuilt over TCP.  A 2-rank exchange on one core
+    costs zero cross-thread wakeups on the data path: the AM callback
+    runs on the loop thread, and a handler's reply frames go straight to
+    ``sendmsg`` from the same stack.
+
+    Cross-thread sends (workers flushing activations, user code) ride a
+    lock-free command ring (``collections.deque``) with one self-pipe
+    wakeup, written only when the loop is parked in ``select``.  Sends
+    become per-peer priority-ordered output queues drained on EPOLLOUT
+    with vectored ``sendmsg`` gather writes (many small frames coalesce
+    into one syscall) and explicit backpressure: a partial write parks
+    the remaining views in per-peer resume state and registers write
+    interest instead of spinning.
+
+    The remote-dep layer detects ``FUNNELLED`` and folds its progress
+    loop in here (no separate progress thread, no per-peer recv
+    threads); ``post``/``add_periodic`` are its hooks.
+    """
+
+    FUNNELLED = True   # callbacks + sends are funnelled onto ONE thread
+    CAP_MT = True      # send_am remains thread-safe (via the ring)
+
+    def __init__(self, rank: int, nranks: int,
+                 port_base: Optional[int] = None):
+        super().__init__(rank, nranks)
+        if port_base is None:
+            port_base = int(params.get("comm_port_base", 0)) or \
+                int(os.environ.get("PARSEC_COMM_PORT_BASE", 23500))
+        self.port_base = port_base
+        hosts = str(params.get("comm_hosts", "") or
+                    os.environ.get("PARSEC_COMM_HOSTS", "")).strip()
+        self._hosts = [h.strip() for h in hosts.split(",")] if hosts else []
+        if self._hosts and len(self._hosts) != nranks:
+            raise ValueError(
+                f"comm_hosts names {len(self._hosts)} hosts for "
+                f"{nranks} ranks")
+        self._max_frame = int(params.get("comm_max_frame_mb", 4096)) << 20
+        self._peers: Dict[int, _EvPeer] = {}
+        self._anon: set = set()          # accepted, handshake pending
+        self._stop = False
+        self._sel = selectors.DefaultSelector()
+        self._ring: deque = deque()
+        self._sleeping = False
+        rfd, wfd = os.pipe()
+        os.set_blocking(rfd, False)
+        os.set_blocking(wfd, False)
+        self._wake_r, self._wake_w = rfd, wfd
+        self._scratch = bytearray(256 << 10)
+        self._scratch_mv = memoryview(self._scratch)
+        self._timers: List[list] = []
+        self._register_onesided()
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        _bump_sockbufs(self._listener)
+        self._listener.bind(("0.0.0.0" if self._hosts else "127.0.0.1",
+                             self.port_base + rank))
+        self._listener.listen(nranks)
+        self._listener.setblocking(False)
+        self._sel.register(self._listener, selectors.EVENT_READ,
+                           ("accept", None))
+        self._sel.register(rfd, selectors.EVENT_READ, ("wake", None))
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"ce-loop-{rank}", daemon=True)
+        self._thread.start()
+        # Deterministic connection direction (same as the threaded
+        # transport): the HIGHER rank initiates to each lower rank at
+        # init; a send to a not-yet-dialed-in higher rank just queues.
+        self._post(("timer", self._check_unconnected, 5.0))
+        try:
+            for dst in range(rank):
+                self._dial(dst)
+        except OSError:
+            # a failed dial must not abandon a half-built engine: the
+            # loop thread, selector, pipe fds, and the bound listener
+            # would leak (and block a rebind of this port)
+            self.fini()
+            raise
+
+    # -- public loop hooks (the remote-dep layer's progress seam) -------
+    def post(self, fn: Callable, *args) -> None:
+        """Run ``fn(*args)`` on the loop thread (the reference's
+        dep_cmd_queue analog)."""
+        self._post(("call", fn, args))
+
+    def add_periodic(self, fn: Callable[[], None], period: float) -> None:
+        """Run ``fn()`` on the loop thread every ``period`` seconds
+        (handle GC, flush windows)."""
+        self._post(("timer", fn, float(period)))
+
+    def peer_feedback(self, dst: int) -> Optional[Dict[str, Any]]:
+        """Adaptive-protocol feedback: queued bytes not yet on the wire,
+        EWMA of frame queue->wire latency, EWMA drain rate (bytes/s)."""
+        peer = self._peers.get(dst)
+        if peer is None:
+            return None
+        return {"out_bytes": peer.out_bytes,
+                "delay_ewma": peer.delay_ewma,
+                "rate_ewma": peer.rate_ewma}
+
+    # -- command ring ----------------------------------------------------
+    def _post(self, cmd: tuple) -> None:
+        self._ring.append(cmd)
+        if self._sleeping and self._wake_w >= 0:
+            try:
+                os.write(self._wake_w, b"\0")
+                self.stats.wakeups += 1
+            except (BlockingIOError, OSError):
+                pass   # pipe full = wakeups already pending
+
+    def _drain_ring(self) -> None:
+        ring = self._ring
+        while ring:
+            try:
+                cmd = ring.popleft()
+            except IndexError:
+                return
+            op = cmd[0]
+            try:
+                if op == "send":
+                    self._send_now(cmd[1], cmd[2], cmd[3])
+                elif op == "call":
+                    cmd[1](*cmd[2])
+                elif op == "local":
+                    self.recv_msgs += 1
+                    self._safe_dispatch(cmd[1], self.rank, cmd[2])
+                elif op == "adopt":
+                    self._adopt(cmd[1], cmd[2])
+                elif op == "timer":
+                    self._timers.append(
+                        [time.monotonic() + cmd[2], cmd[2], cmd[1]])
+                elif op == "stop":
+                    self._stop = True
+            except Exception as exc:
+                self._handler_error(exc)
+
+    def _handler_error(self, exc: Exception) -> None:
+        warning("rank %d: comm-loop command failed: %s", self.rank, exc)
+        if self.on_error is not None:
+            self.on_error(exc)
+
+    # -- the loop --------------------------------------------------------
+    def _loop(self) -> None:
+        sel = self._sel
+        while not self._stop:
+            self._drain_ring()
+            if self._stop:
+                break
+            self._run_timers()
+            self._sleeping = True
+            if self._ring:
+                self._sleeping = False
+                continue
+            try:
+                events = sel.select(self._next_timeout())
+            except OSError:
+                self._sleeping = False
+                continue
+            self._sleeping = False
+            for key, mask in events:
+                kind, peer = key.data
+                try:
+                    if kind == "accept":
+                        self._on_accept()
+                    elif kind == "wake":
+                        try:
+                            os.read(self._wake_r, 4096)
+                        except (BlockingIOError, OSError):
+                            pass
+                    else:
+                        if mask & selectors.EVENT_WRITE and \
+                                peer.sock is not None:
+                            self._flush(peer)
+                        if mask & selectors.EVENT_READ and \
+                                peer.sock is not None:
+                            self._on_read(peer)
+                except Exception as exc:   # the loop must survive
+                    self._handler_error(exc)
+        self._shutdown_drain()
+
+    def _shutdown_drain(self, deadline: float = 5.0) -> None:
+        """Orderly shutdown ships what is already queued (a barrier
+        release posted just before the stop flag flipped must reach the
+        peers — the threaded transport sent it synchronously), bounded
+        so dead peers cannot hang teardown."""
+        end = time.monotonic() + deadline
+        while time.monotonic() < end:
+            self._drain_ring()
+            pending = [p for p in self._peers.values()
+                       if p.sock is not None and
+                       (p.wire or p.q_ctl or p.q_bulk)]
+            if not pending and not self._ring:
+                return
+            for p in pending:
+                self._flush(p)
+            time.sleep(0.002)
+
+    def _next_timeout(self) -> float:
+        if not self._timers:
+            return 0.5
+        now = time.monotonic()
+        due = min(t[0] for t in self._timers) - now
+        return min(0.5, max(0.0, due))
+
+    def _run_timers(self) -> None:
+        if not self._timers:
+            return
+        now = time.monotonic()
+        for t in self._timers:
+            if now >= t[0]:
+                t[0] = now + t[1]
+                try:
+                    t[2]()
+                except Exception as exc:
+                    self._handler_error(exc)
+
+    def _check_unconnected(self) -> None:
+        """A peer with queued frames that never dialed in is a failure,
+        not a silent stall (the threaded transport's 30s connect
+        deadline, ported to the nonblocking world)."""
+        now = time.monotonic()
+        for rank, peer in list(self._peers.items()):
+            if peer.sock is None and peer.out_bytes and \
+                    now - peer.born > 30 and rank not in self.dead_peers:
+                self.dead_peers.add(rank)
+                with self._bar_cond:
+                    self._bar_cond.notify_all()
+                peer.q_ctl.clear()
+                peer.q_bulk.clear()
+                peer.out_bytes = 0
+                if self.on_error is not None:
+                    self.on_error(TimeoutError(
+                        f"rank {self.rank}: no connection from rank "
+                        f"{rank} after 30s (frames queued)"))
+
+    # -- connection management ------------------------------------------
+    def _dial(self, dst: int) -> None:
+        """Blocking connect + handshake (init thread), then hand the
+        socket to the loop."""
+        peer_host = self._hosts[dst] if self._hosts else "127.0.0.1"
+        s = _dial_peer(peer_host, self.port_base + dst, self.rank)
+        s.setblocking(False)
+        self._post(("adopt", s, dst))
+
+    def _adopt(self, sock: socket.socket, rank: int) -> None:
+        peer = self._peers.get(rank)
+        if peer is not None and peer.sock is None:
+            peer.sock = sock       # frames queued before connect: keep
+            peer.born = time.monotonic()
+        else:
+            peer = _EvPeer(rank, sock)
+            self._peers[rank] = peer
+        self._sel.register(sock, selectors.EVENT_READ, ("peer", peer))
+        peer.registered = True
+        self._flush(peer)
+
+    def _on_accept(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _bump_sockbufs(conn)
+            conn.setblocking(False)
+            peer = _EvPeer(None, conn)
+            peer.r_stage = _ST_HS
+            peer.r_want = _HANDSHAKE.size
+            peer.r_got = 0
+            peer.r_buf = None
+            peer.r_view = memoryview(peer.r_small)
+            self._anon.add(peer)
+            self._sel.register(conn, selectors.EVENT_READ, ("peer", peer))
+            peer.registered = True
+
+    def _close_peer(self, peer: _EvPeer) -> None:
+        sock = peer.sock
+        peer.sock = None
+        self._anon.discard(peer)
+        if sock is not None:
+            if peer.registered:
+                try:
+                    self._sel.unregister(sock)
+                except (KeyError, ValueError, OSError):
+                    pass
+                peer.registered = False
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _peer_down(self, peer: _EvPeer, cause: Optional[str]) -> None:
+        """Failure detection: the connection fails WITH its cause — the
+        engine contract — and wakes barrier/quiescence waiters."""
+        self._close_peer(peer)
+        # frames can never reach a dead peer: drop them (and stop
+        # accumulating — _send_now discards for dead ranks), else a
+        # resident service leaks every later token/activation to it
+        peer.q_ctl.clear()
+        peer.q_bulk.clear()
+        peer.wire.clear()
+        peer.marks.clear()
+        peer.out_bytes = 0
+        src = peer.rank
+        if self._stop or src is None or src in self.dead_peers:
+            return
+        warning("rank %d: lost connection to rank %d%s", self.rank, src,
+                f": {cause}" if cause else "")
+        self.dead_peers.add(src)
+        with self._bar_cond:
+            self._bar_cond.notify_all()
+        if self.on_error is not None:
+            self.on_error(ConnectionError(
+                f"rank {self.rank}: peer rank {src} disconnected mid-run"
+                + (f": {cause}" if cause else "")))
+
+    def _sever(self, peer: _EvPeer, why: str) -> None:
+        warning("rank %d: protocol corruption from rank %s: %s",
+                self.rank, peer.rank, why)
+        self._peer_down(peer, why)
+
+    # -- send path -------------------------------------------------------
+    def send_am(self, tag: int, dst: int, payload: Any = None) -> None:
+        mark("send_am tag=%d dst=%d", tag, dst)
+        if dst == self.rank:
+            # local delivery short-circuit (counts as a message so the
+            # termination balance stays symmetric); same posted-FIFO
+            # rule as the remote branch below
+            self.sent_msgs += 1
+            if threading.current_thread() is self._thread and \
+                    not self._ring:
+                self.recv_msgs += 1
+                self._dispatch(tag, self.rank, payload)
+            else:
+                self._post(("local", tag, payload))
+            return
+        if threading.current_thread() is self._thread:
+            # per-destination FIFO across threads: a loop-thread send
+            # (handler reply) must not overtake worker sends already
+            # POSTED but not yet drained — the DTD lane protocol owes
+            # its total write-chain order to this
+            if self._ring:
+                self._ring.append(("send", tag, dst, payload))
+            else:
+                self._send_now(tag, dst, payload)
+        else:
+            self._post(("send", tag, dst, payload))
+
+    def _send_now(self, tag: int, dst: int, payload: Any) -> None:
+        if dst in self.dead_peers:
+            return        # undeliverable; the loss already surfaced
+        peer = self._peers.get(dst)
+        if peer is None:
+            # not yet dialed in (higher-rank peer owns the initiation):
+            # frames queue on a placeholder and flush at adoption
+            peer = self._peers[dst] = _EvPeer(dst, None)
+        self.sent_msgs += 1
+        self.stats.frames_sent += 1
+        self._enqueue(peer, tag, payload)
+        if peer.sock is not None:
+            self._flush(peer)
+
+    def _enqueue(self, peer: _EvPeer, tag: int, payload: Any) -> None:
+        parts = _frame_parts(tag, payload)
+        views = [memoryview(p) for p in parts if len(p)]
+        nbytes = sum(v.nbytes for v in views)
+        q = peer.q_ctl if tag in _CTL_TAGS else peer.q_bulk
+        q.append((time.monotonic(), nbytes, views))
+        peer.out_bytes += nbytes
+
+    def _flush(self, peer: _EvPeer) -> None:
+        sock = peer.sock
+        if sock is None:
+            return
+        stats = self.stats
+        while True:
+            # commit queued frames to wire order (control first; a
+            # partially-sent frame is never preempted)
+            while len(peer.wire) < _IOV_CAP and (peer.q_ctl or peer.q_bulk):
+                t_enq, nb, views = (peer.q_ctl.popleft() if peer.q_ctl
+                                    else peer.q_bulk.popleft())
+                peer.wire.extend(views)
+                peer.marks.append([nb, t_enq, nb])
+            if not peer.wire:
+                self._set_write(peer, False)
+                return
+            iov = list(islice(peer.wire, _IOV_CAP))
+            try:
+                sent = sock.sendmsg(iov)
+            except (BlockingIOError, InterruptedError):
+                stats.partial_writes += 1
+                self._set_write(peer, True)
+                return
+            except OSError as exc:
+                self._peer_down(peer, f"send failed: {exc}")
+                return
+            stats.syscalls_send += 1
+            stats.bytes_sent += sent
+            peer.out_bytes -= sent
+            short = sent < sum(v.nbytes for v in iov)
+            self._consume(peer, sent)
+            if short:
+                # kernel send buffer full mid-frame: park the resume
+                # state, drain the rest on EPOLLOUT (backpressure)
+                stats.partial_writes += 1
+                self._set_write(peer, True)
+                return
+
+    def _consume(self, peer: _EvPeer, sent: int) -> None:
+        wire = peer.wire
+        while sent:
+            head = wire[0]
+            if sent >= head.nbytes:
+                sent -= head.nbytes
+                self._mark_drained(peer, head.nbytes)
+                wire.popleft()
+            else:
+                wire[0] = head[sent:]
+                self._mark_drained(peer, sent)
+                sent = 0
+
+    @staticmethod
+    def _mark_drained(peer: _EvPeer, n: int) -> None:
+        marks = peer.marks
+        while n and marks:
+            m = marks[0]
+            take = n if n < m[0] else m[0]
+            m[0] -= take
+            n -= take
+            if m[0] == 0:
+                marks.popleft()
+                dt = time.monotonic() - m[1]
+                # feedback for the adaptive eager protocol: observed
+                # frame queue->wire latency and drain rate
+                if dt > 0:
+                    rate = m[2] / dt
+                    peer.rate_ewma = rate if peer.rate_ewma is None \
+                        else (1 - _EWMA) * peer.rate_ewma + _EWMA * rate
+                peer.delay_ewma = dt if peer.delay_ewma is None \
+                    else (1 - _EWMA) * peer.delay_ewma + _EWMA * dt
+
+    def _set_write(self, peer: _EvPeer, want: bool) -> None:
+        if peer.want_write == want or peer.sock is None:
+            return
+        peer.want_write = want
+        ev = selectors.EVENT_READ | \
+            (selectors.EVENT_WRITE if want else 0)
+        try:
+            self._sel.modify(peer.sock, ev, ("peer", peer))
+        except (KeyError, ValueError, OSError):
+            pass
+
+    # -- receive path ----------------------------------------------------
+    def _on_read(self, peer: _EvPeer) -> None:
+        budget = _RECV_BUDGET
+        scratch = self._scratch
+        smv = self._scratch_mv
+        stats = self.stats
+        while budget > 0 and peer.sock is not None:
+            rem = peer.r_want - peer.r_got
+            if peer.r_buf is not None and rem >= len(scratch):
+                # bulk stage: receive straight into the frame's own
+                # preallocated buffer (zero-copy out-of-band path)
+                want = rem if rem < budget else budget
+                try:
+                    n = peer.sock.recv_into(
+                        peer.r_view[peer.r_got:peer.r_got + want])
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError as exc:
+                    self._peer_down(peer, f"recv failed: {exc}")
+                    return
+                if n == 0:
+                    self._eof(peer)
+                    return
+                stats.syscalls_recv += 1
+                stats.bytes_recv += n
+                peer.r_got += n
+                budget -= n
+                if peer.r_got == peer.r_want and not self._advance(peer):
+                    return
+                if n < want:
+                    return        # socket drained
+            else:
+                # buffered stage: one read, then carve every complete
+                # small frame out of it (frames/syscall coalescing)
+                try:
+                    n = peer.sock.recv_into(scratch)
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError as exc:
+                    self._peer_down(peer, f"recv failed: {exc}")
+                    return
+                if n == 0:
+                    self._eof(peer)
+                    return
+                stats.syscalls_recv += 1
+                stats.bytes_recv += n
+                budget -= n
+                if not self._feed(peer, smv[:n]):
+                    return
+                if n < len(scratch):
+                    return        # socket drained
+
+    def _feed(self, peer: _EvPeer, mv: memoryview) -> bool:
+        while len(mv):
+            take = peer.r_want - peer.r_got
+            if take > len(mv):
+                take = len(mv)
+            peer.r_view[peer.r_got:peer.r_got + take] = mv[:take]
+            peer.r_got += take
+            mv = mv[take:]
+            if peer.r_got == peer.r_want and not self._advance(peer):
+                return False
+        return True
+
+    def _expect_hdr(self, peer: _EvPeer) -> None:
+        peer.r_stage = _ST_HDR
+        peer.r_want = _LEN.size
+        peer.r_got = 0
+        peer.r_buf = None
+        peer.r_view = memoryview(peer.r_small)
+
+    def _advance(self, peer: _EvPeer) -> bool:
+        """One receive stage filled; returns False when the peer was
+        severed or the socket handed off (stop reading it)."""
+        st = peer.r_stage
+        if st == _ST_HS:
+            magic, ver, src = _HANDSHAKE.unpack_from(peer.r_small)
+            if magic != _WIRE_MAGIC or ver != _WIRE_VERSION:
+                warning("rank %d: rejected connection with bad handshake "
+                        "(magic=%r version=%r)", self.rank, magic, ver)
+                self._close_peer(peer)
+                return False
+            peer.rank = src
+            if src in self.dead_peers:
+                # a rank we already declared dead has no rejoin
+                # protocol: accepting it would create a half-connected
+                # zombie (its frames dispatched and Safra-counted while
+                # _send_now drops every reply)
+                warning("rank %d: rejected reconnection from dead rank "
+                        "%d", self.rank, src)
+                self._close_peer(peer)
+                return False
+            existing = self._peers.get(src)
+            if existing is not None and existing is not peer:
+                if existing.sock is None:
+                    # frames queued before the peer dialed in: adopt
+                    peer.q_ctl.extend(existing.q_ctl)
+                    peer.q_bulk.extend(existing.q_bulk)
+                    peer.out_bytes += existing.out_bytes
+                else:
+                    warning("rank %d: duplicate connection from rank %d "
+                            "rejected", self.rank, src)
+                    self._close_peer(peer)
+                    return False
+            self._peers[src] = peer
+            self._anon.discard(peer)
+            self._expect_hdr(peer)
+            self._flush(peer)
+            return peer.sock is not None
+        if st == _ST_HDR:
+            tag, ln, nbufs = _LEN.unpack_from(peer.r_small)
+            if ln > self._max_frame or nbufs > 4096:
+                self._sever(peer, f"frame length {ln}/{nbufs} bufs "
+                                  f"exceeds the {self._max_frame >> 20} "
+                                  f"MiB bound (tag={tag})")
+                return False
+            peer.r_tag, peer.r_ln, peer.r_nbufs = tag, ln, nbufs
+            peer.r_body = b""
+            peer.r_oob = []
+            if ln:
+                buf = bytearray(ln)
+                peer.r_buf = buf
+                peer.r_view = memoryview(buf)
+                peer.r_stage = _ST_BODY
+                peer.r_want = ln
+                peer.r_got = 0
+                return True
+            return self._next_buf(peer)
+        if st == _ST_BODY:
+            peer.r_body = peer.r_buf
+            return self._next_buf(peer)
+        if st == _ST_BLEN:
+            (bln,) = _BUFLEN.unpack_from(peer.r_small)
+            if bln > self._max_frame:
+                self._sever(peer, f"oob buffer length {bln} "
+                                  f"(tag={peer.r_tag})")
+                return False
+            if bln == 0:
+                peer.r_oob.append(bytearray(0))
+                return self._next_buf(peer)
+            buf = bytearray(bln)
+            peer.r_buf = buf
+            peer.r_view = memoryview(buf)
+            peer.r_stage = _ST_BUF
+            peer.r_want = bln
+            peer.r_got = 0
+            return True
+        if st == _ST_BUF:
+            peer.r_oob.append(peer.r_buf)
+            return self._next_buf(peer)
+        return True
+
+    def _next_buf(self, peer: _EvPeer) -> bool:
+        if len(peer.r_oob) < peer.r_nbufs:
+            peer.r_stage = _ST_BLEN
+            peer.r_want = _BUFLEN.size
+            peer.r_got = 0
+            peer.r_buf = None
+            peer.r_view = memoryview(peer.r_small)
+            return True
+        return self._frame_done(peer)
+
+    def _frame_done(self, peer: _EvPeer) -> bool:
+        self.recv_msgs += 1
+        self.stats.frames_recv += 1
+        tag = peer.r_tag
+        body, oob = peer.r_body, peer.r_oob
+        src = peer.rank
+        self._expect_hdr(peer)   # reset BEFORE dispatch (handlers send)
+        if body:
+            try:
+                payload = pickle.loads(body, buffers=oob)
+            except Exception as exc:
+                self._sever(peer, f"undecodable frame tag={tag}: {exc}")
+                return False
+        else:
+            payload = None
+        self._safe_dispatch(tag, src, payload)
+        return peer.sock is not None
+
+    def _safe_dispatch(self, tag: int, src: int, payload: Any) -> None:
+        try:
+            self._dispatch(tag, src, payload)
+        except Exception as exc:   # handler error must not kill the loop,
+            warning("rank %d: AM handler tag=%d failed: %s",
+                    self.rank, tag, exc)
+            if self.on_error is not None:   # ...but must fail the rank
+                self.on_error(exc)
+
+    def _eof(self, peer: _EvPeer) -> None:
+        if peer.r_stage == _ST_HDR and peer.r_got == 0:
+            self._peer_down(peer, None)      # closed between frames
+        elif peer.r_stage == _ST_HS:
+            self._close_peer(peer)           # stranger never handshook
+        else:
+            self._peer_down(
+                peer, f"peer died mid-frame (stage={peer.r_stage}, "
+                      f"{peer.r_got}/{peer.r_want} bytes of tag="
+                      f"{peer.r_tag})")
+
+    # -- teardown --------------------------------------------------------
+    def fini(self) -> None:
+        self._stop = True
+        self._post(("stop",))
+        wake_w = self._wake_w
+        if wake_w >= 0:
+            try:
+                os.write(wake_w, b"\0")
+            except OSError:
+                pass
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for peer in list(self._peers.values()) + list(self._anon):
+            sock = peer.sock
+            peer.sock = None
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        try:
+            self._sel.close()
+        except (OSError, RuntimeError):
+            pass
+        # invalidate BEFORE closing: a second fini must not write to or
+        # close a recycled fd number belonging to someone else
+        fds = (self._wake_r, self._wake_w)
+        self._wake_r = self._wake_w = -1
+        for fd in fds:
+            if fd >= 0:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        debug_verbose(5, "rank %d CE down: sent=%d recv=%d %s",
+                      self.rank, self.sent_msgs, self.recv_msgs,
+                      self.stats.as_dict())
+
+
+def make_ce(rank: int, nranks: int,
+            port_base: Optional[int] = None) -> CommEngine:
+    """Transport factory: ``comm_transport`` MCA knob (env
+    ``PARSEC_MCA_COMM_TRANSPORT``) selects ``evloop`` (default) or
+    ``threads`` — the pre-event-loop path kept selectable for A/B
+    attribution, mirroring the device_fuse_* knob convention."""
+    transport = str(params.get("comm_transport", "evloop")
+                    or "evloop").lower()
+    if transport in ("threads", "thread", "socketce"):
+        return SocketCE(rank, nranks, port_base)
+    if transport not in ("evloop", "eventloop", "select"):
+        warning("unknown comm_transport %r: using evloop", transport)
+    return EventLoopCE(rank, nranks, port_base)

@@ -226,7 +226,12 @@ class IciEngine:
         with self._lock:
             prog = self._prog_cache.get(key)
             if prog is None:
-                from jax import shard_map
+                try:
+                    from jax import shard_map
+                except ImportError:
+                    # jax 0.4.x ships it under experimental only (the
+                    # top-level alias landed in 0.5); same callable
+                    from jax.experimental.shard_map import shard_map
 
                 def body(t):
                     return lax.ppermute(t, "d", perm)

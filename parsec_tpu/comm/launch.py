@@ -54,11 +54,14 @@ def _worker(rank: int, nranks: int, port_base: int, nb_cores: int,
             jax.config.update("jax_platforms", platform)
         except Exception:
             pass
-        from parsec_tpu.comm.engine import SocketCE
+        from parsec_tpu.comm.engine import make_ce
         from parsec_tpu.comm.remote_dep import RemoteDepEngine
         from parsec_tpu.core.context import Context
 
-        ce = SocketCE(rank, nranks, port_base)
+        # transport selected by PARSEC_MCA_COMM_TRANSPORT (inherited by
+        # the spawned children): evloop (default) or threads (the old
+        # per-peer-thread path, kept for A/B attribution)
+        ce = make_ce(rank, nranks, port_base)
         ctx = Context(nb_cores=nb_cores, rank=rank, nranks=nranks)
         rde = RemoteDepEngine(ce, ctx)
         ce.barrier()   # every rank's handlers are wired before user code

@@ -29,6 +29,7 @@ from __future__ import annotations
 import itertools
 import queue as queue_mod
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -45,6 +46,29 @@ params.register("comm_eager_limit", 64 * 1024,
                 "payloads up to this many bytes ride inside the activation")
 params.register("comm_coll_bcast", "binomial",
                 "activation fan-out topology: star | chain | binomial")
+params.register("comm_adaptive_eager", True,
+                "adapt the eager/rendezvous threshold per peer from the "
+                "transport's observed frame latency vs drain rate "
+                "(starts at comm_eager_limit; backpressure halves it, a "
+                "fast-draining pipe raises it toward the cap).  Only "
+                "active on transports exporting peer_feedback (evloop)")
+params.register("comm_eager_min", 4096,
+                "adaptive floor: the per-peer eager threshold never "
+                "drops below min(this, comm_eager_limit)")
+params.register("comm_eager_cap_mult", 4,
+                "adaptive ceiling: per-peer threshold may rise to "
+                "comm_eager_limit * this when the peer's pipe drains "
+                "fast (payloads that size skip the rendezvous "
+                "round-trip)")
+params.register("comm_backpressure_ms", 2.0,
+                "projected per-peer queue drain delay above which "
+                "payloads are demoted to rendezvous (the adaptive "
+                "protocol's latency budget)")
+params.register("comm_flush_window_ms", 0.0,
+                "cross-TASK activation flush window in milliseconds: "
+                "same-destination activations of tasks completing "
+                "within the window pack into one framed batch "
+                "(0 = off: coalescing stays per-task)")
 
 _handle_seq = itertools.count(1)
 
@@ -70,7 +94,6 @@ class _Handle:
     __slots__ = ("data", "refs", "lock", "born")
 
     def __init__(self, data, refs: int):
-        import time
         self.data = data
         self.refs = refs
         self.lock = threading.Lock()
@@ -113,20 +136,55 @@ class RemoteDepEngine:
         self._dyn_holds: List = []
         self._dyn_released = threading.Event()
         ce.on_error = self._on_handler_error
-        # Funnelled progress: socket recv threads only ENQUEUE; one
-        # dedicated comm-progress thread runs the dep-engine work and
-        # drains sends with per-peer aggregation (reference: the comm
-        # thread + dep_cmd_queue, remote_dep_mpi.c:461-503 — a slow dep
-        # resolution must not head-of-line-block a peer's recv socket,
-        # and GET replies must not serialize payloads on the request
-        # path).
+        #: protocol counters (exported through stats() -> bench bw/rtt)
+        self.proto: Dict[str, int] = {
+            "act_eager": 0, "act_rdv": 0, "act_inline": 0,
+            "eager_bytes": 0, "rdv_bytes": 0,
+            "coalesced_batches": 0, "coalesced_msgs": 0,
+            "eager_downshift": 0, "eager_upshift": 0,
+        }
+        #: per-peer adaptive eager state: dst -> {"eager": cur, "base":..}
+        self._proto_peer: Dict[int, Dict[str, int]] = {}
+        # adaptive-law constants cached off the task-retire hot path
+        # (each params.get is a registry-lock round trip); the
+        # comm_adaptive_eager SWITCH stays a live lookup so tests and
+        # operators can flip it mid-run
+        self._bp_budget = float(params.get("comm_backpressure_ms",
+                                           2.0)) * 1e-3
+        self._eager_floor_cfg = int(params.get("comm_eager_min", 4096))
+        self._eager_cap_mult = max(
+            1, int(params.get("comm_eager_cap_mult", 4)))
+        #: guards proto counters + _proto_peer read-modify-writes:
+        #: flush_activations runs concurrently on every worker stream
+        self._proto_lock = threading.Lock()
+        #: cross-task flush window: dst -> [(tag, msg), ...]
+        self._flushbox: Dict[int, List] = {}
+        self._flush_lock = threading.Lock()
+        self._flush_deadline: Optional[float] = None
+        # Progress model (reference: the comm thread + dep_cmd_queue,
+        # remote_dep_mpi.c:461-503).  On a FUNNELLED transport (evloop)
+        # the dep-engine work runs directly on the transport's single
+        # loop thread: AM callbacks dispatch the handlers in place and
+        # sends ride the loop's command ring — zero cross-thread
+        # wakeups on the recv->handler->send data path.  On the
+        # threaded transport, socket recv threads only ENQUEUE and one
+        # dedicated comm-progress thread drains the command queue with
+        # per-peer send aggregation (the pre-r6 path, selectable via
+        # PARSEC_MCA_COMM_TRANSPORT=threads for A/B attribution).
+        self.funnelled = bool(getattr(ce, "FUNNELLED", False))
         self._cmdq: "queue_mod.Queue" = queue_mod.Queue()
         self._stop = False
-        ce.tag_register(TAG_ACTIVATE, self._enq_cb("activate"))
-        ce.tag_register(TAG_GET_REQ, self._enq_cb("get_req"))
-        ce.tag_register(TAG_GET_REP, self._enq_cb("get_rep"))
+        if self.funnelled:
+            ce.tag_register(TAG_ACTIVATE, self._activate_cb)
+            ce.tag_register(TAG_GET_REQ, self._get_req_cb)
+            ce.tag_register(TAG_GET_REP, self._get_rep_cb)
+            ce.tag_register(TAG_DTD, self._dtd_cb)
+        else:
+            ce.tag_register(TAG_ACTIVATE, self._enq_cb("activate"))
+            ce.tag_register(TAG_GET_REQ, self._enq_cb("get_req"))
+            ce.tag_register(TAG_GET_REP, self._enq_cb("get_rep"))
+            ce.tag_register(TAG_DTD, self._enq_cb("dtd"))
         ce.tag_register(TAG_TERMDET, self._termdet_cb)
-        ce.tag_register(TAG_DTD, self._enq_cb("dtd"))
         ce.tag_register(TAG_BATCH, self._batch_cb)
         ce.tag_register(TAG_UTRIG, self._utrig_cb)
         #: pending GET completions: handle -> (tp_id, deliveries)
@@ -142,10 +200,19 @@ class RemoteDepEngine:
             "get_rep": self._get_rep_cb,
             "dtd": self._dtd_cb,
         }
-        self._progress = threading.Thread(
-            target=self._progress_loop, name=f"parsec-comm-{self.rank}",
-            daemon=True)
-        self._progress.start()
+        #: cross-task flush window, cached at init (run-scoped knob)
+        self._flush_window = float(params.get("comm_flush_window_ms", 0.0))
+        if self.funnelled:
+            self._progress = None
+            ce.add_periodic(self._purge_stale_handles, 5.0)
+            if self._flush_window > 0:
+                ce.add_periodic(self._drain_flush_window,
+                                max(self._flush_window * 5e-4, 0.001))
+        else:
+            self._progress = threading.Thread(
+                target=self._progress_loop,
+                name=f"parsec-comm-{self.rank}", daemon=True)
+            self._progress.start()
 
     # ------------------------------------------------------------------
     # funnelled comm progress (reference: remote_dep_dequeue_main)
@@ -195,13 +262,19 @@ class RemoteDepEngine:
         thread (reference: parsec_remote_dep_memcpy's short-circuit,
         remote_dep_mpi.c:557 — local reshape copies ride the comm thread
         so workers never block on memcpy)."""
-        self._cmdq.put(("memcpy", dst_copy, src_copy))
+        if self.funnelled:
+            self.ce.post(self._do_memcpy, dst_copy, src_copy)
+        else:
+            self._cmdq.put(("memcpy", dst_copy, src_copy))
+
+    @staticmethod
+    def _do_memcpy(dst_copy, src_copy) -> None:
+        np.copyto(np.asarray(dst_copy.payload), np.asarray(src_copy.payload))
 
     def _purge_stale_handles(self) -> None:
         """GC rendezvous handles no receiver ever pulled (reference gap
         closed: refcounted handles with no timeout would leak if a rank
         in the bcast tree dies or the eager race skips its GET)."""
-        import time
         ttl = float(params.get("comm_handle_timeout", 120.0))
         now = time.monotonic()
         stale = []
@@ -217,12 +290,12 @@ class RemoteDepEngine:
         self.ce.purge_once_regions(ttl)
 
     def _progress_loop(self) -> None:
-        import time
         next_purge = time.monotonic() + 5.0
         while not self._stop:
             if time.monotonic() > next_purge:
                 self._purge_stale_handles()
                 next_purge = time.monotonic() + 5.0
+            self._drain_flush_window()
             try:
                 cmd = self._cmdq.get(timeout=0.05)
             except queue_mod.Empty:
@@ -285,8 +358,14 @@ class RemoteDepEngine:
                  dep.end.flow))
 
     def flush_activations(self, es, task) -> None:
-        """Group the task's buffered edges by flow payload and send one
-        activation message down the bcast tree per flow."""
+        """Group the task's buffered edges by flow payload and pack
+        EVERY same-destination activation of the completing task into
+        one framed batch (reference: remote_dep.c aggregating all of a
+        task's output deps per rank into one activation message — one
+        frame/syscall per dependency edge bundle, not per edge).  With
+        ``comm_flush_window_ms`` > 0 the batch additionally holds for a
+        short window so activations of OTHER tasks completing within it
+        coalesce too."""
         with self._outbox_lock:
             edges = self._outbox.pop(id(task), None)
         if not edges:
@@ -299,6 +378,7 @@ class RemoteDepEngine:
                                     {"copy": copy, "targets": {}})
             ent["targets"].setdefault(dst, []).append((tc_name, locs, dflow))
         tp = task.taskpool
+        per_child: Dict[int, List[Tuple[int, dict]]] = {}
         for fname, ent in byflow.items():
             copy = ent["copy"]
             targets = ent["targets"]
@@ -310,23 +390,131 @@ class RemoteDepEngine:
                 "deliveries": {r: targets[r] for r in ranks},
                 "ranks": ranks,
             }
+            children = self._children(msg, self.rank)
             if copy is not None:
                 payload = copy.payload
                 if hasattr(payload, "addressable_shards") or \
                         not isinstance(payload, np.ndarray):
                     payload = np.asarray(payload)   # pull device data home
                 buf, dt, shape = _encode(payload)
-                if getattr(buf, "nbytes", len(buf)) <= self.eager:
+                nbytes = getattr(buf, "nbytes", len(buf))
+                thr = min((self._peer_eager(c) for c in children),
+                          default=self.eager)
+                if nbytes <= thr:
                     msg["data"] = ("eager", buf, dt, shape)
+                    with self._proto_lock:
+                        self.proto["act_eager"] += 1
+                        self.proto["eager_bytes"] += nbytes
                 else:
                     h = next(_handle_seq)
                     with self._hlock:
                         self._handles[h] = _Handle((buf, dt, shape),
                                                    refs=len(ranks))
                     msg["data"] = ("get", h, dt, shape)
+                    with self._proto_lock:
+                        self.proto["act_rdv"] += 1
+                        self.proto["rdv_bytes"] += nbytes
             else:
                 msg["data"] = None
-            self._send_tree(msg)
+                with self._proto_lock:
+                    self.proto["act_inline"] += 1
+            for child in children:
+                per_child.setdefault(child, []).append((TAG_ACTIVATE, msg))
+        if not per_child:
+            return
+        window = self._flush_window
+        if window > 0:
+            with self._flush_lock:
+                for child, items in per_child.items():
+                    self._flushbox.setdefault(child, []).extend(items)
+                if self._flush_deadline is None:
+                    self._flush_deadline = time.monotonic() + window * 1e-3
+            self._drain_flush_window()   # opportunistic: past-due drains
+        else:
+            for child, items in per_child.items():
+                self._send_batch(child, items)
+
+    def _drain_flush_window(self, force: bool = False) -> None:
+        """Ship the cross-task flush window once its deadline passed
+        (driven by the transport's periodic hook / the progress loop)."""
+        if not self._flushbox:
+            return
+        with self._flush_lock:
+            if not self._flushbox:
+                return
+            if not force and self._flush_deadline is not None and \
+                    time.monotonic() < self._flush_deadline:
+                return
+            box, self._flushbox = self._flushbox, {}
+            self._flush_deadline = None
+        for child, items in box.items():
+            self._send_batch(child, items)
+
+    # -- adaptive eager/rendezvous threshold (reference: the eager-limit
+    # MCA of remote_dep_mpi.c, made per-peer and feedback-driven) --------
+    def _peer_eager(self, dst: int) -> int:
+        """Per-peer eager threshold: starts at ``comm_eager_limit``;
+        observed backpressure (projected queue drain delay or frame
+        latency above the budget) halves it — big payloads then ride
+        rendezvous so receivers pull when ready — while a fast-draining
+        pipe raises it toward the cap, skipping the GET round-trip."""
+        base = self.eager
+        if not params.get("comm_adaptive_eager", True):
+            return base
+        fb_fn = getattr(self.ce, "peer_feedback", None)
+        fb = fb_fn(dst) if fb_fn is not None else None
+        budget = self._bp_budget
+        floor = min(self._eager_floor_cfg, base)
+        cap = base * self._eager_cap_mult
+        now = time.monotonic()
+        # the read-modify-write below is shared across worker streams
+        # completing tasks concurrently: a lost adjustment would leave a
+        # congested peer's threshold half-lowered
+        with self._proto_lock:
+            st = self._proto_peer.get(dst)
+            if st is None or st["base"] != base:
+                # (re)base: tests and benches mutate self.eager mid-run
+                st = self._proto_peer[dst] = {"eager": base, "base": base,
+                                              "adj_at": 0.0}
+            if fb is None:
+                return st["eager"]
+            # one adjustment per feedback window: a burst of queries
+            # within one budget interval sees the SAME stale EWMA
+            # sample — shifting once per query would multiply the step
+            # and thrash the threshold instead of converging
+            if now - st.get("adj_at", 0.0) < budget:
+                return st["eager"]
+            rate = fb.get("rate_ewma") or 0.0
+            pending = fb.get("out_bytes") or 0
+            delay = fb.get("delay_ewma") or 0.0
+            if rate > 0:
+                proj = pending / rate
+            else:
+                proj = 0.0 if pending < (64 << 10) else 2.0 * budget
+            if proj > budget or delay > 4.0 * budget:
+                if st["eager"] > floor:
+                    st["eager"] = max(floor, st["eager"] // 2)
+                    st["adj_at"] = now
+                    self.proto["eager_downshift"] += 1
+            elif proj < budget / 8.0 and delay < budget / 2.0 and \
+                    st["eager"] < cap:
+                st["eager"] = min(cap, st["eager"] * 2)
+                st["adj_at"] = now
+                self.proto["eager_upshift"] += 1
+            return st["eager"]
+
+    def stats(self) -> Dict[str, Any]:
+        """Protocol + transport counters for the bench's bw/rtt modes
+        and the prof gauges."""
+        with self._proto_lock:
+            out: Dict[str, Any] = dict(self.proto)
+            out["peer_eager"] = {r: st["eager"]
+                                 for r, st in self._proto_peer.items()}
+        out.update(self.ce.stats.as_dict())
+        out["msgs_sent"] = self.ce.sent_msgs
+        out["msgs_recv"] = self.ce.recv_msgs
+        out["transport"] = "evloop" if self.funnelled else "threads"
+        return out
 
     # -- bcast topologies (reference: remote_dep.c:334-357, virtual
     # topologies re-rooted at the source) ----------------------------------
@@ -354,15 +542,36 @@ class RemoteDepEngine:
             self._send_app(TAG_ACTIVATE, child, msg)
 
     def _send_app(self, tag: int, dst: int, payload) -> None:
-        """Application-message send: counted and blackening (Safra),
-        funnelled through the comm-progress thread which aggregates
-        per-peer (reference: remote_dep_dequeue_send, the payload was
-        already serialized by the caller so worker threads never block
-        on the socket)."""
+        """Application-message send: counted and blackening (Safra).
+        On the event-loop transport the frame goes straight onto the
+        loop's command ring; on the threaded transport it funnels
+        through the comm-progress thread which aggregates per-peer
+        (reference: remote_dep_dequeue_send)."""
         with self._term_lock:
             self._color_black = True
             self._app_sent += 1
-        self._cmdq.put(("send", tag, dst, payload))
+        self._post_send(tag, dst, payload)
+
+    def _send_batch(self, dst: int, items: List[Tuple[int, Any]]) -> None:
+        """Send several application messages to one destination as ONE
+        wire frame (TAG_BATCH); each inner message stays individually
+        counted for Safra (the receiver's _batch_cb mirrors this)."""
+        with self._term_lock:
+            self._color_black = True
+            self._app_sent += len(items)
+        if len(items) == 1:
+            self._post_send(items[0][0], dst, items[0][1])
+            return
+        with self._proto_lock:
+            self.proto["coalesced_batches"] += 1
+            self.proto["coalesced_msgs"] += len(items)
+        self._post_send(TAG_BATCH, dst, list(items))
+
+    def _post_send(self, tag: int, dst: int, payload) -> None:
+        if self.funnelled:
+            self.ce.send_am(tag, dst, payload)
+        else:
+            self._cmdq.put(("send", tag, dst, payload))
 
     # ------------------------------------------------------------------
     # receiver side
@@ -559,6 +768,9 @@ class RemoteDepEngine:
         if self._pending_gets or self.dtd_refs_pending or \
                 not self._cmdq.empty():
             return False
+        if self._flushbox:
+            self._drain_flush_window(force=True)
+            return False
         with ctx._lock:
             return ctx._active_taskpools == 0
 
@@ -647,6 +859,9 @@ class RemoteDepEngine:
         if self._pending_gets or self.dtd_refs_pending or \
                 not self._cmdq.empty():
             return False
+        if self._flushbox:
+            self._drain_flush_window(force=True)
+            return False
         with self._term_lock:
             holds = list(self._dyn_holds)
         with ctx._lock:
@@ -688,7 +903,6 @@ class RemoteDepEngine:
                     "kind": "dyn_token", "black": False, "balance": 0,
                     "rounds": 0})
             threading.Thread(target=kick, daemon=True).start()
-        import time
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._dyn_released.wait(0.05):
             if self.ce.dead_peers:
@@ -717,7 +931,6 @@ class RemoteDepEngine:
                     "kind": "token", "black": False, "balance": 0,
                     "rounds": 0})
             threading.Thread(target=kick, daemon=True).start()
-        import time
         deadline = time.monotonic() + timeout
         while not self._terminated.wait(0.05):
             if self.ce.dead_peers:
@@ -731,5 +944,7 @@ class RemoteDepEngine:
 
     def fini(self) -> None:
         self._stop = True
-        self._progress.join(timeout=5)
+        self._drain_flush_window(force=True)
+        if self._progress is not None:
+            self._progress.join(timeout=5)
         self.ce.fini()

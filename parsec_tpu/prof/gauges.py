@@ -17,7 +17,10 @@ class Gauges:
     GAUGE_NAMES = ("tasks_enabled", "tasks_retired", "tasks_discarded",
                    "pending_tasks",
                    "device_bytes_in", "device_bytes_out",
-                   "device_tasks", "device_evictions")
+                   "device_tasks", "device_evictions",
+                   "comm_frames_sent", "comm_frames_recv",
+                   "comm_bytes_sent", "comm_bytes_recv",
+                   "comm_act_eager", "comm_act_rdv")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -63,12 +66,25 @@ class Gauges:
             "device_evictions": 0,
         }
         ctx = self.context
+        for name in ("comm_frames_sent", "comm_frames_recv",
+                     "comm_bytes_sent", "comm_bytes_recv",
+                     "comm_act_eager", "comm_act_rdv"):
+            snap[name] = 0
         if ctx is not None:
             for d in ctx.device_registry.devices[1:]:
                 snap["device_bytes_in"] += d.stats.bytes_in
                 snap["device_bytes_out"] += d.stats.bytes_out
                 snap["device_tasks"] += d.stats.executed_tasks
                 snap["device_evictions"] += d.stats.evictions
+            comm = getattr(ctx, "comm", None)
+            if comm is not None and hasattr(comm, "stats"):
+                cs = comm.stats()
+                snap["comm_frames_sent"] = cs.get("frames_sent", 0)
+                snap["comm_frames_recv"] = cs.get("frames_recv", 0)
+                snap["comm_bytes_sent"] = cs.get("bytes_sent", 0)
+                snap["comm_bytes_recv"] = cs.get("bytes_recv", 0)
+                snap["comm_act_eager"] = cs.get("act_eager", 0)
+                snap["comm_act_rdv"] = cs.get("act_rdv", 0)
         return snap
 
 

@@ -191,21 +191,48 @@ class JobServer:
                         else int(params.get("service_port", 41990))))
         self._srv.listen(16)
         self.host, self.port = self._srv.getsockname()[:2]
+        # selector-driven accept (comm/engine.py event-loop discipline):
+        # a nonblocking listener + a self-pipe instead of a 0.2s accept
+        # timeout poll — close() interrupts the wait instantly and an
+        # idle server makes zero wakeups
+        self._srv.setblocking(False)
+        self._wake_r, self._wake_w = socket.socketpair()
         self._thread = threading.Thread(target=self._accept_loop,
                                         name="job-server", daemon=True)
         self._thread.start()
 
     def _accept_loop(self) -> None:
-        self._srv.settimeout(0.2)
-        while not self._stop:
-            try:
-                conn, _addr = self._srv.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+        import selectors
+        sel = selectors.DefaultSelector()
+        sel.register(self._srv, selectors.EVENT_READ, "accept")
+        sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        try:
+            while not self._stop:
+                try:
+                    events = sel.select()
+                except OSError:
+                    return       # close() raced us and closed the fds
+                for key, _mask in events:
+                    if key.data != "accept":
+                        return                       # close() poked us
+                    try:
+                        conn, _addr = self._srv.accept()
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    except OSError:
+                        return
+                    # accepted sockets may inherit the listener's
+                    # nonblocking mode (BSD/macOS): the per-connection
+                    # handler's framed recv is written blocking
+                    conn.setblocking(True)
+                    # request/reply latency discipline of the comm
+                    # transport: no Nagle stall on small JSON replies
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    threading.Thread(target=self._serve_conn,
+                                     args=(conn,), daemon=True).start()
+        finally:
+            sel.close()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         with conn:
@@ -289,11 +316,16 @@ class JobServer:
 
     def close(self) -> None:
         self._stop = True
-        self._thread.join(timeout=2)
         try:
-            self._srv.close()
+            self._wake_w.send(b"\0")     # interrupt the selector wait
         except OSError:
             pass
+        self._thread.join(timeout=2)
+        for s in (self._srv, self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------

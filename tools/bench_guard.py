@@ -37,7 +37,15 @@ import sys
 
 #: metrics where SMALLER is better (everything else: bigger is better)
 LOWER_IS_BETTER = ("task_rtt", "tracer_overhead", "backward_error",
-                   "factorization_residual")
+                   "factorization_residual",
+                   # bw/rtt protocol-mix guards (the r6 event-loop
+                   # transport): more wire frames or more syscalls per
+                   # MB moved for the same probe is a transport
+                   # regression even when the headline number hides in
+                   # host noise.  act_eager stays higher-is-better by
+                   # default: the probes declare eager coverage, so
+                   # eroding it IS a regression
+                   "frames_sent", "syscalls_per_mb")
 
 #: keys that are configuration/metadata or noise diagnostics, never
 #: compared.  rep_band/best are extreme order statistics of a protocol
@@ -45,11 +53,18 @@ LOWER_IS_BETTER = ("task_rtt", "tracer_overhead", "backward_error",
 #: headline gates; the refinement LADDERS (per-step residual histories)
 #: legitimately move by orders of magnitude and are accuracy evidence,
 #: not rate metrics.
-SKIP_KEYS = {"metric", "unit", "protocol", "storage", "note", "ib",
+SKIP_KEYS = {"metric", "unit", "storage", "note", "ib",
              "fuse_panel", "potrf_protocol", "potrf_storage",
              "potrf_fuse_panel", "rep_band_gflops", "best_gflops",
              "potrf_rep_band_gflops", "potrf_best_gflops",
-             "ir_residuals", "potrf_ir_residuals", "ls_refine_errors"}
+             "ir_residuals", "potrf_ir_residuals", "ls_refine_errors",
+             # partial_writes depends on transient kernel send-buffer
+             # state and wakeups on OS thread-scheduling timing — not
+             # on the code under test; act_rdv/act_inline/coalesced are
+             # direction-less mix descriptors (act_eager alone gates:
+             # eager coverage eroding is the regression)
+             "partial_writes", "wakeups", "act_rdv", "act_inline",
+             "coalesced_msgs", "transport"}
 
 
 def _load(path: str) -> dict:
@@ -116,7 +131,21 @@ def _lower_is_better(path: str) -> bool:
     # metric (bench.py inverts latency-class targets itself)
     if path.endswith("vs_baseline"):
         return False
-    return any(tag in path for tag in LOWER_IS_BETTER)
+    segs = [s.split("[")[0] for s in path.split(".")]
+    leaf = segs[-1]
+    # leaf-scoped: the counter's own direction wherever it appears
+    # (protocol breakdown keys, error leaves incl. prefixed forms like
+    # potrf_backward_error)
+    if any(tag in leaf for tag in LOWER_IS_BETTER):
+        return True
+    # metric-scoped: ONLY the namespaced headline (metric.value)
+    # inherits the metric's direction from its prefix — a protocol leaf
+    # under task_rtt.* must not inherit "lower is better" (that
+    # inverted act_eager gating for the rtt probe)
+    if leaf == "value":
+        return any(tag in seg for seg in segs[:-1]
+                   for tag in LOWER_IS_BETTER)
+    return False
 
 
 def _namespaced(obj: dict) -> dict:

@@ -1,0 +1,34 @@
+#!/bin/sh
+# Pre-merge bench smoke: run the CPU-only host-side probes and diff each
+# against the last driver artifact (BENCH_r*.json) with bench_guard.
+#
+# These probes time the Python+TCP runtime layers (no accelerator), so
+# they run anywhere in ~3 minutes and catch scheduler/transport
+# regressions — including the r6 protocol-mix guards (frames_sent,
+# syscalls_per_mb, and act_eager coverage under the bw/rtt "protocol"
+# key; wakeups/partial_writes are recorded but not gated — they track
+# OS scheduling timing, not the code under test) — before a change
+# merges.  Documented in BENCH.md ("Pre-merge guard").
+#
+# Usage:  sh tools/premerge_bench.sh [threshold]
+#         threshold: relative regression that fails (default 0.15)
+set -e
+repo="$(cd "$(dirname "$0")/.." && pwd)"
+threshold="${1:-0.15}"
+rc=0
+for mode in tasks rtt bw; do
+    echo "== premerge probe: $mode =="
+    out="/tmp/premerge_${mode}_$$.json"
+    if ! JAX_PLATFORMS=cpu PARSEC_BENCH_APP=$mode \
+         python "$repo/bench.py" > "$out" 2>/dev/null; then
+        echo "premerge: $mode probe FAILED to run"
+        rc=1
+        continue
+    fi
+    if ! python "$repo/tools/bench_guard.py" "$out" --repo "$repo" \
+         --threshold "$threshold"; then
+        rc=1
+    fi
+    rm -f "$out"
+done
+exit $rc
