@@ -482,7 +482,18 @@ def run_potrf_bench(mb: int, nt: int, reps: int = 3,
 
 def _pp_worker(ctx, rank, nranks, nbytes, hops):
     from parsec_tpu.apps.pingpong import run_pingpong
+    trace_dir = os.environ.get("PARSEC_BENCH_TRACE_DIR")
+    mod = tr = prof = None
     run_pingpong(ctx, nbytes, 8)          # warm the link + code paths
+    if trace_dir:
+        # install AFTER the warmup: the embedded attribution must
+        # describe the measured run, not the warmup pool + gap
+        from parsec_tpu.prof.causal import install_causal_tracer
+        from parsec_tpu.prof.pins import install_task_profiler
+        from parsec_tpu.prof.profiling import Profile
+        prof = Profile(f"bench-pp-r{rank}")
+        mod = install_task_profiler(ctx, prof)
+        tr = install_causal_tracer(ctx, prof)
     before = ctx.comm.stats()
     res = run_pingpong(ctx, nbytes, hops)
     after = ctx.comm.stats()
@@ -490,7 +501,26 @@ def _pp_worker(ctx, rank, nranks, nbytes, hops):
              if isinstance(v, (int, float)) and not isinstance(v, bool)
              and isinstance(before.get(k), (int, float))}
     delta["transport"] = after.get("transport")
+    if trace_dir:
+        mod.uninstall(ctx)
+        tr.uninstall(ctx)
+        prof.dump(os.path.join(trace_dir, f"rank{rank}.ptt"))
     return res[0], res[1], delta
+
+
+def _trace_attribution(trace_dir) -> dict:
+    """Merge the per-rank bench traces and fold the critical-path
+    attribution into the bench JSON line (informational: bench_guard
+    skips it — the buckets reshuffle with host load, and the tracer
+    overhead gate lives in premerge_bench.sh)."""
+    import glob as _glob
+    from parsec_tpu.prof import critpath
+    paths = sorted(_glob.glob(os.path.join(trace_dir, "rank*.ptt")))
+    att = critpath.attribution(paths)
+    return {"makespan_s": round(att["makespan"], 6),
+            "coverage": att["coverage"],
+            "flows": att["flows"],
+            **{k: round(v, 6) for k, v in att["buckets"].items()}}
 
 
 def _protocol_breakdown(res) -> dict:
@@ -521,11 +551,33 @@ def _protocol_breakdown(res) -> dict:
 
 def run_rtt_bench(hops: int = 400):
     """2-rank task round-trip latency over loopback (rtt.jdf analog):
-    seconds per dataflow hop, reported in microseconds."""
+    seconds per dataflow hop, reported in microseconds.
+
+    ``PARSEC_BENCH_TRACE=1`` additionally traces both ranks, merges the
+    traces, and embeds the critical-path attribution (exec/queue/comm/
+    idle buckets, prof/critpath.py) in the JSON line — the per-hop time
+    breakdown PR 3 reconstructed by hand, now tool-produced."""
     from parsec_tpu.comm.launch import run_distributed
-    res = run_distributed(_pp_worker, 2, args=(8, hops), timeout=300)
+    extras = {}
+    trace_dir = None
+    if os.environ.get("PARSEC_BENCH_TRACE", "0") == "1":
+        import tempfile
+        trace_dir = tempfile.mkdtemp(prefix="bench-rtt-trace-")
+        os.environ["PARSEC_BENCH_TRACE_DIR"] = trace_dir
+    try:
+        res = run_distributed(_pp_worker, 2, args=(8, hops), timeout=300)
+    finally:
+        os.environ.pop("PARSEC_BENCH_TRACE_DIR", None)
     value = float(np.mean([r[0] for r in res])) * 1e6
-    return value, {"protocol": _protocol_breakdown(res)}
+    if trace_dir:
+        import shutil
+        try:
+            extras["attribution"] = _trace_attribution(trace_dir)
+        except Exception as exc:   # the headline must still publish
+            log(f"rtt trace attribution FAILED: {exc!r}")
+        finally:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+    return value, {"protocol": _protocol_breakdown(res), **extras}
 
 
 def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
@@ -568,16 +620,33 @@ def _empty_pool(n):
 def run_tasks_bench(n: int = 20000):
     """Empty-body task throughput, tasks/s — the DAG-scheduling
     efficiency proxy (insert+wait over n no-op tasks; every runtime
-    layer except the body is on the clock)."""
+    layer except the body is on the clock).
+
+    ``PARSEC_BENCH_TRACE=1`` runs the same probe with the FULL tracing
+    stack installed (binary task profiler + causal tracer: queue-wait
+    spans, dep edges) — the premerge tracer-overhead gate compares this
+    against the default untraced run (tools/premerge_bench.sh)."""
     from parsec_tpu.core.context import Context
+    trace = os.environ.get("PARSEC_BENCH_TRACE", "0") == "1"
     with Context(nb_cores=int(os.environ.get("PARSEC_BENCH_CORES", 4))) \
             as ctx:
+        mod = tr = None
+        if trace:
+            from parsec_tpu.prof.causal import install_causal_tracer
+            from parsec_tpu.prof.pins import install_task_profiler
+            from parsec_tpu.prof.profiling import Profile
+            prof = Profile("bench-tasks")
+            mod = install_task_profiler(ctx, prof)
+            tr = install_causal_tracer(ctx, prof)
         ctx.add_taskpool(_empty_pool(n // 10))   # warm
         ctx.wait()
         t0 = time.perf_counter()
         ctx.add_taskpool(_empty_pool(n))
         ctx.wait()
         dt = time.perf_counter() - t0
+        if mod is not None:
+            mod.uninstall(ctx)
+            tr.uninstall(ctx)
     return n / dt
 
 

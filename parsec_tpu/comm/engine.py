@@ -55,6 +55,7 @@ TAG_UTRIG = 8     # user-trigger termination declaration
 TAG_PUT = 9       # one-sided put into a registered region
 TAG_GET1 = 10     # one-sided get request
 TAG_GET1_REP = 11
+TAG_CLOCK = 12    # clock-offset ping/pong (causal-trace alignment)
 TAG_USER = 16     # first tag available to applications
 
 #: frame header: (tag, pickle length, out-of-band buffer count).  Large
@@ -91,6 +92,11 @@ params.register("comm_sockbuf_bytes", 0,
                 "comm_sockbuf_mb when > 0).  Test hook: a tiny send "
                 "buffer forces the event-loop transport through its "
                 "partial-write resume path")
+
+params.register("comm_clock_samples", 4,
+                "ping samples per clock-offset probe round; the "
+                "minimum-RTT sample's midpoint estimate wins (error "
+                "bounded by that sample's rtt/2 under asymmetric delay)")
 
 params.register("comm_transport", "evloop",
                 "socket transport module: 'evloop' (single-threaded "
@@ -136,6 +142,20 @@ def parse_dtype(spec: str):
     except TypeError:
         import ml_dtypes  # noqa: F401  (registers bfloat16 et al.)
         return _np.dtype(spec)
+
+
+def clock_offset_estimate(samples):
+    """Peer clock offset (``clock_peer - clock_mine``, seconds) and rtt
+    from ping samples ``[(t0, t1, t2), ...]`` — t0 = ping send and t2 =
+    pong arrival on OUR clock, t1 = the peer's stamp on ITS clock.  The
+    minimum-RTT sample's midpoint estimate ``t1 - (t0 + t2) / 2`` wins:
+    queuing delay only ever inflates rtt, so the tightest round trip is
+    the closest to symmetric, and the estimate's error is bounded by
+    that sample's rtt/2 even under fully asymmetric path delay (the
+    NTP/Cristian bound)."""
+    best = min(samples, key=lambda s: s[2] - s[0])
+    t0, t1, t2 = best
+    return t1 - (t0 + t2) / 2.0, t2 - t0
 
 
 class CommStats:
@@ -258,6 +278,15 @@ class CommEngine:
         # that forgot the registration would hang every barrier to its
         # timeout with nothing pointing at the cause
         self.tag_register(TAG_BARRIER, self._barrier_cb)
+        #: per-peer clock alignment (causal traces): rank ->
+        #: {offset (clock_peer - clock_mine, perf_counter seconds),
+        #:  rtt, drift (s/s), measured_at (monotonic)} — fed by the
+        #: TAG_CLOCK ping exchange, re-probed periodically by the
+        #: remote-dep progress/event loop
+        self.clock: Dict[int, Dict[str, float]] = {}
+        self._clock_lock = threading.Lock()
+        self._clock_pend: Dict[int, List] = {}
+        self.tag_register(TAG_CLOCK, self._clock_cb)
         #: set by the remote-dep layer: fatal handler errors fail the rank
         #: fast instead of silently dropping the message
         self.on_error: Optional[Callable[[Exception], None]] = None
@@ -377,6 +406,78 @@ class CommEngine:
                         f"(dead peers: {sorted(self.dead_peers) or None})")
                 self._bar_released.discard(gen)
                 self._bar_aborted.discard(gen)
+
+    # -- clock alignment (causal traces): Cristian-style ping exchange --
+    def probe_clocks(self, samples: Optional[int] = None) -> None:
+        """Fire one offset-probe round at every live peer: ``samples``
+        pings whose pongs fold into ``self.clock`` asynchronously (the
+        estimator keeps the minimum-RTT sample).  TAG_CLOCK rides the
+        control lane (_CTL_TAGS) so a ping measures protocol latency,
+        not the bulk queue it would otherwise sit behind."""
+        if self.nranks == 1:
+            return
+        n = samples if samples is not None \
+            else max(1, int(params.get("comm_clock_samples", 4)))
+        for r in range(self.nranks):
+            if r == self.rank or r in self.dead_peers:
+                continue
+            for _ in range(n):
+                try:
+                    self.send_am(TAG_CLOCK, r,
+                                 {"k": "ping", "n": n,
+                                  "t0": time.perf_counter()})
+                except OSError:
+                    break
+
+    def _clock_cb(self, src: int, msg: dict) -> None:
+        if msg.get("k") == "ping":
+            try:
+                self.send_am(TAG_CLOCK, src,
+                             {"k": "pong", "n": msg.get("n", 1),
+                              "t0": msg["t0"],
+                              "t1": time.perf_counter()})
+            except OSError:
+                pass
+            return
+        t2 = time.perf_counter()
+        with self._clock_lock:
+            pend = self._clock_pend.setdefault(src, [])
+            pend.append((msg["t0"], msg["t1"], t2))
+            if len(pend) < msg.get("n", 1):
+                return
+            samples, self._clock_pend[src] = list(pend), []
+        self._clock_update(src, samples)
+
+    def _clock_update(self, src: int, samples: List) -> None:
+        off, rtt = clock_offset_estimate(samples)
+        now = time.monotonic()
+        with self._clock_lock:
+            st = self.clock.get(src)
+            if st is None:
+                self.clock[src] = {"offset": off, "rtt": rtt,
+                                   "drift": 0.0, "measured_at": now}
+                return
+            dt = now - st["measured_at"]
+            # a round whose best rtt is much worse than what we have
+            # seen is congestion, not clock motion — keep the old
+            # estimate unless it has gone stale (then anything beats
+            # extrapolating a minute-old offset)
+            if rtt > 2.0 * st["rtt"] and dt < 60.0:
+                return
+            if dt > 1.0:
+                st["drift"] = (off - st["offset"]) / dt
+            st["offset"] = off
+            # the ACCEPTED sample's rtt, not an all-time minimum: the
+            # recorded value must bound the stored offset's error
+            # (rtt/2), and a ratcheted floor would make the congestion
+            # veto above monotonically stricter as host load rises
+            st["rtt"] = rtt
+            st["measured_at"] = now
+
+    def clock_table(self) -> Dict[int, Dict[str, float]]:
+        """Snapshot of the per-peer alignment state (trace headers)."""
+        with self._clock_lock:
+            return {r: dict(st) for r, st in self.clock.items()}
 
     # -- pack/unpack (reference: ce.pack/unpack) ------------------------
     @staticmethod
@@ -840,7 +941,8 @@ class SocketCE(CommEngine):
 #: control-plane tags jump the per-peer output queue ahead of bulk data
 #: frames (a termination token or GET request must not wait behind a
 #: multi-MB payload drain); a partially-written frame is never preempted
-_CTL_TAGS = frozenset((TAG_TERMDET, TAG_BARRIER, TAG_GET_REQ, TAG_UTRIG))
+_CTL_TAGS = frozenset((TAG_TERMDET, TAG_BARRIER, TAG_GET_REQ, TAG_UTRIG,
+                       TAG_CLOCK))
 
 #: receive state machine stages
 _ST_HS, _ST_HDR, _ST_BODY, _ST_BLEN, _ST_BUF = range(5)

@@ -69,6 +69,13 @@ params.register("comm_flush_window_ms", 0.0,
                 "same-destination activations of tasks completing "
                 "within the window pack into one framed batch "
                 "(0 = off: coalescing stays per-task)")
+params.register("comm_clock_sync", 1,
+                "estimate per-peer clock offset + drift via a TAG_CLOCK "
+                "ping exchange (re-probed periodically); recorded into "
+                "causal-trace headers for cross-rank merge alignment "
+                "(0 = off)")
+params.register("comm_clock_probe_s", 5.0,
+                "seconds between clock-offset probe rounds")
 
 _handle_seq = itertools.count(1)
 
@@ -88,6 +95,17 @@ params.register("comm_handle_timeout", 600.0,
                 "must not strand the payload forever; a GET after the "
                 "purge fails the RECEIVER with a clear miss, not the "
                 "serving rank)")
+
+
+def _msg_nbytes(msg: dict) -> int:
+    """Best-effort payload byte count of an app message (trace events)."""
+    d = msg.get("data")
+    if isinstance(d, tuple) and len(d) >= 2 and hasattr(d[1], "nbytes"):
+        return int(d[1].nbytes)
+    b = msg.get("buf")
+    if hasattr(b, "nbytes"):
+        return int(b.nbytes)
+    return 0
 
 
 class _Handle:
@@ -136,6 +154,9 @@ class RemoteDepEngine:
         self._dyn_holds: List = []
         self._dyn_released = threading.Event()
         ce.on_error = self._on_handler_error
+        #: causal tracer (prof/causal.py), attached by its install();
+        #: None = zero tracing work on every send/recv path
+        self.tracer = None
         #: protocol counters (exported through stats() -> bench bw/rtt)
         self.proto: Dict[str, int] = {
             "act_eager": 0, "act_rdv": 0, "act_inline": 0,
@@ -202,9 +223,20 @@ class RemoteDepEngine:
         }
         #: cross-task flush window, cached at init (run-scoped knob)
         self._flush_window = float(params.get("comm_flush_window_ms", 0.0))
+        #: clock alignment: probe every peer's offset at attach and
+        #: periodically after (drift), through the transport's own
+        #: progress machinery (the event loop / the progress thread)
+        self._clock_on = bool(int(params.get("comm_clock_sync", 1))) \
+            and self.nranks > 1
+        self._clock_period = max(0.5,
+                                 float(params.get("comm_clock_probe_s",
+                                                  5.0)))
         if self.funnelled:
             self._progress = None
             ce.add_periodic(self._purge_stale_handles, 5.0)
+            if self._clock_on:
+                ce.add_periodic(ce.probe_clocks, self._clock_period)
+                ce.post(ce.probe_clocks)   # first round at attach
             if self._flush_window > 0:
                 ce.add_periodic(self._drain_flush_window,
                                 max(self._flush_window * 5e-4, 0.001))
@@ -291,10 +323,20 @@ class RemoteDepEngine:
 
     def _progress_loop(self) -> None:
         next_purge = time.monotonic() + 5.0
+        # first clock round shortly after attach (peers are dialing in);
+        # then every probe period for drift
+        next_clock = time.monotonic() + 0.2 if self._clock_on \
+            else float("inf")
         while not self._stop:
             if time.monotonic() > next_purge:
                 self._purge_stale_handles()
                 next_purge = time.monotonic() + 5.0
+            if time.monotonic() > next_clock:
+                try:
+                    self.ce.probe_clocks()
+                except OSError:
+                    pass
+                next_clock = time.monotonic() + self._clock_period
             self._drain_flush_window()
             try:
                 cmd = self._cmdq.get(timeout=0.05)
@@ -390,6 +432,11 @@ class RemoteDepEngine:
                 "deliveries": {r: targets[r] for r in ranks},
                 "ranks": ranks,
             }
+            if self.tracer is not None:
+                # producer identity for the causal DAG: the same oid the
+                # task_profiler's exec interval carries (forwarders keep
+                # it, so tree hops still attribute to the producer)
+                msg["_oid"] = hash(task.key)
             children = self._children(msg, self.rank)
             if copy is not None:
                 payload = copy.payload
@@ -550,6 +597,8 @@ class RemoteDepEngine:
         with self._term_lock:
             self._color_black = True
             self._app_sent += 1
+        if self.tracer is not None:
+            payload = self._traced(tag, dst, payload)
         self._post_send(tag, dst, payload)
 
     def _send_batch(self, dst: int, items: List[Tuple[int, Any]]) -> None:
@@ -559,6 +608,11 @@ class RemoteDepEngine:
         with self._term_lock:
             self._color_black = True
             self._app_sent += len(items)
+        if self.tracer is not None:
+            # per inner message: each gets its own correlation id; the
+            # receiver's _batch_cb re-dispatches them individually, so
+            # every flow edge survives coalescing
+            items = [(tag, self._traced(tag, dst, p)) for tag, p in items]
         if len(items) == 1:
             self._post_send(items[0][0], dst, items[0][1])
             return
@@ -566,6 +620,35 @@ class RemoteDepEngine:
             self.proto["coalesced_batches"] += 1
             self.proto["coalesced_msgs"] += len(items)
         self._post_send(TAG_BATCH, dst, list(items))
+
+    # -- causal tracing (prof/causal.py): every traced app frame carries
+    # a send timestamp + (src_rank, event_seq) correlation id; matched
+    # comm_send/comm_recv events become the merged trace's flow edges --
+    def _traced(self, tag: int, dst: int, payload):
+        tr = self.tracer
+        if tr is None or not isinstance(payload, dict):
+            return payload
+        corr = tr.next_corr()
+        now = time.perf_counter()
+        # shallow copy: tree forwarding reuses one msg dict for several
+        # children — each SEND is its own flow edge with its own id
+        payload = dict(payload, _corr=corr, _sent_at=now)
+        tp = payload.get("tp")
+        root = payload.get("root")
+        tr.comm_send(tag, dst, corr, payload.get("_oid"),
+                     _msg_nbytes(payload), now,
+                     tpid=tp if isinstance(tp, int) else 0,
+                     src_rank=root if isinstance(root, int) else None)
+        return payload
+
+    def _trace_recv(self, tag: int, src: int, msg) -> None:
+        tr = self.tracer
+        if tr is None or not isinstance(msg, dict):
+            return
+        corr = msg.get("_corr")
+        if corr is not None:
+            tr.comm_recv(tag, src, corr, msg.get("_sent_at"),
+                         _msg_nbytes(msg))
 
     def _post_send(self, tag: int, dst: int, payload) -> None:
         if self.funnelled:
@@ -582,6 +665,7 @@ class RemoteDepEngine:
             self._app_recv += 1
 
     def _activate_cb(self, src: int, msg: dict) -> None:
+        self._trace_recv(TAG_ACTIVATE, src, msg)
         self._on_app_recv()   # exactly once per wire message
         self._try_activation(src, msg)
 
@@ -624,19 +708,23 @@ class RemoteDepEngine:
             msg["deliveries"].get(str(self.rank))
         if not deliveries:
             return
+        corr = msg.get("_corr")
         if data is None:
-            self._deliver(tp, deliveries, None)
+            self._deliver(tp, deliveries, None, corr=corr)
         elif data[0] == "eager":
             _, buf, dt, shape = data
-            self._deliver(tp, deliveries, _decode(buf, dt, shape))
+            self._deliver(tp, deliveries, _decode(buf, dt, shape),
+                          corr=corr)
         else:   # rendezvous: pull the payload from the root
             _, handle, dt, shape = data
             key = (msg["root"], handle)
-            self._pending_gets[key] = {"tp": tp, "deliveries": deliveries}
+            self._pending_gets[key] = {"tp": tp, "deliveries": deliveries,
+                                       "corr": corr}
             self._send_app(TAG_GET_REQ, msg["root"],
                            {"handle": handle, "from": self.rank})
 
     def _get_req_cb(self, src: int, msg: dict) -> None:
+        self._trace_recv(TAG_GET_REQ, src, msg)
         self._on_app_recv()
         h = msg["handle"]
         with self._hlock:
@@ -675,6 +763,7 @@ class RemoteDepEngine:
             self.dtd_refs_pending -= 1
 
     def _dtd_cb(self, src: int, msg: dict) -> None:
+        self._trace_recv(TAG_DTD, src, msg)
         # For rendezvous refs the pending-pull count must become visible
         # ATOMICALLY with the message credit: crediting first opens a
         # window where the Safra token sees an even balance and empty
@@ -706,6 +795,7 @@ class RemoteDepEngine:
             tp._dtd_incoming(src, msg)
 
     def _get_rep_cb(self, src: int, msg: dict) -> None:
+        self._trace_recv(TAG_GET_REP, src, msg)
         self._on_app_recv()
         key = (msg["root"], msg["handle"])
         pend = self._pending_gets.pop(key, None)
@@ -718,11 +808,16 @@ class RemoteDepEngine:
                 "(comm_handle_timeout)"), None)
             return
         arr = _decode(msg["buf"], msg["dtype"], msg["shape"])
-        self._deliver(pend["tp"], pend["deliveries"], arr)
+        self._deliver(pend["tp"], pend["deliveries"], arr,
+                      corr=pend.get("corr"))
 
-    def _deliver(self, tp, deliveries, array: Optional[np.ndarray]) -> None:
+    def _deliver(self, tp, deliveries, array: Optional[np.ndarray],
+                 corr=None) -> None:
         """Release the incoming deps locally (reference:
-        remote_dep_release_incoming, remote_dep.c:964)."""
+        remote_dep_release_incoming, remote_dep.c:964).  ``corr`` is
+        the activation frame's correlation id: each delivered successor
+        gets a dep_deliver trace event binding the cross-rank flow edge
+        to the consumer task."""
         from parsec_tpu.data.data import Coherency, Data
         ready = []
         copy = None
@@ -734,10 +829,17 @@ class RemoteDepEngine:
             copy = datum.create_copy(0, payload=array,
                                      coherency=Coherency.SHARED, version=1)
         from parsec_tpu.data.reshape import as_dtt, needs_reshape
+        tracer = self.tracer
         for tc_name, locs, dflow in deliveries:
             tc = tp.task_classes.get(tc_name)
             if tc is None:
                 raise RuntimeError(f"unknown task class {tc_name!r}")
+            if tracer is not None:
+                try:
+                    tracer.dep_deliver(corr, hash(tc.make_key(locs)),
+                                       tpid=tp.taskpool_id)
+                except Exception:
+                    pass   # un-keyable locals: skip the trace, not the dep
             dcopy = copy
             if copy is not None:
                 # receiver-side datatype resolution: the consumer's IN

@@ -78,6 +78,7 @@ class Context:
         self._pins = {}
         self.comm = None               # comm engine (distributed layer)
         self.grapher = None            # DOT grapher (prof layer)
+        self._causal_tracer = None     # prof/causal.py CausalTracer
 
         # device layer (reference: parsec_mca_device_init, parsec.c:823)
         from parsec_tpu.devices import init_devices

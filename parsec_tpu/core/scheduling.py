@@ -23,8 +23,17 @@ def schedule(es, tasks: List[Task], distance: int = 0) -> None:
     """Enter ready tasks into the scheduler (reference: __parsec_schedule)."""
     if not tasks:
         return
-    for t in tasks:
-        t.status = TaskStatus.READY
+    if es.context._causal_tracer is not None:
+        # one stamp for the batch: the tasks became ready at this same
+        # moment, and the causal tracer closes select - ready_at into a
+        # queue-wait span.  Gated so the untraced hot path stays free
+        now = time.perf_counter()
+        for t in tasks:
+            t.status = TaskStatus.READY
+            t.ready_at = now
+    else:
+        for t in tasks:
+            t.status = TaskStatus.READY
     es.context.scheduler.schedule(es, tasks, distance)
     es.context.ring_doorbell(len(tasks))
 

@@ -343,7 +343,8 @@ class Task:
 
     __slots__ = ("task_class", "taskpool", "locals", "key", "priority",
                  "status", "data", "input_sources", "pinned_flows",
-                 "chore_mask", "seq", "device", "prof", "dtd")
+                 "chore_mask", "seq", "device", "prof", "dtd",
+                 "ready_at")
 
     def __init__(self, task_class: TaskClass, taskpool, locals_: Dict[str, int]):
         self.task_class = task_class
@@ -370,6 +371,10 @@ class Task:
         self.device = None
         self.prof = None
         self.dtd = None     # DTD dep-bookkeeping state, if dynamically inserted
+        #: perf_counter stamp of the moment the task became READY
+        #: (schedule()); the causal tracer turns select - ready_at into
+        #: the task's queue-wait span.  None unless a tracer is installed
+        self.ready_at = None
 
     def __repr__(self):
         args = ",".join(f"{k}={v}" for k, v in self.locals.items())

@@ -821,6 +821,13 @@ class XlaDevice(Device):
                     copy.arena.release_unheld(copy)
             raise
         self.stats.executed_tasks += n
+        if self.es.context._causal_tracer is not None:
+            # device span opens at dispatch (the wave just entered the
+            # accelerator pipeline); the matching device_done fires when
+            # the outputs materialize (_finalize) — together the
+            # dispatch->done device segment of the causal trace
+            for task, _spec2, _load2 in batch:
+                self.es.pins("device_dispatch", task)
         with self._cond:
             # gate on the WHOLE wave fitting under the inflight depth:
             # appending n entries after a <depth check would let the
@@ -1419,6 +1426,10 @@ class XlaDevice(Device):
             self.stats.faults += 1
             inf.es.context.record_error(exc, inf.task)
         finally:
+            if inf.es.context._causal_tracer is not None:
+                # outputs are materialized (or the failure surfaced):
+                # close the dispatch->done device span
+                inf.es.pins("device_done", inf.task)
             self.load_sub(inf.load)
             for d in inf.pinned:
                 self._unpin(d)

@@ -45,6 +45,17 @@ from parsec_tpu.utils.mca import params
 from parsec_tpu.utils.output import warning
 
 
+def _chain_val(arr) -> Optional[float]:
+    """First element of a payload as a plain float — the 'chain value'
+    the dtd_lane trace events carry so an ordering race's stale read is
+    visible in the merged timeline."""
+    try:
+        a = np.asarray(arr)
+        return float(a.flat[0]) if a.size else None
+    except (TypeError, ValueError):
+        return None
+
+
 def _apply_payload(datum: Data, arr: np.ndarray,
                    slices: Optional[tuple] = None) -> None:
     """Land a network payload as the datum's new authoritative host
@@ -370,6 +381,8 @@ class DTDTaskpool(Taskpool):
         rank A flushed home after rank B's later lane write (the lane's
         own flush, or the home rank's local value, carries the newer
         bytes)."""
+        self._trace_lane("flush_apply", tile.wire_key, lane, ver,
+                         arr=arr)
         if lane is not None:
             l = tile.lanes.get(lane) if tile.lanes else None
             if l is not None and l.version > ver:
@@ -395,6 +408,22 @@ class DTDTaskpool(Taskpool):
         if errs:
             exc, task = errs[0]
             raise RuntimeError(f"task {task} failed") from exc
+
+    def _trace_lane(self, op: str, wire, lane, ver: int,
+                    arr=None) -> None:
+        """Lane/surrogate observability (the causal tracer's dtd_lane
+        events): every dep-tracking transition and payload application
+        lands in the trace with its lane id and chain value (the
+        payload's first element, extracted from ``arr`` only once the
+        tracer gate passed — untraced runs pay a single None check), so
+        a region-ordering race shows up as an out-of-order apply in ONE
+        merged timeline instead of needing rerun roulette."""
+        ctx = self.context
+        tr = getattr(ctx, "_causal_tracer", None) if ctx is not None \
+            else None
+        if tr is not None:
+            tr.dtd_event(op, wire, lane, ver,
+                         _chain_val(arr) if arr is not None else None)
 
     # -- tiles -------------------------------------------------------------
     def tile_of(self, dc: DataCollection, *indices) -> DTDTile:
@@ -801,6 +830,8 @@ class DTDTaskpool(Taskpool):
                 d.done = True        # no pending obligations: pass-through
             tile.last_writer = d
             tile.readers = []
+            self._trace_lane("surrogate", tile.wire_key, None,
+                             tile.version)
             return
         if tile.lanes is None:
             tile.lanes = {None: _Lane(tile.last_writer,
@@ -822,6 +853,7 @@ class DTDTaskpool(Taskpool):
             lanes[rid].version = tile.version
         tile.last_writer = d
         tile.readers = []
+        self._trace_lane("surrogate", tile.wire_key, rid, tile.version)
 
     @staticmethod
     def _edge(pred: "_DTDState", succ: "_DTDState") -> None:
@@ -845,6 +877,7 @@ class DTDTaskpool(Taskpool):
             return
         d.done = False               # revive a pass-through completion
         d.needed = True
+        self._trace_lane("need", d.tile.wire_key, d.region, d.version)
         task = Task(self._recv_class(), self, {"tid": next(_seq)})
         task.dtd = d
         d.task = task
@@ -871,6 +904,8 @@ class DTDTaskpool(Taskpool):
         newer lane whose newest writer is a LOCAL task is ordered after
         this recv (it conflicts transitively), so its extent still wants
         this payload's bytes and is NOT preserved."""
+        self._trace_lane("apply", tile.wire_key, lane, ver,
+                         arr=arr)
         if lane is not None:
             sl = self._region_slices.get(lane)
             if sl is not None:
@@ -940,6 +975,8 @@ class DTDTaskpool(Taskpool):
             if sl is not None:
                 arr = np.ascontiguousarray(arr[tuple(sl)])
             base["lane"] = lane
+        self._trace_lane("encode", tile.wire_key, lane, ver,
+                         arr=arr)
         eager = int(params.get("comm_eager_limit", 65536))
         comm = self.context.comm if self.context is not None else None
         if comm is not None and arr.nbytes > eager:
@@ -981,6 +1018,8 @@ class DTDTaskpool(Taskpool):
 
     def _dtd_payload(self, msg: dict, arr: np.ndarray) -> None:
         wire = tuple(msg["tile"])
+        self._trace_lane("payload", wire, msg.get("lane"), msg["ver"],
+                         arr=arr)
         if msg["kind"] == "data":
             key = (wire, msg["ver"])
             to_schedule: List[Task] = []
@@ -1052,6 +1091,7 @@ class DTDTaskpool(Taskpool):
                     self._mark_needed(lw, to_schedule)
                 self._edge(lw, state)              # RAW
             tile.readers.append(state)
+            self._trace_lane("read", tile.wire_key, None, tile.version)
         else:  # OUTPUT / INOUT: this task becomes the tile's writer
             for r in tile.readers:                 # WAR
                 self._edge(r, state)
@@ -1073,6 +1113,7 @@ class DTDTaskpool(Taskpool):
             state.version = tile.version
             tile.last_writer = state
             tile.readers = []
+            self._trace_lane("write", tile.wire_key, None, tile.version)
 
     def _warn_extentless_overlap(self, tile: DTDTile, rid: Any,
                                  writer_is_recv: bool) -> None:
@@ -1142,6 +1183,7 @@ class DTDTaskpool(Taskpool):
                     self._edge(lw, state)                      # RAW
             (mine if mine is not None else lanes[None]).readers.append(
                 state)
+            self._trace_lane("read", tile.wire_key, rid, tile.version)
         else:
             self._warn_extentless_overlap(tile, rid, writer_is_recv=False)
             for _lrid, lane in conflict:
@@ -1166,6 +1208,7 @@ class DTDTaskpool(Taskpool):
             # keep the legacy fields coherent for flush/debug paths
             tile.last_writer = state
             tile.readers = []
+            self._trace_lane("write", tile.wire_key, rid, tile.version)
 
     # -- dynamic release (called from engine.release_deps) ----------------
     def dynamic_release(self, es, task: Task) -> List[Task]:

@@ -11,3 +11,5 @@ from parsec_tpu.prof.pins import TaskProfilerPins, install_task_profiler  # noqa
 from parsec_tpu.prof.grapher import DotGrapher  # noqa: F401
 from parsec_tpu.prof.gauges import Gauges, install_gauges  # noqa: F401
 from parsec_tpu.prof.reader import read_trace  # noqa: F401
+from parsec_tpu.prof.causal import (CausalTracer,  # noqa: F401
+                                    install_causal_tracer)

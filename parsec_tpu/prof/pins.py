@@ -19,8 +19,12 @@ from parsec_tpu.prof.profiling import EV_END, EV_POINT, EV_START, Profile
 #: ``task_discard`` fires for tasks dropped by pool cancellation; the
 #: ``job_*`` events are emitted by the job service (service/service.py)
 #: with the Job as payload.
+#: ``device_dispatch``/``device_done`` bracket a device task's
+#: accelerator-pipeline residency (devices/xla.py, gated on the causal
+#: tracer being installed).
 PINS_EVENTS = ("select", "exec_begin", "exec_end", "exec_async",
                "complete_exec", "task_discard",
+               "device_dispatch", "device_done",
                "job_submit", "job_start", "job_done")
 
 

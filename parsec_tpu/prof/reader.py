@@ -7,6 +7,12 @@ begin/end rows).  ``read_trace`` returns (meta, events_df) where the
 DataFrame has columns: stream, key, name, flags, taskpool_id, event_id,
 object_id, ts, info; ``intervals`` pairs START/END rows into one row per
 executed task with a duration.
+
+The reader is FORWARD-TOLERANT: event classes it has never seen (new
+tracer modules add them every round), dictionary entries carrying extra
+fields, and point events interleaved with intervals all pass through —
+an analysis tool built on an older dictionary must degrade to "unknown
+class", not crash (the r6 causal tracer was the forcing case).
 """
 
 from __future__ import annotations
@@ -29,7 +35,12 @@ def read_trace(path: str):
     off += 8
     meta = pickle.loads(raw[off:off + mlen])
     off += mlen
-    key_names = {k: name for k, name, _attrs in meta["dictionary"]}
+    # dictionary entries are (key, name, attrs) today; tolerate future
+    # fields riding along (and, defensively, attr-less pairs)
+    key_names = {}
+    for entry in meta.get("dictionary", ()):
+        if len(entry) >= 2:
+            key_names[entry[0]] = entry[1]
     rows = []
     for stream_id, name, nev in meta["streams"]:
         events = []
@@ -51,12 +62,20 @@ def read_trace(path: str):
 
 
 def intervals(events_df):
-    """Pair START/END events into one row per interval with duration."""
+    """Pair START/END events into one row per interval with duration.
+
+    Pairing is by event id — and by ``rank`` too when the frame carries
+    one (merged multi-rank traces: each rank's profile numbers its
+    events independently, so cross-rank id collisions must not pair)."""
     import pandas as pd
+    keys = ["event_id"]
+    if "rank" in events_df.columns:
+        keys = ["rank", "event_id"]
     starts = events_df[(events_df["flags"] & EV_START) != 0]
     ends = events_df[(events_df["flags"] & EV_END) != 0]
     merged = starts.merge(
-        ends[["event_id", "ts"]], on="event_id",
+        ends[keys + ["ts"]], on=keys,
         suffixes=("_begin", "_end"))
     merged["duration"] = merged["ts_end"] - merged["ts_begin"]
     return merged
+

@@ -10,12 +10,24 @@
 # OS scheduling timing, not the code under test) — before a change
 # merges.  Documented in BENCH.md ("Pre-merge guard").
 #
-# Usage:  sh tools/premerge_bench.sh [threshold]
-#         threshold: relative regression that fails (default 0.15)
+# r7 adds the TRACER-OVERHEAD gate: the tasks probe runs a second time
+# with the full tracing stack installed (PARSEC_BENCH_TRACE=1: binary
+# task profiler + causal tracer's queue-wait spans and dep edges), and
+# the slowdown versus the default untraced run must stay under
+# $trace_bound (default 50%; measured ~30% on the 1-core CI container,
+# documented in BENCH.md).  The tracing-OFF
+# cost staying ~0 is covered by the default tasks probe itself: its
+# task_throughput gates against the last driver artifact above.
+#
+# Usage:  sh tools/premerge_bench.sh [threshold] [trace_bound]
+#         threshold:   relative regression that fails (default 0.15)
+#         trace_bound: max tracing-on slowdown of tasks/s (default 0.50)
 set -e
 repo="$(cd "$(dirname "$0")/.." && pwd)"
 threshold="${1:-0.15}"
+trace_bound="${2:-0.50}"
 rc=0
+tasks_off=""
 for mode in tasks rtt bw; do
     echo "== premerge probe: $mode =="
     out="/tmp/premerge_${mode}_$$.json"
@@ -29,6 +41,38 @@ for mode in tasks rtt bw; do
          --threshold "$threshold"; then
         rc=1
     fi
-    rm -f "$out"
+    if [ "$mode" = tasks ]; then
+        tasks_off="$out"     # kept for the tracer-overhead comparison
+    else
+        rm -f "$out"
+    fi
 done
+echo "== premerge probe: tracer overhead (tasks, tracing on) =="
+on="/tmp/premerge_tasks_on_$$.json"
+if [ -n "$tasks_off" ] && JAX_PLATFORMS=cpu PARSEC_BENCH_APP=tasks \
+     PARSEC_BENCH_TRACE=1 python "$repo/bench.py" > "$on" 2>/dev/null; then
+    if ! python - "$tasks_off" "$on" "$trace_bound" <<'EOF'
+import json, sys
+def last_json(path):
+    for line in reversed(open(path).read().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"premerge: no JSON in {path}")
+off = last_json(sys.argv[1])["value"]
+on = last_json(sys.argv[2])["value"]
+bound = float(sys.argv[3])
+overhead = off / on - 1 if on else float("inf")
+print(f"premerge: tracer overhead {overhead:+.1%} "
+      f"(bound {bound:.0%}; off {off:.0f} -> on {on:.0f} tasks/s)")
+sys.exit(1 if overhead > bound else 0)
+EOF
+    then
+        rc=1
+    fi
+else
+    echo "premerge: traced tasks probe FAILED to run"
+    rc=1
+fi
+rm -f "$tasks_off" "$on"
 exit $rc

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Convert a binary trace (.ptt) to Chrome trace-event JSON.
+"""Convert binary traces (.ptt) to Chrome trace-event JSON.
 
 The interoperable-trace-format role of the reference's OTF2 backend
 (reference: parsec/profiling_otf2.c), targeted at the tooling that is
@@ -7,6 +7,13 @@ native on TPU stacks: chrome://tracing and Perfetto open the output
 directly.  Usage:
 
     python tools/trace2chrome.py run.ptt -o run.json
+    python tools/trace2chrome.py --merge rank0.ptt rank1.ptt -o run.json
+
+``--merge`` takes one trace per rank, aligns their clocks with the
+TAG_CLOCK offsets recorded in each header, and emits ONE timeline
+(pid = rank, tid = stream) with Perfetto flow arrows linking every
+matched cross-rank activation's send event to its recv event, plus the
+critical-path attribution summary in ``otherData``.
 """
 
 from __future__ import annotations
@@ -19,34 +26,105 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _interval_events(iv, t0, pid_of):
+    events = []
+    for row in iv.itertuples():
+        events.append({
+            "name": row.name,
+            "cat": "task",
+            "ph": "X",                      # complete event
+            "ts": (float(row.ts_begin) - t0) * 1e6,
+            "dur": float(row.duration) * 1e6,
+            "pid": pid_of(row),
+            "tid": int(row.stream),
+            "args": {"event_id": int(row.event_id),
+                     "info": repr(row.info) if row.info is not None
+                     else ""},
+        })
+    return events
+
+
+def _flow_events(df, t0):
+    """Matched comm_send/comm_recv pairs -> anchor slices + s/f flow
+    arrows (Perfetto binds an arrow to the slice enclosing each end)."""
+    sends, recvs = {}, {}
+    for row in df[df["name"] == "comm_send"].itertuples():
+        if row.info and row.info.get("corr") is not None:
+            sends[tuple(row.info["corr"])] = row
+    for row in df[df["name"] == "comm_recv"].itertuples():
+        if row.info and row.info.get("corr") is not None:
+            recvs[tuple(row.info["corr"])] = row
+    events = []
+    arrows = 0
+    for corr in sorted(set(sends) & set(recvs)):
+        s, r = sends[corr], recvs[corr]
+        fid = f"{corr[0]}:{corr[1]}"
+        s_ts = (float(s.ts) - t0) * 1e6
+        r_ts = (float(r.ts) - t0) * 1e6
+        for row, ts, nm in ((s, s_ts, "comm_send"), (r, r_ts, "comm_recv")):
+            events.append({
+                "name": nm, "cat": "comm", "ph": "X",
+                "ts": ts, "dur": 1,
+                "pid": int(row.rank), "tid": int(row.stream),
+                "args": {"corr": fid,
+                         "tag": (row.info or {}).get("tag"),
+                         "nbytes": (row.info or {}).get("nbytes")},
+            })
+        events.append({"name": "activation", "cat": "comm", "ph": "s",
+                       "id": fid, "pid": int(s.rank),
+                       "tid": int(s.stream), "ts": s_ts})
+        events.append({"name": "activation", "cat": "comm", "ph": "f",
+                       "bp": "e", "id": fid, "pid": int(r.rank),
+                       "tid": int(r.stream), "ts": max(r_ts, s_ts + 1)})
+        arrows += 1
+    return events, arrows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("trace", help=".ptt trace file")
+    ap.add_argument("traces", nargs="+", help=".ptt trace file(s)")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge per-rank traces into one clock-aligned "
+                         "timeline with cross-rank flow arrows")
     ap.add_argument("-o", "--out", default=None,
                     help="output JSON (default: <trace>.json)")
     args = ap.parse_args(argv)
-    out = args.out or (os.path.splitext(args.trace)[0] + ".json")
+    if len(args.traces) > 1 and not args.merge:
+        ap.error("several traces need --merge")
+    out = args.out or (os.path.splitext(args.traces[0])[0] + ".json")
 
     from parsec_tpu.prof.reader import intervals, read_trace
-    meta, df = read_trace(args.trace)
-    iv = intervals(df) if len(df) else df
 
+    if args.merge:
+        from parsec_tpu.prof import critpath
+        df, metas = critpath.merge_traces(args.traces)
+        iv = intervals(df) if len(df) else df
+        t0 = float(df["ts"].min()) if len(df) else 0.0
+        events = _interval_events(iv, t0, lambda r: int(r.rank)) \
+            if len(iv) else []
+        flow, arrows = _flow_events(df, t0)
+        events.extend(flow)
+        other = {"ranks": sorted(metas), "flow_arrows": arrows}
+        try:
+            tasks, preds, ready = critpath.build_dag(df)
+            path = critpath.critical_path(tasks, preds)
+            other["attribution"] = critpath.attribute(path, tasks, ready)
+        except Exception as exc:     # the timeline must still export
+            other["attribution_error"] = str(exc)[:200]
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": other}
+        with open(out, "w") as f:
+            json.dump(doc, f)
+        print(f"{out}: {len(events)} events, {arrows} flow arrows")
+        return 0
+
+    meta, df = read_trace(args.traces[0])
+    iv = intervals(df) if len(df) else df
     events = []
     if len(iv):
         t0 = float(iv["ts_begin"].min())
-        for row in iv.itertuples():
-            events.append({
-                "name": row.name,
-                "cat": "task",
-                "ph": "X",                      # complete event
-                "ts": (float(row.ts_begin) - t0) * 1e6,
-                "dur": float(row.duration) * 1e6,
-                "pid": int(row.taskpool_id),
-                "tid": int(row.stream),
-                "args": {"event_id": int(row.event_id),
-                         "info": repr(row.info) if row.info is not None
-                         else ""},
-            })
+        events = _interval_events(iv, t0,
+                                  lambda r: int(r.taskpool_id))
     doc = {
         "traceEvents": events,
         "displayTimeUnit": "ms",

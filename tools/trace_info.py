@@ -17,6 +17,55 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _causal_stats(meta, df, iv) -> None:
+    """The r7 --stats columns: per-class queue-wait (the causal
+    tracer's ready->select spans joined to exec intervals by object id)
+    and per-source comm delay (comm_recv arrival minus the sender's
+    embedded clock stamp, corrected by this rank's measured offset to
+    that peer when the header carries one)."""
+    import json as _json
+    qw = iv[iv["name"] == "queue_wait"]
+    ex = iv[(iv["name"] != "queue_wait")
+            & ~iv["name"].str.startswith("dev:")]
+    if len(qw) and len(ex):
+        # task identity is (taskpool, key hash): a warmup pool reruns
+        # the same task keys, and an object_id-only join would pair its
+        # spans with the main pool's
+        j = ex[["name", "taskpool_id", "object_id"]].merge(
+            qw[["taskpool_id", "object_id", "duration"]],
+            on=["taskpool_id", "object_id"])
+        if len(j):
+            print("per-class queue-wait (seconds, ready -> selected):")
+            print(j.groupby("name")["duration"]
+                  .agg(["count", "mean", "max"])
+                  .to_string(float_format=lambda v: f"{v:.6f}"))
+    rx = df[df["name"] == "comm_recv"]
+    if len(rx):
+        try:
+            offsets = {int(r): float(o) for r, o in _json.loads(
+                meta.get("info", {}).get("clock_offsets", "{}")).items()}
+        except (TypeError, ValueError):
+            offsets = {}
+        rows = {}
+        for row in rx.itertuples():
+            info = row.info or {}
+            sent, src = info.get("sent_at"), info.get("src")
+            if sent is None or src is None:
+                continue
+            # sent_at is on the SENDER's clock; offset = clock_src -
+            # clock_mine, so the local-clock send time is sent - offset
+            delay = row.ts - (sent - offsets.get(src, 0.0))
+            rows.setdefault(src, []).append(delay)
+        if rows:
+            print("comm delay by source rank (seconds, send -> recv"
+                  + ("" if offsets else "; UNCORRECTED clocks") + "):")
+            for src in sorted(rows):
+                d = rows[src]
+                print(f"  from rank {src}: n={len(d)} "
+                      f"mean={sum(d) / len(d):.6f} "
+                      f"max={max(d):.6f}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("trace", help=".ptt trace file")
@@ -37,8 +86,12 @@ def main(argv=None) -> int:
     for k, v in sorted(meta.get("info", {}).items()):
         print(f"info : {k} = {v}")
     print(f"dictionary ({len(meta['dictionary'])} classes):")
-    for key, name, attrs in meta["dictionary"]:
-        print(f"  [{key:3d}] {name}{'  ' + attrs if attrs else ''}")
+    for entry in meta["dictionary"]:
+        # tolerate entries with extra (future) fields beyond
+        # (key, name, attrs)
+        key, name = entry[0], entry[1]
+        attrs = entry[2] if len(entry) > 2 else ""
+        print(f"  [{key:3d}] {name}{'  ' + str(attrs) if attrs else ''}")
     print(f"streams ({len(meta['streams'])}):")
     for sid, name, nev in meta["streams"]:
         print(f"  [{sid:3d}] {name or '<unnamed>'}: {nev} events")
@@ -53,6 +106,7 @@ def main(argv=None) -> int:
             print("per-class interval stats (seconds):")
             print(g.agg(["count", "sum", "mean", "min", "max"])
                   .to_string(float_format=lambda v: f"{v:.6f}"))
+            _causal_stats(meta, df, iv)
     if args.gaps and len(df):
         iv = intervals(df)
         if len(iv):
