@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import select
 import selectors
 import socket
 import struct
@@ -30,6 +31,8 @@ from collections import deque
 from itertools import islice
 from typing import Any, Callable, Dict, List, Optional
 
+from parsec_tpu.core.errors import PeerFailedError
+from parsec_tpu.utils import faultinject
 from parsec_tpu.utils.debug_history import mark
 from parsec_tpu.utils.mca import params
 from parsec_tpu.utils.output import debug_verbose, warning
@@ -56,7 +59,21 @@ TAG_PUT = 9       # one-sided put into a registered region
 TAG_GET1 = 10     # one-sided get request
 TAG_GET1_REP = 11
 TAG_CLOCK = 12    # clock-offset ping/pong (causal-trace alignment)
+TAG_HB = 13       # heartbeat (active failure detection of HUNG peers)
 TAG_USER = 16     # first tag available to applications
+
+# the fault injector names tags without importing this module (it is
+# below us in the layering); a drift between the two maps would
+# silently mistarget every tag-matched fault directive.  An explicit
+# raise, not assert: python -O would compile the guard away
+for _name, _tag in (("ACT", TAG_ACTIVATE), ("DTD", TAG_DTD),
+                    ("GET_REP", TAG_GET_REP), ("HB", TAG_HB)):
+    if faultinject.TAG_NAMES[_name] != _tag:
+        raise RuntimeError(
+            f"faultinject.TAG_NAMES[{_name!r}] drifted from "
+            "comm/engine.py's wire tags — every tag-matched fault "
+            "directive would silently mistarget")
+del _name, _tag
 
 #: frame header: (tag, pickle length, out-of-band buffer count).  Large
 #: array payloads ride OUT OF BAND (pickle protocol 5): the pickle holds
@@ -97,6 +114,14 @@ params.register("comm_clock_samples", 4,
                 "ping samples per clock-offset probe round; the "
                 "minimum-RTT sample's midpoint estimate wins (error "
                 "bounded by that sample's rtt/2 under asymmetric delay)")
+
+params.register("comm_peer_timeout_s", 15.0,
+                "declare a peer dead after this many seconds of total "
+                "wire silence (heartbeats ride the control lane at "
+                "timeout/3, piggybacking on the TAG_CLOCK probe "
+                "machinery, so a HUNG peer — open socket, nothing "
+                "flowing — is detected, not just a closed one; "
+                "0 disables active detection)")
 
 params.register("comm_transport", "evloop",
                 "socket transport module: 'evloop' (single-threaded "
@@ -290,9 +315,29 @@ class CommEngine:
         #: set by the remote-dep layer: fatal handler errors fail the rank
         #: fast instead of silently dropping the message
         self.on_error: Optional[Callable[[Exception], None]] = None
+        #: set by the remote-dep layer: peer-death containment — routes a
+        #: PeerFailedError to the taskpools that touch the dead rank
+        #: instead of poisoning the whole context; falls back to on_error
+        self.on_peer_dead: Optional[Callable[[int, Exception], None]] = None
         #: ranks whose connection died mid-run (failure detection);
         #: barrier and quiescence waiters observe this and fail fast
         self.dead_peers: set = set()
+        #: failure detection: monotonic stamp of the last frame each peer
+        #: delivered (ANY tag counts as liveness; TAG_HB only guarantees
+        #: a floor of traffic on an otherwise-quiet control lane)
+        self._last_heard: Dict[int, float] = {}
+        self._hb_check_at = time.monotonic()
+        #: fault injection (utils/faultinject.py): None compiles every
+        #: per-frame hook to a single attribute check
+        self._fault = faultinject.comm_faults(rank) \
+            if faultinject.ARMED else None
+        #: Safra reconcile hook: the remote-dep layer adjusts its message
+        #: balance when the injector drops/duplicates an app frame
+        self.on_frame_fault: Optional[Callable[[str, int, Any], None]] = None
+        #: kill_rank mode=hang: a muted engine neither sends nor
+        #: processes frames (sockets stay open — the silent-hang fault)
+        self._muted = False
+        self.tag_register(TAG_HB, self._hb_cb)
 
     def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
         """cb(src_rank, payload) runs on the comm receive thread."""
@@ -478,6 +523,179 @@ class CommEngine:
         """Snapshot of the per-peer alignment state (trace headers)."""
         with self._clock_lock:
             return {r: dict(st) for r, st in self.clock.items()}
+
+    # -- active failure detection: heartbeats + silence timeout ---------
+    def _hb_cb(self, src: int, payload: Any) -> None:
+        pass   # receipt alone is the signal (_note_heard at the framer)
+
+    def _note_heard(self, src: Optional[int]) -> None:
+        if src is not None:
+            self._last_heard[src] = time.monotonic()
+
+    def heartbeat_tick(self) -> None:
+        """One heartbeat round at every live peer; rides the control
+        lane so it measures protocol liveness, not bulk-queue depth.
+        Driven by the remote-dep progress machinery on the TAG_CLOCK
+        probe cadence (capped at comm_peer_timeout_s / 3)."""
+        if self.nranks == 1 or self._muted:
+            return
+        for r in range(self.nranks):
+            if r == self.rank or r in self.dead_peers:
+                continue
+            try:
+                self._hb_send(r)
+            except OSError:
+                pass
+
+    def _hb_send(self, r: int) -> None:
+        """One heartbeat frame to ``r``.  Transports whose send path can
+        BLOCK must override with a nonblocking discipline: the caller is
+        the single progress thread that also runs check_peer_timeouts,
+        and a detector wedged behind a hung peer's full send buffer (or
+        a not-yet-dialed-in rank's 30s connect wait) cannot detect the
+        very hang it exists to catch."""
+        self.send_am(TAG_HB, r, None)
+
+    def check_peer_timeouts(self) -> None:
+        """Declare peers silent past ``comm_peer_timeout_s`` dead — the
+        detector for HUNG peers, whose sockets never close.  A starved
+        checker (GIL/compile storm froze US, not them) rebases instead
+        of declaring: our own silence proves nothing about theirs."""
+        timeout = float(params.get("comm_peer_timeout_s", 15.0))
+        if timeout <= 0 or self.nranks == 1 or self._muted:
+            return
+        now = time.monotonic()
+        starved = now - self._hb_check_at > timeout
+        self._hb_check_at = now
+        if starved:
+            for r in list(self._last_heard):
+                self._last_heard[r] = now
+            return
+        for r, at in list(self._last_heard.items()):
+            if r in self.dead_peers:
+                continue
+            if now - at > timeout:
+                self.declare_peer_dead(r, PeerFailedError(
+                    r, f"rank {self.rank}: no frames from rank {r} for "
+                       f"{now - at:.1f}s (comm_peer_timeout_s="
+                       f"{timeout:g})", detector="heartbeat"))
+
+    def declare_peer_dead(self, r: int, exc: Exception) -> None:
+        """Shared death path (EOF, corruption, heartbeat silence): mark,
+        drop the transport state, wake barrier waiters, and route the
+        failure through containment."""
+        if r in self.dead_peers or self._stop_requested():
+            return
+        warning("rank %d: declaring rank %d dead: %s", self.rank, r, exc)
+        self.dead_peers.add(r)
+        self._drop_peer(r)
+        with self._bar_cond:
+            self._bar_cond.notify_all()
+        self._peer_failure(r, exc)
+
+    def _stop_requested(self) -> bool:
+        return bool(getattr(self, "_stop", False))
+
+    def _drop_peer(self, r: int) -> None:
+        pass   # transports close the peer's socket / clear its queues
+
+    def _peer_failure(self, r: int, exc: Exception) -> None:
+        cb = self.on_peer_dead
+        if cb is not None:
+            try:
+                cb(r, exc)
+                return
+            except Exception as route_exc:   # containment must not mask
+                warning("rank %d: peer-death containment failed: %s",
+                        self.rank, route_exc)
+        if self.on_error is not None:
+            self.on_error(exc)
+
+    def peer_debug(self) -> Dict[int, Dict[str, Any]]:
+        """Per-peer liveness/queue snapshot for the hang autopsy."""
+        now = time.monotonic()
+        out: Dict[int, Dict[str, Any]] = {}
+        for r, at in list(self._last_heard.items()):   # recv threads insert
+            out[r] = {"last_heard_age_s": round(now - at, 3),
+                      "dead": r in self.dead_peers}
+        for r in list(self.dead_peers):
+            out.setdefault(r, {"dead": True})
+        return out
+
+    # -- fault injection (utils/faultinject.py hook points) -------------
+    def _arm_kill(self) -> None:
+        """Schedule this rank's kill_rank directive, if any."""
+        if self._fault is None or self._fault.kill is None:
+            return
+        k = self._fault.kill
+        t = threading.Timer(max(0.0, k.at_s), self.fault_kill,
+                            args=(k.mode,))
+        t.daemon = True
+        t.start()
+
+    def fault_kill(self, mode: str = "close") -> None:
+        """Injected rank death.  ``close`` hard-closes every socket (the
+        EOF detector path); ``hang`` goes silent with sockets open (only
+        the heartbeat timeout can see it)."""
+        warning("rank %d: FAULT INJECTION kill_rank fired (mode=%s)",
+                self.rank, mode)
+        if mode == "hang":
+            self._muted = True
+            return
+        self._kill_close()
+
+    def _kill_close(self) -> None:
+        raise NotImplementedError
+
+    def _fault_frame(self, tag: int, dst: int, payload: Any) -> bool:
+        """Apply a matching frame directive to an outbound frame;
+        returns True when the frame was consumed (drop/delay/trunc) —
+        dup sends the extra copy and falls through to the normal send."""
+        act = self._fault.frame_action(tag, dst, payload)
+        if act is None:
+            return False
+        kind, ms = act
+        debug_verbose(3, "rank %d: FAULT %s_frame tag=%d dst=%d",
+                      self.rank, kind, tag, dst)
+        if kind == "drop":
+            if self.on_frame_fault is not None:
+                self.on_frame_fault("drop", tag, payload)
+            return True
+        if kind == "delay":
+            def _delayed_send():
+                try:
+                    self.send_am(tag, dst, payload, _nofault=True)
+                except OSError:
+                    # the lane died while the frame was held: reconcile
+                    # like a drop, or the Safra balance leaks the held
+                    # frame's count forever
+                    if self.on_frame_fault is not None:
+                        self.on_frame_fault("drop", tag, payload)
+            t = threading.Timer(ms * 1e-3, _delayed_send)
+            t.daemon = True
+            t.start()
+            return True
+        if kind == "dup":
+            if self.on_frame_fault is not None:
+                self.on_frame_fault("dup", tag, payload)
+            self.send_am(tag, dst, payload, _nofault=True)
+            return False
+        if kind == "trunc":
+            # an undecodable frame: the receiver severs the connection
+            # (the wire-corruption detector); the frame's message never
+            # arrives, so reconcile the balance like a drop
+            if self.on_frame_fault is not None:
+                self.on_frame_fault("drop", tag, payload)
+            try:
+                self._send_raw_parts(
+                    dst, [_LEN.pack(tag, 8, 0), b"\xde\xad\xbe\xef" * 2])
+            except OSError:
+                pass
+            return True
+        return False
+
+    def _send_raw_parts(self, dst: int, parts: List[Any]) -> None:
+        raise NotImplementedError
 
     # -- pack/unpack (reference: ce.pack/unpack) ------------------------
     @staticmethod
@@ -694,6 +912,7 @@ class SocketCE(CommEngine):
         # connect simultaneously and close each other's canonical socket.
         for dst in range(rank):
             self._connect(dst)
+        self._arm_kill()
 
     # -- connection management -------------------------------------------
     def _accept_loop(self) -> None:
@@ -704,6 +923,7 @@ class SocketCE(CommEngine):
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             _bump_sockbufs(conn)
+            self._bound_send(conn)
             # peer announces magic + protocol version + rank first: a
             # stranger or cross-version peer fails ITS connection here
             hdr = self._recv_exact(conn, _HANDSHAKE.size)
@@ -719,6 +939,7 @@ class SocketCE(CommEngine):
             with self._plock:
                 self._peers.setdefault(src, conn)
                 self._send_locks.setdefault(src, threading.Lock())
+            self._note_heard(src)
             t = threading.Thread(target=self._recv_loop, args=(conn, src),
                                  name=f"ce-recv-{self.rank}<-{src}",
                                  daemon=True)
@@ -744,9 +965,11 @@ class SocketCE(CommEngine):
                 time.sleep(0.01)
         peer_host = self._hosts[dst] if self._hosts else "127.0.0.1"
         s = _dial_peer(peer_host, self.port_base + dst, self.rank)
+        self._bound_send(s)
         with self._plock:
             self._peers[dst] = s
             self._send_locks.setdefault(dst, threading.Lock())
+        self._note_heard(dst)
         t = threading.Thread(target=self._recv_loop, args=(s, dst),
                              name=f"ce-recv-{self.rank}<-{dst}", daemon=True)
         t.start()
@@ -754,7 +977,25 @@ class SocketCE(CommEngine):
         return s
 
     # -- framing -----------------------------------------------------------
-    def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytes]:
+    def _bound_send(self, s: socket.socket) -> None:
+        """Bound blocking sends with SO_SNDTIMEO (send-only; recv loops
+        keep blocking indefinitely by design): a hung peer that stopped
+        draining must not wedge the single progress thread — which also
+        runs check_peer_timeouts — inside sendmsg forever.  2x the
+        detection timeout: a lane that cannot drain one frame in that
+        long is dead for every practical purpose."""
+        pt = float(params.get("comm_peer_timeout_s", 15.0))
+        if pt <= 0:
+            return
+        t = 2.0 * pt
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                         struct.pack("ll", int(t), int((t % 1.0) * 1e6)))
+        except OSError:
+            pass
+
+    def _recv_exact(self, conn: socket.socket, n: int,
+                    src: Optional[int] = None) -> Optional[bytes]:
         buf = b""
         while len(buf) < n:
             try:
@@ -765,10 +1006,15 @@ class SocketCE(CommEngine):
                 return None
             self.stats.syscalls_recv += 1
             self.stats.bytes_recv += len(chunk)
+            # liveness per CHUNK, not per completed frame: a frame whose
+            # transmission outlasts comm_peer_timeout_s must not get its
+            # actively-sending peer declared dead mid-transfer
+            self._note_heard(src)
             buf += chunk
         return buf
 
-    def _recv_into(self, conn: socket.socket, n: int) -> Optional[bytearray]:
+    def _recv_into(self, conn: socket.socket, n: int,
+                   src: Optional[int] = None) -> Optional[bytearray]:
         """Receive ``n`` bytes straight into one buffer (no quadratic
         bytes-concatenation; the out-of-band payload path)."""
         buf = bytearray(n)
@@ -783,13 +1029,19 @@ class SocketCE(CommEngine):
                 return None
             self.stats.syscalls_recv += 1
             self.stats.bytes_recv += r
+            self._note_heard(src)   # per chunk (see _recv_exact)
             got += r
         return buf
 
     def _recv_loop(self, conn: socket.socket, src: int) -> None:
         max_ln = int(params.get("comm_max_frame_mb", 4096)) << 20
         while not self._stop:
-            hdr = self._recv_exact(conn, _LEN.size)
+            if self._muted:
+                # injected silent hang: stop consuming (data piles up in
+                # the kernel buffer; our socket stays open and mute)
+                time.sleep(0.05)
+                continue
+            hdr = self._recv_exact(conn, _LEN.size, src)
             if hdr is None:
                 self._peer_lost(src)
                 return
@@ -803,14 +1055,14 @@ class SocketCE(CommEngine):
                                    f"exceeds the {max_ln >> 20} MiB "
                                    f"bound (tag={tag})")
                 return
-            data = self._recv_exact(conn, ln) if ln else b""
+            data = self._recv_exact(conn, ln, src) if ln else b""
             if data is None:
                 self._peer_lost(src)
                 return
             oob: List[bytearray] = []
             corrupt = None
             for _ in range(nbufs):
-                bhdr = self._recv_exact(conn, _BUFLEN.size)
+                bhdr = self._recv_exact(conn, _BUFLEN.size, src)
                 if bhdr is None:
                     self._peer_lost(src)
                     return
@@ -818,7 +1070,7 @@ class SocketCE(CommEngine):
                 if bln > max_ln:
                     corrupt = f"oob buffer length {bln} (tag={tag})"
                     break
-                buf = self._recv_into(conn, bln)
+                buf = self._recv_into(conn, bln, src)
                 if buf is None:
                     self._peer_lost(src)
                     return
@@ -828,6 +1080,7 @@ class SocketCE(CommEngine):
                 return
             self.recv_msgs += 1
             self.stats.frames_recv += 1
+            self._note_heard(src)
             try:
                 payload = pickle.loads(data, buffers=oob) if data else None
             except Exception as exc:
@@ -846,34 +1099,96 @@ class SocketCE(CommEngine):
 
     def _peer_corrupt(self, src: int, conn: socket.socket,
                       why: str) -> None:
-        warning("rank %d: protocol corruption from rank %d: %s",
-                self.rank, src, why)
         try:
             conn.close()
         except OSError:
             pass
-        self._peer_lost(src)
+        self.declare_peer_dead(src, PeerFailedError(
+            src, f"rank {self.rank}: protocol corruption from rank "
+                 f"{src}: {why}", detector="corrupt"))
 
     def _peer_lost(self, src: int) -> None:
         """Failure detection: a peer's socket closed while we are still
         running (the reference has NO fault tolerance — it aborts; here
-        the loss surfaces as a context error AND wakes barrier/
-        quiescence waiters so they fail fast with a cause instead of
-        hanging to their timeouts)."""
-        if self._stop:
-            return             # orderly shutdown closes sockets
-        warning("rank %d: lost connection to rank %d", self.rank, src)
-        self.dead_peers.add(src)
-        cond = getattr(self, "_bar_cond", None)   # SocketCE's barrier
-        if cond is not None:
-            with cond:
-                cond.notify_all()
-        if self.on_error is not None:
-            self.on_error(ConnectionError(
-                f"rank {self.rank}: peer rank {src} disconnected "
-                "mid-run"))
+        the loss surfaces as a contained PeerFailedError AND wakes
+        barrier/quiescence waiters so they fail fast with a cause
+        instead of hanging to their timeouts)."""
+        self.declare_peer_dead(src, PeerFailedError(
+            src, f"rank {self.rank}: peer rank {src} disconnected "
+                 "mid-run"))
 
-    def send_am(self, tag: int, dst: int, payload: Any = None) -> None:
+    def _drop_peer(self, r: int) -> None:
+        with self._plock:
+            s = self._peers.pop(r, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _kill_close(self) -> None:
+        """Injected hard death: every socket closes abruptly (peers see
+        EOF); the engine object stays nominally alive."""
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._plock:
+            peers, self._peers = dict(self._peers), {}
+        for s in peers.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _send_raw_parts(self, dst: int, parts: List[Any]) -> None:
+        s = self._connect(dst)
+        with self._send_locks[dst]:
+            self._sendmsg_all(s, parts)
+
+    def _hb_send(self, r: int) -> None:
+        # NEVER block the progress thread on a heartbeat: only beat
+        # ESTABLISHED connections (send_am to an undialed higher rank
+        # parks in _connect's 30s wait), skip when a send is already in
+        # flight on the lane, and skip when the kernel buffer is full —
+        # a hung peer that stopped draining would otherwise wedge the
+        # thread that runs check_peer_timeouts behind a blocking
+        # sendmsg.  A skipped beat only delays the PEER's view of us by
+        # one tick; our own view of them rides _last_heard regardless.
+        if self._muted:
+            return
+        with self._plock:
+            s = self._peers.get(r)
+        lock = self._send_locks.get(r)
+        if s is None or lock is None or not lock.acquire(blocking=False):
+            return
+        try:
+            try:
+                if hasattr(select, "poll"):
+                    # poll has no FD_SETSIZE: select.select raises
+                    # ValueError for fds >= 1024 (a resident service
+                    # holds thousands) and that would kill the thread
+                    po = select.poll()
+                    po.register(s.fileno(), select.POLLOUT)
+                    writable = bool(po.poll(0))
+                else:
+                    writable = bool(select.select([], [s], [], 0)[1])
+            except (OSError, ValueError):
+                return
+            if not writable:
+                return   # send buffer full: beating it would block
+            self.sent_msgs += 1
+            self.stats.frames_sent += 1
+            self._sendmsg_all(s, _frame_parts(TAG_HB, None))
+        finally:
+            lock.release()
+
+    def send_am(self, tag: int, dst: int, payload: Any = None,
+                _nofault: bool = False) -> None:
         mark("send_am tag=%d dst=%d", tag, dst)
         if dst == self.rank:
             # local delivery short-circuit (counts as a message so the
@@ -882,12 +1197,37 @@ class SocketCE(CommEngine):
             self.recv_msgs += 1
             self._dispatch(tag, self.rank, payload)
             return
+        if self._muted:
+            return   # injected silent hang swallows every outbound frame
+        if dst in self.dead_peers:
+            # the closed socket used to raise OSError from sendmsg; now
+            # that death drops the peer entry, raise the same class
+            # rather than re-dialing a corpse for 30s
+            raise OSError(f"peer rank {dst} is dead")
+        if self._fault is not None and not _nofault \
+                and self._fault_frame(tag, dst, payload):
+            return
         parts = _frame_parts(tag, payload)
         s = self._connect(dst)
         with self._send_locks[dst]:
             self.sent_msgs += 1
             self.stats.frames_sent += 1
-            self._sendmsg_all(s, parts)
+            try:
+                self._sendmsg_all(s, parts)
+            except (socket.timeout, BlockingIOError):
+                # SO_SNDTIMEO fired (_bound_send): the peer stopped
+                # draining for 2x comm_peer_timeout_s and the frame is
+                # torn mid-stream — fail the lane like an EOF so the
+                # progress thread (which also runs the hung-peer
+                # detector) is never wedged inside sendmsg
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                self._peer_lost(dst)
+                raise OSError(
+                    f"rank {self.rank}: send to rank {dst} timed out "
+                    "(peer not draining)")
 
     def _sendmsg_all(self, s: socket.socket, parts: List[Any]) -> None:
         """Gather-send every part (scatter-gather keeps large array
@@ -942,7 +1282,7 @@ class SocketCE(CommEngine):
 #: frames (a termination token or GET request must not wait behind a
 #: multi-MB payload drain); a partially-written frame is never preempted
 _CTL_TAGS = frozenset((TAG_TERMDET, TAG_BARRIER, TAG_GET_REQ, TAG_UTRIG,
-                       TAG_CLOCK))
+                       TAG_CLOCK, TAG_HB))
 
 #: receive state machine stages
 _ST_HS, _ST_HDR, _ST_BODY, _ST_BLEN, _ST_BUF = range(5)
@@ -1076,6 +1416,7 @@ class EventLoopCE(CommEngine):
             # would leak (and block a rebind of this port)
             self.fini()
             raise
+        self._arm_kill()
 
     # -- public loop hooks (the remote-dep layer's progress seam) -------
     def post(self, fn: Callable, *args) -> None:
@@ -1097,6 +1438,14 @@ class EventLoopCE(CommEngine):
         return {"out_bytes": peer.out_bytes,
                 "delay_ewma": peer.delay_ewma,
                 "rate_ewma": peer.rate_ewma}
+
+    def peer_debug(self) -> Dict[int, Dict[str, Any]]:
+        out = super().peer_debug()
+        for r, peer in list(self._peers.items()):
+            ent = out.setdefault(r, {})
+            ent["out_bytes"] = peer.out_bytes
+            ent["connected"] = peer.sock is not None
+        return out
 
     # -- command ring ----------------------------------------------------
     def _post(self, cmd: tuple) -> None:
@@ -1142,7 +1491,24 @@ class EventLoopCE(CommEngine):
     # -- the loop --------------------------------------------------------
     def _loop(self) -> None:
         sel = self._sel
+        mute_done = False
         while not self._stop:
+            if self._muted and not mute_done:
+                # injected silent hang: deafen the selector once (a
+                # level-triggered readable socket we refuse to read
+                # would otherwise busy-spin the loop)
+                mute_done = True
+                for peer in list(self._peers.values()) + list(self._anon):
+                    if peer.sock is not None and peer.registered:
+                        try:
+                            sel.unregister(peer.sock)
+                        except (KeyError, ValueError, OSError):
+                            pass
+                        peer.registered = False
+                try:
+                    sel.unregister(self._listener)
+                except (KeyError, ValueError, OSError):
+                    pass
             self._drain_ring()
             if self._stop:
                 break
@@ -1183,6 +1549,8 @@ class EventLoopCE(CommEngine):
         release posted just before the stop flag flipped must reach the
         peers — the threaded transport sent it synchronously), bounded
         so dead peers cannot hang teardown."""
+        if self._muted:
+            return   # a hung rank ships nothing, by definition
         end = time.monotonic() + deadline
         while time.monotonic() < end:
             self._drain_ring()
@@ -1222,16 +1590,13 @@ class EventLoopCE(CommEngine):
         for rank, peer in list(self._peers.items()):
             if peer.sock is None and peer.out_bytes and \
                     now - peer.born > 30 and rank not in self.dead_peers:
-                self.dead_peers.add(rank)
-                with self._bar_cond:
-                    self._bar_cond.notify_all()
-                peer.q_ctl.clear()
-                peer.q_bulk.clear()
-                peer.out_bytes = 0
-                if self.on_error is not None:
-                    self.on_error(TimeoutError(
-                        f"rank {self.rank}: no connection from rank "
-                        f"{rank} after 30s (frames queued)"))
+                self._clear_peer_queues(peer)
+                # the shared death sequence (mark, wake barrier
+                # waiters, containment route) — one path per detector
+                self.declare_peer_dead(rank, PeerFailedError(
+                    rank, f"rank {self.rank}: no connection from rank "
+                          f"{rank} after 30s (frames queued)",
+                    detector="connect"))
 
     # -- connection management ------------------------------------------
     def _dial(self, dst: int) -> None:
@@ -1252,6 +1617,7 @@ class EventLoopCE(CommEngine):
             self._peers[rank] = peer
         self._sel.register(sock, selectors.EVENT_READ, ("peer", peer))
         peer.registered = True
+        self._note_heard(rank)
         self._flush(peer)
 
     def _on_accept(self) -> None:
@@ -1291,10 +1657,8 @@ class EventLoopCE(CommEngine):
             except OSError:
                 pass
 
-    def _peer_down(self, peer: _EvPeer, cause: Optional[str]) -> None:
-        """Failure detection: the connection fails WITH its cause — the
-        engine contract — and wakes barrier/quiescence waiters."""
-        self._close_peer(peer)
+    @staticmethod
+    def _clear_peer_queues(peer: _EvPeer) -> None:
         # frames can never reach a dead peer: drop them (and stop
         # accumulating — _send_now discards for dead ranks), else a
         # resident service leaks every later token/activation to it
@@ -1303,27 +1667,75 @@ class EventLoopCE(CommEngine):
         peer.wire.clear()
         peer.marks.clear()
         peer.out_bytes = 0
+
+    def _peer_down(self, peer: _EvPeer, cause: Optional[str],
+                   detector: str = "close") -> None:
+        """Failure detection: the connection fails WITH its cause — the
+        engine contract.  Local transport teardown happens here; the
+        shared death sequence (mark, wake barrier waiters, containment
+        route) is declare_peer_dead's — ONE path for every detector."""
+        self._close_peer(peer)
+        self._clear_peer_queues(peer)
         src = peer.rank
-        if self._stop or src is None or src in self.dead_peers:
-            return
-        warning("rank %d: lost connection to rank %d%s", self.rank, src,
-                f": {cause}" if cause else "")
-        self.dead_peers.add(src)
-        with self._bar_cond:
-            self._bar_cond.notify_all()
-        if self.on_error is not None:
-            self.on_error(ConnectionError(
-                f"rank {self.rank}: peer rank {src} disconnected mid-run"
-                + (f": {cause}" if cause else "")))
+        if src is None:
+            return   # a stranger that never handshook has no identity
+        self.declare_peer_dead(src, PeerFailedError(
+            src, f"rank {self.rank}: peer rank {src} disconnected mid-run"
+            + (f": {cause}" if cause else ""), detector=detector))
 
     def _sever(self, peer: _EvPeer, why: str) -> None:
         warning("rank %d: protocol corruption from rank %s: %s",
                 self.rank, peer.rank, why)
-        self._peer_down(peer, why)
+        self._peer_down(peer, why, detector="corrupt")
+
+    def _drop_peer(self, r: int) -> None:
+        """Close a declared-dead peer's transport state (declare_peer_dead
+        contract); hops onto the loop thread when called off it."""
+        if threading.current_thread() is not self._thread:
+            self._post(("call", self._drop_peer, (r,)))
+            return
+        peer = self._peers.get(r)
+        if peer is not None:
+            self._close_peer(peer)
+            self._clear_peer_queues(peer)
+
+    def _kill_close(self) -> None:
+        """Injected hard death: close everything abruptly on the loop
+        thread; each dropped connection surfaces on OUR side too, so the
+        killed rank's own context fails structurally instead of
+        wedging."""
+        def doit():
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            for peer in list(self._peers.values()):
+                if peer.sock is not None:
+                    self._peer_down(peer, "fault_kill (injected)")
+        self.post(doit)
+
+    def _send_raw_parts(self, dst: int, parts: List[Any]) -> None:
+        views = [memoryview(p) for p in parts if len(p)]
+        nbytes = sum(v.nbytes for v in views)
+
+        def doit():
+            peer = self._peers.get(dst)
+            if peer is None or peer.sock is None:
+                return
+            peer.q_bulk.append((time.monotonic(), nbytes, views))
+            peer.out_bytes += nbytes
+            self._flush(peer)
+        self.post(doit)
 
     # -- send path -------------------------------------------------------
-    def send_am(self, tag: int, dst: int, payload: Any = None) -> None:
+    def send_am(self, tag: int, dst: int, payload: Any = None,
+                _nofault: bool = False) -> None:
         mark("send_am tag=%d dst=%d", tag, dst)
+        if self._muted and dst != self.rank:
+            return   # injected silent hang swallows every outbound frame
+        if self._fault is not None and not _nofault and dst != self.rank \
+                and self._fault_frame(tag, dst, payload):
+            return
         if dst == self.rank:
             # local delivery short-circuit (counts as a message so the
             # termination balance stays symmetric); same posted-FIFO
@@ -1372,7 +1784,7 @@ class EventLoopCE(CommEngine):
 
     def _flush(self, peer: _EvPeer) -> None:
         sock = peer.sock
-        if sock is None:
+        if sock is None or self._muted:
             return
         stats = self.stats
         while True:
@@ -1454,6 +1866,8 @@ class EventLoopCE(CommEngine):
 
     # -- receive path ----------------------------------------------------
     def _on_read(self, peer: _EvPeer) -> None:
+        if self._muted:
+            return   # injected silent hang: stop consuming
         budget = _RECV_BUDGET
         scratch = self._scratch
         smv = self._scratch_mv
@@ -1477,6 +1891,10 @@ class EventLoopCE(CommEngine):
                     return
                 stats.syscalls_recv += 1
                 stats.bytes_recv += n
+                # liveness per chunk, not per completed frame: a bulk
+                # frame outlasting comm_peer_timeout_s on the wire must
+                # not get its actively-sending peer declared dead
+                self._note_heard(peer.rank)
                 peer.r_got += n
                 budget -= n
                 if peer.r_got == peer.r_want and not self._advance(peer):
@@ -1498,6 +1916,7 @@ class EventLoopCE(CommEngine):
                     return
                 stats.syscalls_recv += 1
                 stats.bytes_recv += n
+                self._note_heard(peer.rank)   # per chunk (see above)
                 budget -= n
                 if not self._feed(peer, smv[:n]):
                     return
@@ -1558,6 +1977,7 @@ class EventLoopCE(CommEngine):
                     return False
             self._peers[src] = peer
             self._anon.discard(peer)
+            self._note_heard(src)
             self._expect_hdr(peer)
             self._flush(peer)
             return peer.sock is not None
@@ -1617,6 +2037,7 @@ class EventLoopCE(CommEngine):
     def _frame_done(self, peer: _EvPeer) -> bool:
         self.recv_msgs += 1
         self.stats.frames_recv += 1
+        self._note_heard(peer.rank)
         tag = peer.r_tag
         body, oob = peer.r_body, peer.r_oob
         src = peer.rank

@@ -92,6 +92,16 @@ class IciEngine:
         self._mesh = None
         self._prog_cache: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
+        #: serializes COLLECTIVE program launches: two multi-device
+        #: programs dispatched concurrently from different worker
+        #: threads (an idle-worker window flush racing a full-round
+        #: defer_place flush) can interleave their per-device
+        #: participant enqueues and deadlock the XLA rendezvous — the
+        #: r8 repro's "two CollectivePermute run-ids stuck waiting for
+        #: participants" wedge (the pre-existing dryrun >3min stall).
+        #: One launch at a time gives every device queue the same
+        #: program order.
+        self._launch_lock = threading.Lock()
         #: deferred single-consumer placements awaiting same-wavefront
         #: siblings: (produced copy, destination space, enqueue time).
         #: Flushed as batched CollectivePermute rounds (SURVEY §5.8's
@@ -123,9 +133,12 @@ class IciEngine:
     def put(self, payload, dst_space: int):
         """Move one tile to ``dst_space``'s device, device-to-device
         (reference: CE put with registered memory,
-        parsec_mpi_funnelled.c:793)."""
-        import jax
-        out = jax.device_put(payload, self._jdev[dst_space])
+        parsec_mpi_funnelled.c:793).  The placed copy must be PRIVATE:
+        on the CPU client a plain device_put can alias the source
+        buffer, which a later donation would corrupt (the r8 wrong-R
+        root cause; see devices/xla.device_put_private)."""
+        from parsec_tpu.devices.xla import device_put_private
+        out = device_put_private(payload, self._jdev[dst_space])
         self.stats.puts += 1
         self.stats.put_bytes += getattr(payload, "nbytes", 0)
         return out
@@ -143,6 +156,24 @@ class IciEngine:
         want = set(dst_spaces)
         sharding = NamedSharding(self.mesh(), P())   # fully replicated
         rep = jax.device_put(payload, sharding)
+        # the replicated "copies" must be PRIVATE: on the CPU client the
+        # shard co-located with the host buffer can alias it (the same
+        # r8 wrong-R hazard device_put_private closes for put/stage-in)
+        # — a later in-place mutation or donation of the source would
+        # corrupt every consumer's tile
+        try:
+            sptr = payload.unsafe_buffer_pointer()
+        except Exception:
+            iface = getattr(payload, "__array_interface__", None)
+            sptr = iface["data"][0] if iface is not None else None
+        if sptr is not None:
+            try:
+                aliased = any(s.data.unsafe_buffer_pointer() == sptr
+                              for s in rep.addressable_shards)
+            except Exception:
+                aliased = False   # probe unsupported: transfers copy
+            if aliased:
+                rep = jax.device_put(np.asarray(payload).copy(), sharding)
         out: Dict[int, Any] = {}
         by_jdev = {jd: sp for sp, jd in self._jdev.items()}
         for shard in rep.addressable_shards:
@@ -238,7 +269,11 @@ class IciEngine:
                 prog = jax.jit(shard_map(
                     body, mesh=mesh, in_specs=P("d"), out_specs=P("d")))
                 self._prog_cache[key] = prog
-        y = prog(x)
+        with self._launch_lock:
+            # dispatch AND completion inside the lock: async dispatch
+            # alone could still leave per-device enqueues of two
+            # collectives interleaved (see _launch_lock)
+            y = jax.block_until_ready(prog(x))
         pos_to_space = {v: k for k, v in self._space_to_pos.items()}
         recv = {d_pos: s_pos for s_pos, d_pos in perm}
         by_jdev = {jd: sp for sp, jd in self._jdev.items()}

@@ -30,6 +30,7 @@ import itertools
 import queue as queue_mod
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -39,6 +40,7 @@ from parsec_tpu.comm.engine import (CommEngine, TAG_ACTIVATE, TAG_BATCH,
                                     TAG_TERMDET, TAG_UTRIG)
 from parsec_tpu.core import scheduling
 from parsec_tpu.core.engine import deliver_dep
+from parsec_tpu.core.errors import PeerFailedError
 from parsec_tpu.utils.mca import params
 from parsec_tpu.utils.output import warning
 
@@ -96,6 +98,25 @@ params.register("comm_handle_timeout", 600.0,
                 "purge fails the RECEIVER with a clear miss, not the "
                 "serving rank)")
 
+params.register("comm_rdv_retry_s", 2.0,
+                "initial rendezvous retry backoff: a GET_REQ with no "
+                "reply is re-sent after this many seconds, doubling per "
+                "attempt (the serving side keeps answered handles "
+                "around for a grace period, so a duplicate pull is "
+                "idempotent)")
+
+params.register("comm_rdv_timeout_s", 60.0,
+                "terminal rendezvous deadline: a pull still unanswered "
+                "after this many seconds fails ITS taskpool with a "
+                "structured PeerFailedError instead of waiting forever")
+
+#: answered (refs == 0) rendezvous handles linger this long so a
+#: retransmitted GET_REQ — retry backoff, duplicated frame — can be
+#: re-served instead of surfacing a spurious miss.  Sized past the
+#: default backoff horizon (comm_rdv_retry_s doubling: 2+4+8+16 = 30s)
+#: so every retry a 60s comm_rdv_timeout_s allows finds the handle
+_HANDLE_GRACE_S = 30.0
+
 
 def _msg_nbytes(msg: dict) -> int:
     """Best-effort payload byte count of an app message (trace events)."""
@@ -109,13 +130,20 @@ def _msg_nbytes(msg: dict) -> int:
 
 
 class _Handle:
-    __slots__ = ("data", "refs", "lock", "born")
+    __slots__ = ("data", "refs", "lock", "born", "dead_at", "served")
 
     def __init__(self, data, refs: int):
         self.data = data
         self.refs = refs
         self.lock = threading.Lock()
         self.born = time.monotonic()
+        #: stamped when the last expected ref was served; the handle
+        #: then lingers for _HANDLE_GRACE_S (idempotent re-serves)
+        self.dead_at: Optional[float] = None
+        #: ranks already served once: a RETRANSMITTED pull (retry
+        #: backoff stamps a fresh _fid, so dedup cannot see it) must not
+        #: consume another requester's ref
+        self.served: set = set()
 
 
 class RemoteDepEngine:
@@ -154,6 +182,20 @@ class RemoteDepEngine:
         self._dyn_holds: List = []
         self._dyn_released = threading.Event()
         ce.on_error = self._on_handler_error
+        #: peer-death containment: route a dead rank into the taskpools
+        #: that touch it (per-pool error sinks) instead of poisoning the
+        #: whole context
+        ce.on_peer_dead = self._on_peer_dead
+        #: Safra reconcile for injected frame faults (utils/faultinject):
+        #: a dropped app frame un-counts its send, a duplicated one
+        #: counts twice — the token balance stays convergent either way
+        ce.on_frame_fault = self._on_frame_fault
+        #: per-message wire id (origin_rank, seq): receivers drop
+        #: duplicate deliveries (retransmits, injected dups) after
+        #: crediting them in the Safra balance
+        self._fid_seq = itertools.count(1)
+        self._seen_fids: set = set()
+        self._fid_order: "deque" = deque()
         #: causal tracer (prof/causal.py), attached by its install();
         #: None = zero tracing work on every send/recv path
         self.tracer = None
@@ -231,12 +273,30 @@ class RemoteDepEngine:
         self._clock_period = max(0.5,
                                  float(params.get("comm_clock_probe_s",
                                                   5.0)))
+        #: active failure detection: TAG_HB heartbeats piggyback on the
+        #: TAG_CLOCK probe cadence (capped at timeout/3 so a silent peer
+        #: is declared within ~2x the timeout even with drifty timers)
+        self._peer_timeout = float(params.get("comm_peer_timeout_s",
+                                              15.0))
+        self._hb_period = max(0.2, min(self._clock_period,
+                                       self._peer_timeout / 3.0)) \
+            if self._peer_timeout > 0 else 0.0
+        self._hb_on = self._peer_timeout > 0 and self.nranks > 1
+        #: rendezvous retry/backoff state (see _retry_rendezvous)
+        self._rdv_retry = max(0.05, float(params.get("comm_rdv_retry_s",
+                                                     2.0)))
+        self._rdv_timeout = float(params.get("comm_rdv_timeout_s", 60.0))
         if self.funnelled:
             self._progress = None
             ce.add_periodic(self._purge_stale_handles, 5.0)
+            ce.add_periodic(self._retry_rendezvous,
+                            max(0.25, self._rdv_retry / 2.0))
             if self._clock_on:
                 ce.add_periodic(ce.probe_clocks, self._clock_period)
                 ce.post(ce.probe_clocks)   # first round at attach
+            if self._hb_on:
+                ce.add_periodic(ce.heartbeat_tick, self._hb_period)
+                ce.add_periodic(ce.check_peer_timeouts, self._hb_period)
             if self._flush_window > 0:
                 ce.add_periodic(self._drain_flush_window,
                                 max(self._flush_window * 5e-4, 0.001))
@@ -265,7 +325,10 @@ class RemoteDepEngine:
         (reference: the user_trigger termdet's own AM tag)."""
         for r in range(self.nranks):
             if r != self.rank:
-                self.ce.send_am(TAG_UTRIG, r, {"tp": tp_id})
+                try:
+                    self.ce.send_am(TAG_UTRIG, r, {"tp": tp_id})
+                except OSError:
+                    pass   # dead peer; its loss is already routed
 
     def _utrig_cb(self, src: int, msg: dict) -> None:
         tp = self.context.taskpools.get(msg["tp"])
@@ -306,13 +369,18 @@ class RemoteDepEngine:
     def _purge_stale_handles(self) -> None:
         """GC rendezvous handles no receiver ever pulled (reference gap
         closed: refcounted handles with no timeout would leak if a rank
-        in the bcast tree dies or the eager race skips its GET)."""
+        in the bcast tree dies or the eager race skips its GET).  Fully
+        served handles linger for a short grace (dead_at) so a
+        retransmitted GET_REQ can be re-served idempotently."""
         ttl = float(params.get("comm_handle_timeout", 120.0))
         now = time.monotonic()
         stale = []
         with self._hlock:
             for h, handle in list(self._handles.items()):
-                if now - handle.born > ttl:
+                if handle.dead_at is not None:
+                    if now - handle.dead_at > _HANDLE_GRACE_S:
+                        del self._handles[h]   # served; silent drop
+                elif now - handle.born > ttl:
                     stale.append(h)
                     del self._handles[h]
         for h in stale:
@@ -327,6 +395,9 @@ class RemoteDepEngine:
         # then every probe period for drift
         next_clock = time.monotonic() + 0.2 if self._clock_on \
             else float("inf")
+        next_hb = time.monotonic() + self._hb_period if self._hb_on \
+            else float("inf")
+        next_rdv = time.monotonic() + self._rdv_retry
         while not self._stop:
             if time.monotonic() > next_purge:
                 self._purge_stale_handles()
@@ -337,6 +408,17 @@ class RemoteDepEngine:
                 except OSError:
                     pass
                 next_clock = time.monotonic() + self._clock_period
+            if time.monotonic() > next_hb:
+                try:
+                    self.ce.heartbeat_tick()
+                except OSError:
+                    pass
+                self.ce.check_peer_timeouts()
+                next_hb = time.monotonic() + self._hb_period
+            if time.monotonic() > next_rdv:
+                self._retry_rendezvous()
+                next_rdv = time.monotonic() + max(0.25,
+                                                  self._rdv_retry / 2.0)
             self._drain_flush_window()
             try:
                 cmd = self._cmdq.get(timeout=0.05)
@@ -367,6 +449,8 @@ class RemoteDepEngine:
                     self._on_handler_error(exc)
             for dst, msgs in sends.items():
                 try:
+                    if dst in self.ce.dead_peers:
+                        continue   # undeliverable; the death was routed
                     if len(msgs) == 1:
                         self.ce.send_am(msgs[0][0], dst, msgs[0][1])
                     else:
@@ -374,11 +458,147 @@ class RemoteDepEngine:
                         # the BATCH frame carried len(msgs) app messages
                         # in one send; the counters already accounted
                         # each at enqueue time
+                except OSError:
+                    # the lane died mid-send (EOF, dead-peer raise,
+                    # SNDTIMEO): the transport's death path already
+                    # routed a CONTAINED PeerFailedError into the
+                    # touched pools — recording it again here would be
+                    # context-GLOBAL and poison every pool on the rank
+                    pass
                 except Exception as exc:
                     self._on_handler_error(exc)
 
     def _on_handler_error(self, exc: Exception) -> None:
         self.context.record_error(exc, None)
+
+    # ------------------------------------------------------------------
+    # robustness: fault reconcile, dedup, rendezvous retry, containment
+    # ------------------------------------------------------------------
+    def _on_frame_fault(self, kind: str, tag: int, payload) -> None:
+        """Safra reconcile for injected frame faults: the counters must
+        reflect what actually crossed the wire, or the token never sees
+        a zero balance again (a permanent hang the PLAN did not ask
+        for).  Only Safra-counted tags matter."""
+        if tag == TAG_BATCH:
+            n = len(payload) if isinstance(payload, list) else 1
+        elif tag in (TAG_ACTIVATE, TAG_GET_REQ, TAG_GET_REP, TAG_DTD):
+            n = 1
+        else:
+            return
+        with self._term_lock:
+            self._app_sent += n if kind == "dup" else -n
+
+    def _is_dup(self, msg) -> bool:
+        """Receiver-side dedup by wire id.  Called AFTER the Safra recv
+        credit (the duplicate's send was also counted), bounded memory.
+        Runs on the single comm/progress thread of either transport."""
+        fid = msg.get("_fid") if isinstance(msg, dict) else None
+        if fid is None:
+            return False
+        if fid in self._seen_fids:
+            warning("rank %d: dropped duplicate app message %s",
+                    self.rank, fid)
+            return True
+        self._seen_fids.add(fid)
+        self._fid_order.append(fid)
+        if len(self._fid_order) > 8192:
+            self._seen_fids.discard(self._fid_order.popleft())
+        return False
+
+    def _retry_rendezvous(self) -> None:
+        """Bounded retry with exponential backoff for parked rendezvous
+        pulls, and a terminal deadline: a GET whose source died or never
+        answers fails ITS taskpool with a structured PeerFailedError
+        instead of waiting forever (pre-r8 behavior: _pending_gets
+        entries were immortal)."""
+        if not self._pending_gets:
+            return
+        now = time.monotonic()
+        for key, pend in list(self._pending_gets.items()):
+            root, handle = key
+            exc = None
+            if root in self.ce.dead_peers:
+                exc = PeerFailedError(
+                    root, f"rank {self.rank}: rendezvous source rank "
+                          f"{root} died (handle {handle})",
+                    detector="rendezvous")
+            elif now - pend["sent_at"] > self._rdv_timeout:
+                exc = PeerFailedError(
+                    root, f"rank {self.rank}: rendezvous pull of handle "
+                          f"{handle} from rank {root} unanswered after "
+                          f"{self._rdv_timeout:g}s "
+                          f"({pend['attempts'] + 1} attempts)",
+                    detector="rendezvous")
+            if exc is not None:
+                if self._pending_gets.pop(key, None) is not None:
+                    self.context.record_pool_error(pend["tp"], exc)
+                continue
+            if now >= pend["next_at"]:
+                pend["attempts"] += 1
+                pend["next_at"] = now + self._rdv_retry \
+                    * (2 ** pend["attempts"])
+                warning("rank %d: re-sending rendezvous GET %s to rank "
+                        "%d (attempt %d)", self.rank, handle, root,
+                        pend["attempts"] + 1)
+                try:
+                    self._send_app(TAG_GET_REQ, root,
+                                   {"handle": handle, "from": self.rank})
+                except (PeerFailedError, OSError):
+                    pass   # the next sweep sees dead_peers
+
+    def _on_peer_dead(self, rank: int, exc: Exception) -> None:
+        """Containment: a dead peer fails the taskpools that TOUCH it —
+        parked rendezvous pulls rooted there, and pools that exchanged
+        traffic with it (Taskpool.peer_ranks) — through the per-pool
+        error route (Context.record_pool_error -> error_sink for
+        service jobs).  Only when nothing can be attributed does the
+        failure land on the context globally (the pre-r8 behavior)."""
+        pools: Dict[int, Any] = {}
+        for key in [k for k in list(self._pending_gets) if k[0] == rank]:
+            pend = self._pending_gets.pop(key, None)
+            if pend is not None:
+                pools[id(pend["tp"])] = pend["tp"]
+        for tp in list(self.context.taskpools.values()):
+            if rank in getattr(tp, "peer_ranks", ()):
+                pools[id(tp)] = tp
+        routed = False
+        for tp in pools.values():
+            if getattr(tp, "completed", False) \
+                    or getattr(tp, "cancelled", False):
+                continue
+            routed = True
+            self.context.record_pool_error(tp, exc)
+        if not routed:
+            self.context.record_error(exc, None)
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Protocol-state snapshot for the hang autopsy (Context.wait's
+        soft deadline): termdet balance, parked work, per-peer liveness."""
+        now = time.monotonic()
+        with self._term_lock:
+            out: Dict[str, Any] = {
+                "app_sent": self._app_sent, "app_recv": self._app_recv,
+                "balance": self._app_sent - self._app_recv,
+                "color_black": self._color_black,
+                "dyn_holds": len(self._dyn_holds),
+                "dtd_refs_pending": self.dtd_refs_pending,
+            }
+        with self._dlock:
+            out["delayed_activations"] = len(self._delayed)
+            out["dtd_backlog"] = sum(len(v)
+                                     for v in self._dtd_backlog.values())
+        out["pending_gets"] = {
+            f"{root}:{h}": {"attempts": p.get("attempts", 0),
+                            "age_s": round(now - p.get("sent_at", now), 2)}
+            for (root, h), p in list(self._pending_gets.items())}
+        with self._hlock:
+            out["serving_handles"] = len(self._handles)
+        with self._flush_lock:
+            out["flush_window_msgs"] = sum(len(v)
+                                           for v in self._flushbox.values())
+        out["dead_peers"] = sorted(self.ce.dead_peers)
+        out["peers"] = self.ce.peer_debug()
+        return out
 
     # ------------------------------------------------------------------
     # sender side
@@ -432,6 +652,7 @@ class RemoteDepEngine:
                 "deliveries": {r: targets[r] for r in ranks},
                 "ranks": ranks,
             }
+            tp.peer_ranks.update(ranks)   # containment attribution
             if self.tracer is not None:
                 # producer identity for the causal DAG: the same oid the
                 # task_profiler's exec interval carries (forwarders keep
@@ -479,7 +700,13 @@ class RemoteDepEngine:
             self._drain_flush_window()   # opportunistic: past-due drains
         else:
             for child, items in per_child.items():
-                self._send_batch(child, items)
+                try:
+                    self._send_batch(child, items)
+                except PeerFailedError as exc:
+                    # a dead child must not cut off its live siblings:
+                    # route into the owning pool (the window>0 path's
+                    # drain does the same per child)
+                    self.context.record_pool_error(tp, exc)
 
     def _drain_flush_window(self, force: bool = False) -> None:
         """Ship the cross-task flush window once its deadline passed
@@ -495,7 +722,16 @@ class RemoteDepEngine:
             box, self._flushbox = self._flushbox, {}
             self._flush_deadline = None
         for child, items in box.items():
-            self._send_batch(child, items)
+            try:
+                self._send_batch(child, items)
+            except PeerFailedError as exc:
+                # window drains run on the comm/progress thread, where
+                # nothing catches for us: route into the owning pools
+                for tpid in {p.get("tp") for _t, p in items
+                             if isinstance(p, dict)}:
+                    tp = self.context.taskpools.get(tpid)
+                    if tp is not None:
+                        self.context.record_pool_error(tp, exc)
 
     # -- adaptive eager/rendezvous threshold (reference: the eager-limit
     # MCA of remote_dep_mpi.c, made per-peer and feedback-driven) --------
@@ -585,15 +821,44 @@ class RemoteDepEngine:
         return kids
 
     def _send_tree(self, msg: dict) -> None:
+        """Forward down the bcast tree.  A dead child must not cut off
+        its LIVE siblings: every child is attempted, and the first
+        failure re-raises after the loop for the caller's pool
+        routing."""
+        first: Optional[PeerFailedError] = None
         for child in self._children(msg, self.rank):
-            self._send_app(TAG_ACTIVATE, child, msg)
+            try:
+                self._send_app(TAG_ACTIVATE, child, msg)
+            except PeerFailedError as exc:
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first
+
+    def _stamp_fid(self, payload) -> None:
+        """Give an app message its wire id (origin rank, seq) — the
+        receiver-side dedup key.  Stamped only at the ORIGINATOR (tree
+        forwarders relay the id), so one logical message keeps one id
+        across every hop and retransmit copies are recognizable."""
+        if isinstance(payload, dict) and "_fid" not in payload:
+            payload["_fid"] = (self.rank, next(self._fid_seq))
+
+    def _dead_peer_guard(self, dst: int) -> None:
+        if dst in self.ce.dead_peers:
+            raise PeerFailedError(
+                dst, f"rank {self.rank}: send to dead rank {dst}",
+                detector="send")
 
     def _send_app(self, tag: int, dst: int, payload) -> None:
         """Application-message send: counted and blackening (Safra).
         On the event-loop transport the frame goes straight onto the
         loop's command ring; on the threaded transport it funnels
         through the comm-progress thread which aggregates per-peer
-        (reference: remote_dep_dequeue_send)."""
+        (reference: remote_dep_dequeue_send).  A send to a DEAD rank
+        raises a structured PeerFailedError instead of silently
+        queueing — callers route it into the owning taskpool."""
+        self._dead_peer_guard(dst)
+        self._stamp_fid(payload)
         with self._term_lock:
             self._color_black = True
             self._app_sent += 1
@@ -605,6 +870,9 @@ class RemoteDepEngine:
         """Send several application messages to one destination as ONE
         wire frame (TAG_BATCH); each inner message stays individually
         counted for Safra (the receiver's _batch_cb mirrors this)."""
+        self._dead_peer_guard(dst)
+        for _tag, p in items:
+            self._stamp_fid(p)
         with self._term_lock:
             self._color_black = True
             self._app_sent += len(items)
@@ -667,6 +935,8 @@ class RemoteDepEngine:
     def _activate_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_ACTIVATE, src, msg)
         self._on_app_recv()   # exactly once per wire message
+        if self._is_dup(msg):
+            return            # retransmit/injected dup: already acted on
         self._try_activation(src, msg)
 
     def _try_activation(self, src: int, msg: dict) -> None:
@@ -701,8 +971,13 @@ class RemoteDepEngine:
             self._try_activation(src, msg)
 
     def _process_activation(self, tp, msg: dict) -> None:
-        # forward down the tree first (pipeline: data flows while we work)
-        self._send_tree(msg)
+        tp.peer_ranks.add(msg["root"])   # containment attribution
+        # forward down the tree first (pipeline: data flows while we
+        # work); a dead child fails THIS pool, not the whole context
+        try:
+            self._send_tree(msg)
+        except PeerFailedError as exc:
+            self.context.record_pool_error(tp, exc)
         data = msg["data"]
         deliveries = msg["deliveries"].get(self.rank) or \
             msg["deliveries"].get(str(self.rank))
@@ -718,34 +993,58 @@ class RemoteDepEngine:
         else:   # rendezvous: pull the payload from the root
             _, handle, dt, shape = data
             key = (msg["root"], handle)
+            now = time.monotonic()
             self._pending_gets[key] = {"tp": tp, "deliveries": deliveries,
-                                       "corr": corr}
-            self._send_app(TAG_GET_REQ, msg["root"],
-                           {"handle": handle, "from": self.rank})
+                                       "corr": corr, "sent_at": now,
+                                       "attempts": 0,
+                                       "next_at": now + self._rdv_retry}
+            try:
+                self._send_app(TAG_GET_REQ, msg["root"],
+                               {"handle": handle, "from": self.rank})
+            except PeerFailedError as exc:
+                self._pending_gets.pop(key, None)
+                self.context.record_pool_error(tp, exc)
 
     def _get_req_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_GET_REQ, src, msg)
         self._on_app_recv()
+        if self._is_dup(msg):
+            return
         h = msg["handle"]
         with self._hlock:
             handle = self._handles.get(h)
         if handle is None:
             # purged (TTL) or never existed: report the miss to the
             # rank that actually cannot proceed — the requester — rather
-            # than crashing the serving rank
-            self._send_app(TAG_GET_REP, src,
-                           {"handle": h, "miss": True, "root": self.rank})
+            # than crashing the serving rank (the requester is still
+            # alive by definition; if it died, the send guard raises
+            # into _safe_dispatch and the death was already routed)
+            try:
+                self._send_app(TAG_GET_REP, src,
+                               {"handle": h, "miss": True,
+                                "root": self.rank})
+            except PeerFailedError:
+                pass
             return
         buf, dt, shape = handle.data
-        self._send_app(TAG_GET_REP, src,
-                       {"handle": h, "buf": buf, "dtype": dt,
-                        "shape": shape, "root": self.rank})
+        try:
+            self._send_app(TAG_GET_REP, src,
+                           {"handle": h, "buf": buf, "dtype": dt,
+                            "shape": shape, "root": self.rank})
+        except PeerFailedError:
+            return   # requester died; keep the handle for live readers
         with handle.lock:
-            handle.refs -= 1
-            drop = handle.refs <= 0
-        if drop:
-            with self._hlock:
-                self._handles.pop(h, None)
+            # fully-served handles LINGER (dead_at) for a grace period
+            # instead of dropping instantly: a retransmitted GET_REQ
+            # (retry backoff, duplicated frame) re-serves idempotently —
+            # and decrements refs only ONCE per requester, else a slow
+            # requester's retry would consume a sibling's ref and start
+            # the grace purge while that sibling's pull is still parked
+            if src not in handle.served:
+                handle.served.add(src)
+                handle.refs -= 1
+                if handle.refs <= 0 and handle.dead_at is None:
+                    handle.dead_at = time.monotonic()
 
     # ------------------------------------------------------------------
     # distributed DTD traffic (reference: the DTD two-sided protocol —
@@ -753,7 +1052,12 @@ class RemoteDepEngine:
     # remote_dep_mpi.c:519, insert_function.c:3014-3163)
     # ------------------------------------------------------------------
     def dtd_send(self, dst: int, msg: dict) -> None:
-        """Counted application send for the DTD layer (Safra-visible)."""
+        """Counted application send for the DTD layer (Safra-visible).
+        Raises PeerFailedError when ``dst`` is dead — callers on worker
+        threads route it into the pool via record_error."""
+        tp = self.context.taskpools.get(msg.get("tp"))
+        if tp is not None:
+            tp.peer_ranks.add(dst)
         self._send_app(TAG_DTD, dst, msg)
 
     def dtd_ref_done(self) -> None:
@@ -767,13 +1071,20 @@ class RemoteDepEngine:
         # For rendezvous refs the pending-pull count must become visible
         # ATOMICALLY with the message credit: crediting first opens a
         # window where the Safra token sees an even balance and empty
-        # queues while the pull hasn't been registered yet
+        # queues while the pull hasn't been registered yet.  A duplicate
+        # is credited (its send was counted too) but must NOT register a
+        # second pull — the leaked pending count would hang termination
+        dup = self._is_dup(msg)
         with self._term_lock:
             self._color_black = True
             self._app_recv += 1
-            if isinstance(msg, dict) and "ref" in msg:
+            if not dup and isinstance(msg, dict) and "ref" in msg:
                 self.dtd_refs_pending += 1
+        if dup:
+            return
         tp = self.context.taskpools.get(msg["tp"])
+        if tp is not None:
+            tp.peer_ranks.add(src)
         incoming = getattr(tp, "_dtd_incoming", None)
         if incoming is not None:
             incoming(src, msg)
@@ -797,15 +1108,21 @@ class RemoteDepEngine:
     def _get_rep_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_GET_REP, src, msg)
         self._on_app_recv()
+        if self._is_dup(msg):
+            return
         key = (msg["root"], msg["handle"])
         pend = self._pending_gets.pop(key, None)
         if pend is None:
             return
         if msg.get("miss"):
-            self.context.record_error(RuntimeError(
-                f"rank {self.rank}: rendezvous payload {msg['handle']} "
-                f"from rank {src} expired before our GET "
-                "(comm_handle_timeout)"), None)
+            # contained: the pull's OWNING pool fails, not the context
+            # (the handle expired server-side — TTL or a grace window
+            # the retry backoff outlived)
+            self.context.record_pool_error(pend["tp"], PeerFailedError(
+                src, f"rank {self.rank}: rendezvous payload "
+                     f"{msg['handle']} from rank {src} expired before "
+                     "our GET (comm_handle_timeout)",
+                detector="rendezvous"))
             return
         arr = _decode(msg["buf"], msg["dtype"], msg["shape"])
         self._deliver(pend["tp"], pend["deliveries"], arr,
@@ -886,16 +1203,22 @@ class RemoteDepEngine:
             if self.rank != 0:
                 nxt = (self.rank + 1) % self.nranks
                 if nxt != 0:
-                    self.ce.send_am(TAG_TERMDET, nxt,
-                                    {"kind": "terminate"})
+                    try:
+                        self.ce.send_am(TAG_TERMDET, nxt,
+                                        {"kind": "terminate"})
+                    except OSError:
+                        pass   # dead next rank; its waiters fail fast
             self._terminated.set()
             return
         if kind == "dyn_release":
             if self.rank != 0:
                 nxt = (self.rank + 1) % self.nranks
                 if nxt != 0:
-                    self.ce.send_am(TAG_TERMDET, nxt,
-                                    {"kind": "dyn_release"})
+                    try:
+                        self.ce.send_am(TAG_TERMDET, nxt,
+                                        {"kind": "dyn_release"})
+                    except OSError:
+                        pass
             self._release_dyn_holds()
             return
         # token: wait until locally idle, then forward
@@ -904,6 +1227,15 @@ class RemoteDepEngine:
                          daemon=True).start()
 
     def _forward_token(self, token: dict, dyn: bool = False) -> None:
+        try:
+            self._forward_token_inner(token, dyn)
+        except OSError:
+            # the next rank in the ring died mid-forward: quiescence
+            # waiters fail fast through dead_peers; don't kill the
+            # daemon thread with a loose traceback
+            pass
+
+    def _forward_token_inner(self, token: dict, dyn: bool) -> None:
         idle = self._dyn_idle if dyn else self._local_idle
         done_evt = self._dyn_released if dyn else self._terminated
         kind = "dyn_token" if dyn else "token"
@@ -1001,16 +1333,21 @@ class RemoteDepEngine:
                         return
                 with self._term_lock:
                     self._color_black = False
-                self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
-                    "kind": "dyn_token", "black": False, "balance": 0,
-                    "rounds": 0})
+                try:
+                    self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
+                        "kind": "dyn_token", "black": False, "balance": 0,
+                        "rounds": 0})
+                except OSError:
+                    pass   # dead ring: the waiter below fails fast
             threading.Thread(target=kick, daemon=True).start()
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._dyn_released.wait(0.05):
             if self.ce.dead_peers:
-                raise ConnectionError(
+                dead = sorted(self.ce.dead_peers)
+                raise PeerFailedError(
+                    dead[0],
                     f"rank {self.rank}: dynamic-pool quiescence with "
-                    f"dead peer(s) {sorted(self.ce.dead_peers)}")
+                    f"dead peer(s) {dead}")
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"rank {self.rank}: dynamic-pool termination not "
@@ -1029,16 +1366,21 @@ class RemoteDepEngine:
                         return
                 with self._term_lock:
                     self._color_black = False
-                self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
-                    "kind": "token", "black": False, "balance": 0,
-                    "rounds": 0})
+                try:
+                    self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
+                        "kind": "token", "black": False, "balance": 0,
+                        "rounds": 0})
+                except OSError:
+                    pass   # dead ring: the waiter below fails fast
             threading.Thread(target=kick, daemon=True).start()
         deadline = time.monotonic() + timeout
         while not self._terminated.wait(0.05):
             if self.ce.dead_peers:
-                raise ConnectionError(
+                dead = sorted(self.ce.dead_peers)
+                raise PeerFailedError(
+                    dead[0],
                     f"rank {self.rank}: quiescence with dead peer(s) "
-                    f"{sorted(self.ce.dead_peers)}")
+                    f"{dead}")
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"rank {self.rank}: global termination not reached")

@@ -30,6 +30,19 @@ from parsec_tpu.utils.output import debug_verbose, inform
 params.register("runtime_num_cores", 4, "worker execution streams")
 params.register("sched", "", "scheduler component selection")
 params.register("termdet", "", "termination-detection component selection")
+params.register("runtime_autopsy_s", 45.0,
+                "soft deadline of Context.wait: when completion takes "
+                "longer than this, a one-shot HANG AUTOPSY is logged — "
+                "termdet counters, per-pool pending tasks, per-peer "
+                "queue depths and last-frame ages, in-flight rendezvous "
+                "handles — so a stuck run is diagnosable from its log "
+                "(0 disables)")
+params.register("task_retry_max", 0,
+                "retry a transiently-failing idempotent task body up to "
+                "this many times before failing its pool with "
+                "TaskRetryExhausted (datarepo-versioned inputs plus a "
+                "pre-execution write-flow snapshot make re-execution "
+                "safe; 0 = off; read at Context construction)")
 
 
 class ExecutionStream:
@@ -79,6 +92,9 @@ class Context:
         self.comm = None               # comm engine (distributed layer)
         self.grapher = None            # DOT grapher (prof layer)
         self._causal_tracer = None     # prof/causal.py CausalTracer
+        #: transient-task retry budget, cached off the worker hot path
+        #: (core/scheduling.task_progress probes it per task)
+        self._retry_max = int(params.get("task_retry_max", 0))
 
         # device layer (reference: parsec_mca_device_init, parsec.c:823)
         from parsec_tpu.devices import init_devices
@@ -247,7 +263,10 @@ class Context:
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until all enqueued taskpools complete
-        (reference: parsec_context_wait:776)."""
+        (reference: parsec_context_wait:776).  Past the
+        ``runtime_autopsy_s`` soft deadline a one-shot hang autopsy is
+        logged so a stuck run is diagnosable from its log."""
+        import time as _time
         self.start()
         if self.comm is not None:
             # dynamic pools hold a runtime action until the pool-scoped
@@ -256,13 +275,30 @@ class Context:
             # timeout=None means wait indefinitely, like the completion
             # wait below — not a default deadline.
             self.comm.resolve_dynamic_holds(timeout)
-        with self._cond:
-            ok = self._cond.wait_for(
-                lambda: self._active_taskpools == 0 or self._errors,
-                timeout=timeout)
-        if self._errors:
-            exc, task = self._errors[0]
-            raise RuntimeError(f"task {task} failed") from exc
+        start = _time.monotonic()
+        autopsy_s = float(params.get("runtime_autopsy_s", 45.0))
+        autopsy_at = start + autopsy_s if autopsy_s > 0 else None
+        deadline = None if timeout is None else start + timeout
+        pred = lambda: self._active_taskpools == 0 or self._errors  # noqa: E731
+        while True:
+            with self._cond:
+                bounds = [t for t in (autopsy_at, deadline)
+                          if t is not None]
+                slice_s = max(0.0, min(bounds) - _time.monotonic()) \
+                    if bounds else None
+                ok = self._cond.wait_for(pred, timeout=slice_s)
+            if ok:
+                break
+            now = _time.monotonic()
+            if autopsy_at is not None and now >= autopsy_at:
+                from parsec_tpu.utils.output import warning
+                warning("context wait exceeded the %.0fs soft deadline "
+                        "— hang autopsy:\n%s", autopsy_s,
+                        self.hang_autopsy())
+                autopsy_at = None
+            if deadline is not None and now >= deadline:
+                break
+        self._raise_first_error()
         if not ok:
             raise TimeoutError("parsec context wait timed out")
         # drain accelerator pipelines: deps are released eagerly on
@@ -270,9 +306,7 @@ class Context:
         # "all work dispatched" — quiescence means "all work done", and
         # late device-side failures surface here
         self.sync_devices(timeout=timeout)
-        if self._errors:
-            exc, task = self._errors[0]
-            raise RuntimeError(f"task {task} failed") from exc
+        self._raise_first_error()
         if self.comm is not None:
             # distributed: local completion is not global completion —
             # peers may still pull our data (reference: ranks keep
@@ -286,6 +320,22 @@ class Context:
             dsync = getattr(d, "sync", None)
             if dsync is not None:
                 dsync(timeout=timeout)
+
+    def _raise_first_error(self) -> None:
+        """Surface the first recorded context error.  Structured
+        failures (PeerFailedError, TaskRetryExhausted) raise AS
+        THEMSELVES when no task is attributable — chaos harnesses and
+        serving layers dispatch on the type; everything else keeps the
+        pre-existing RuntimeError wrapper."""
+        if not self._errors:
+            return
+        from parsec_tpu.core.errors import (PeerFailedError,
+                                            TaskRetryExhausted)
+        exc, task = self._errors[0]
+        if task is None and isinstance(exc, (PeerFailedError,
+                                             TaskRetryExhausted)):
+            raise exc
+        raise RuntimeError(f"task {task} failed") from exc
 
     def record_error(self, exc: Exception, task: Task) -> None:
         from parsec_tpu.utils.debug_history import dump_history, paranoid
@@ -308,6 +358,65 @@ class Context:
         with self._cond:
             self._errors.append((exc, task))
             self._cond.notify_all()
+
+    def record_pool_error(self, tp, exc: Exception) -> None:
+        """Route a pool-scoped failure with no specific task (a dead
+        peer, a rendezvous timeout) through the pool's error sink —
+        containment for service jobs — falling back to the context-wide
+        error list exactly like record_error."""
+        sink = getattr(tp, "error_sink", None) if tp is not None else None
+        if sink is not None:
+            try:
+                sink(exc, None)
+                return
+            except Exception as sink_exc:
+                debug_verbose(1, "error_sink failed: %s", sink_exc)
+        with self._cond:
+            self._errors.append((exc, None))
+            self._cond.notify_all()
+
+    def hang_autopsy(self) -> str:
+        """One diagnosable snapshot of everything that can wedge a run:
+        per-pool termdet counters, comm protocol state (termdet balance,
+        parked activations, in-flight rendezvous, per-peer queue depths
+        and last-frame ages), and device pipeline depths."""
+        lines = ["=== parsec hang autopsy (rank %d) ===" % self.rank]
+        with self._lock:
+            lines.append(f"active taskpools: {self._active_taskpools}; "
+                         f"errors recorded: {len(self._errors)}")
+            pools = list(self.taskpools.values())
+        for tp in pools:
+            if getattr(tp, "completed", False):
+                continue
+            try:
+                peers = sorted(tp.peer_ranks) or "-"
+            except RuntimeError:
+                # comm threads resize the set lock-free; the autopsy
+                # must never raise out of Context.wait
+                peers = "~resizing~"
+            lines.append(
+                f"  pool {tp.taskpool_id} {tp.name!r}: state="
+                f"{getattr(tp, 'state', '?')} nb_tasks={tp.nb_tasks} "
+                f"pending_actions={tp.nb_pending_actions} "
+                f"cancelled={tp.cancelled} "
+                f"peer_ranks={peers}")
+        done = sum(es.nb_tasks_done for es in self.streams)
+        lines.append(f"workers: {len(self.streams)} streams, "
+                     f"{done} tasks done")
+        for d in self.device_registry.accelerators:
+            pend = len(getattr(d, "_pending", ()) or ())
+            infl = len(getattr(d, "_inflight", ()) or ())
+            held = len(getattr(d, "_held", ()) or ())
+            lines.append(f"  device {d.name}: pending={pend} "
+                         f"inflight={infl} held={held}")
+        if self.comm is not None:
+            dbg = getattr(self.comm, "debug_state", None)
+            if dbg is not None:
+                try:
+                    lines.append("comm: " + repr(dbg()))
+                except Exception as exc:   # the autopsy must never raise
+                    lines.append(f"comm: <debug_state failed: {exc}>")
+        return "\n".join(lines)
 
     # -- remote deps (filled in by the comm layer) ------------------------
     def remote_dep_activate(self, es, task, flow, dep, succ_tc, succ_locals,

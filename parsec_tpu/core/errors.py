@@ -1,0 +1,46 @@
+"""Structured runtime failures (the robustness substrate's vocabulary).
+
+The reference aborts the job on any comm failure (PaRSEC has no fault
+tolerance in-tree); a resident serving runtime instead needs failures
+that NAME what broke so containment can route them: a dead peer fails
+the jobs whose taskpools touch that rank, an exhausted retry fails one
+task's pool, and everything else keeps running.  These classes subclass
+the exceptions the pre-existing paths raised (ConnectionError /
+RuntimeError), so every ``except`` written against the old vocabulary
+still catches them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class PeerFailedError(ConnectionError):
+    """A peer rank died mid-run (hard socket close, protocol corruption,
+    or heartbeat silence past ``comm_peer_timeout_s``).  ``rank`` is the
+    dead peer; ``detector`` says which path declared it (``"close"``,
+    ``"corrupt"``, ``"heartbeat"``, ``"connect"``, ``"rendezvous"``)."""
+
+    def __init__(self, rank: int, msg: str, detector: str = "close"):
+        super().__init__(msg)
+        self.rank = rank
+        self.detector = detector
+
+
+class TaskRetryExhausted(RuntimeError):
+    """A transiently-failing task was retried ``attempts`` times
+    (``task_retry_max``) and still failed; ``__cause__`` carries the
+    last body error."""
+
+    def __init__(self, msg: str, attempts: int = 0,
+                 last: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.attempts = attempts
+        if last is not None:
+            self.__cause__ = last
+
+
+class FaultInjected(RuntimeError):
+    """A fault-plan ``fail_task`` directive fired (utils/faultinject.py).
+    Deliberately transient-shaped: the retry machinery treats it like
+    any other body error."""

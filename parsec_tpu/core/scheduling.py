@@ -14,8 +14,10 @@ import time
 from typing import List, Optional
 
 from parsec_tpu.core import engine
+from parsec_tpu.core.errors import FaultInjected, TaskRetryExhausted
 from parsec_tpu.core.task import HookReturn, Task, TaskStatus
 from parsec_tpu.data.data import ACCESS_WRITE
+from parsec_tpu.utils import faultinject as _fi
 from parsec_tpu.utils.output import debug_verbose, warning
 
 
@@ -95,9 +97,21 @@ def task_progress(es, task: Task, distance: int = 0) -> None:
         if task.status < TaskStatus.PREPARED:
             engine.prepare_input(es, task)
             task.status = TaskStatus.PREPARED
+        if es.context._retry_max > 0 and task.retries == 0:
+            _snapshot_write_flows(task)
+        if _fi.ARMED and _fi.task_fault(task):
+            # fault plan fail_task directive: a transient, retryable
+            # body failure (utils/faultinject.py)
+            raise FaultInjected(f"{task}: injected transient fault")
         task.status = TaskStatus.RUNNING
         ret = execute(es, task)
-    except Exception as exc:  # body/binding error: fail the context
+    except Exception as exc:  # body/binding error: retry or fail the pool
+        if _maybe_retry(es, task, exc, distance):
+            return
+        if task.retries:
+            exc = TaskRetryExhausted(
+                f"{task}: still failing after {task.retries + 1} "
+                "attempts", attempts=task.retries + 1, last=exc)
         es.context.record_error(exc, task)
         complete_execution(es, task, failed=True)
         return
@@ -114,6 +128,49 @@ def task_progress(es, task: Task, distance: int = 0) -> None:
         es.context.record_error(
             RuntimeError(f"{task} failed with {ret!r}"), task)
         complete_execution(es, task, failed=True)
+
+
+def _snapshot_write_flows(task: Task) -> None:
+    """Transient-retry support: snapshot host write-flow payloads before
+    the first execution attempt, so a retried body re-runs against the
+    ORIGINAL inputs even if the failed attempt mutated them in place
+    (read-only and task-fed versioned inputs are already safe — the
+    datarepo pins their version).  Only armed when task_retry_max > 0."""
+    import numpy as np
+    snap = {}
+    for flow in task.task_class.flows:
+        if not flow.access & ACCESS_WRITE:
+            continue
+        copy = task.data.get(flow.name)
+        p = copy.payload if copy is not None else None
+        if isinstance(p, np.ndarray):
+            snap[flow.name] = p.copy()
+    task.retry_snap = snap
+
+
+def _maybe_retry(es, task: Task, exc: Exception, distance: int) -> bool:
+    """Transient-failure retry: reschedule an idempotent task whose body
+    raised, up to ``task_retry_max`` attempts.  Device-owned (ASYNC)
+    tasks are not retried here — the device layer has its own degrade
+    path."""
+    limit = es.context._retry_max
+    if limit <= 0 or task.retries >= limit or task.taskpool.cancelled:
+        return False
+    if not task.task_class.properties.get("idempotent", True):
+        return False
+    import numpy as np
+    snap = task.retry_snap
+    for fname, arr in (snap or {}).items():
+        copy = task.data.get(fname)
+        if copy is not None:
+            copy.payload = arr.copy()
+    task.retries += 1
+    task.status = TaskStatus.READY
+    warning("%s: transient failure (%s: %s); retrying %d/%d", task,
+            type(exc).__name__, exc, task.retries, limit)
+    es.pins("task_retry", task)
+    schedule(es, [task], distance + 1)
+    return True
 
 
 def complete_execution(es, task: Task, failed: bool = False) -> None:

@@ -344,7 +344,7 @@ class Task:
     __slots__ = ("task_class", "taskpool", "locals", "key", "priority",
                  "status", "data", "input_sources", "pinned_flows",
                  "chore_mask", "seq", "device", "prof", "dtd",
-                 "ready_at")
+                 "ready_at", "retries", "retry_snap")
 
     def __init__(self, task_class: TaskClass, taskpool, locals_: Dict[str, int]):
         self.task_class = task_class
@@ -375,6 +375,10 @@ class Task:
         #: (schedule()); the causal tracer turns select - ready_at into
         #: the task's queue-wait span.  None unless a tracer is installed
         self.ready_at = None
+        #: transient-failure retry bookkeeping (core/scheduling
+        #: _maybe_retry; active only when task_retry_max > 0)
+        self.retries = 0
+        self.retry_snap = None
 
     def __repr__(self):
         args = ",".join(f"{k}={v}" for k, v in self.locals.items())

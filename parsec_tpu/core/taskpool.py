@@ -89,6 +89,10 @@ class Taskpool:
         #: here instead of poisoning the whole context
         #: (``sink(exc, task)``; see Context.record_error)
         self.error_sink: Optional[Callable] = None
+        #: ranks this pool exchanged traffic with (filled by the comm
+        #: layer) — peer-death containment fails exactly the pools whose
+        #: dataflow touches the dead rank (RemoteDepEngine._on_peer_dead)
+        self.peer_ranks: set = set()
 
     # -- construction ------------------------------------------------------
     def add_task_class(self, tc: TaskClass) -> TaskClass:

@@ -38,6 +38,7 @@ from parsec_tpu.data.data import (ACCESS_READ, ACCESS_WRITE, Coherency,
                                   DataCopy, FLAG_COW, FLAG_SCRATCH)
 from parsec_tpu.devices.device import Device
 from parsec_tpu.core.task import ToDesc
+from parsec_tpu.utils import faultinject as _fi
 from parsec_tpu.utils.mca import params
 from parsec_tpu.utils.output import debug_verbose, warning
 
@@ -101,6 +102,16 @@ params.register("device_fuse_panel", 1,
                 "Python scheduling latency between them (the measured "
                 "potrf tunnel-state sensitivity).  0 restores the "
                 "per-kernel panel path (the A/B attribution knob)")
+params.register("device_fuse_donate", 0,
+                "allow input-buffer donation inside CHAINED launches "
+                "(device_fuse_panel programs).  Default OFF as a "
+                "regression guard: the r8 loaded A/B attributed the "
+                "intermittent geqrf wrong-R to donation in chained "
+                "programs; the underlying aliasing is root-caused and "
+                "fixed (device_put_private), and donation-on re-tested "
+                "clean under the same load — flips back to 1 after a "
+                "longer soak.  Plain launches keep donating "
+                "(device_donate)")
 params.register("device_dispatchers", 2,
                 "manager (launch) threads per XLA device: each dispatch "
                 "blocks on the transport ack (milliseconds through a "
@@ -463,6 +474,37 @@ def _chain_jitted(key, node_specs, node_descs, wave_spec, wave_descs,
         return _chain_jit_cache.setdefault(key, jf)
 
 
+def device_put_private(payload, jdev):
+    """``jax.device_put`` that GUARANTEES a private buffer.
+
+    On the CPU client (virtual multi-device meshes, tests, the dryrun)
+    ``np.asarray`` of a device array is a zero-copy view and
+    ``device_put`` of an aligned host buffer is zero-copy too — so a
+    cross-device "copy" can silently ALIAS the source buffer.  Donation
+    or an in-place update of either side then corrupts the other: the
+    r8 root cause of the intermittent geqrf wrong-R (a consumer's staged
+    tile changed under it when the producer-side buffer was donated).
+    Real accelerator transfers never alias (and keep their direct D2D
+    path here), so the pointer probe costs one comparison and the
+    defensive copy never runs there."""
+    import jax
+    out = jax.device_put(payload, jdev)
+    try:
+        optr = out.unsafe_buffer_pointer()
+    except Exception:
+        return out   # probe unsupported on this backend: transfers copy
+    sptr = None
+    try:
+        sptr = payload.unsafe_buffer_pointer()
+    except Exception:
+        iface = getattr(payload, "__array_interface__", None)
+        if iface is not None:
+            sptr = iface["data"][0]
+    if sptr is not None and optr == sptr:
+        out = jax.device_put(np.asarray(payload).copy(), jdev)
+    return out
+
+
 #: marks an LRU entry as an in-progress adopt claim (distinguishable from
 #: a real accounted entry even at nbytes == 0)
 _PLACEHOLDER = object()
@@ -502,6 +544,8 @@ class XlaDevice(Device):
         self._donate = (bool(params.get("device_donate", 1))
                         and self.platform in ("tpu", "axon", "gpu", "cuda",
                                               "rocm"))
+        self._chain_donate = self._donate and \
+            bool(int(params.get("device_fuse_donate", 0)))
         self._depth = max(1, int(params.get("device_inflight_depth", 4)))
         self._runahead = max(self._depth,
                              int(params.get("device_runahead", 256)))
@@ -593,6 +637,10 @@ class XlaDevice(Device):
                 batch = self._pop_wave_locked()
                 self._launching += 1
             try:
+                if _fi.ARMED:
+                    # fault plan delay_dispatch: perturb the manager /
+                    # completer interleaving deterministically
+                    _fi.device_delay()
                 self._launch(batch)
             except Exception as exc:   # stage-in/compile failure
                 from parsec_tpu.core import scheduling
@@ -1034,9 +1082,18 @@ class XlaDevice(Device):
             wave_descs = tuple(
                 spec_descs(wave_spec, flat[t * k:(t + 1) * k])
                 for t in range(n))
+        # REGRESSION GUARD (r8, the geqrf wrong-R flake): chained
+        # launches donate NOTHING by default.  A/B under load +
+        # delay_dispatch fault plans attributed the intermittent wrong
+        # R to donation in chained programs (fuse=1/donate=1: 2 wrong
+        # in 22 runs; fuse=1/donate=0 and fuse=0: 0 in 46) — a chain's
+        # leaves were staged at HOLD time, long before this launch, and
+        # the leaf-used-once rule cannot see every later reference the
+        # way the plain path's same-instant _donation_hazard can.
+        # device_fuse_donate=1 re-enables it for root-cause work.
         donate = tuple(sorted(j for j in donatable
                               if leaf_uses.get(j) == 1)) \
-            if self._donate else ()
+            if self._chain_donate else ()
         key = (tuple((hd.spec.fn, d)
                      for hd, d in zip(claimed, node_descs)),
                wave_spec.fn if wave_spec is not None else None,
@@ -1234,7 +1291,7 @@ class XlaDevice(Device):
                 import jax.numpy as jnp
                 staged = jnp.array(payload, copy=True)
             else:
-                staged = jax.device_put(np.asarray(payload), self.jdev)
+                staged = device_put_private(payload, self.jdev)
                 if copy.arena is not None:
                     # eager completion can retire (and recycle) the arena
                     # host buffer before this async H2D drains: wait it out
@@ -1265,7 +1322,11 @@ class XlaDevice(Device):
                 import jax.numpy as jnp
                 dc.payload = jnp.array(payload, copy=True)
             else:
-                dc.payload = jax.device_put(payload, self.jdev)
+                # cross-device/host staging must be private too: on the
+                # CPU client a plain device_put ALIASES the source
+                # buffer (see device_put_private — the r8 wrong-R root
+                # cause)
+                dc.payload = device_put_private(payload, self.jdev)
                 if (src.arena if src is not None else copy.arena) \
                         is not None:
                     # see the snapshot path above: don't let an eager

@@ -179,7 +179,7 @@ class DTDTile:
     tile on the wire)."""
 
     __slots__ = ("data", "last_writer", "readers", "home_rank", "version",
-                 "wire_key", "v0_sent", "lanes")
+                 "wire_key", "v0_sent", "lanes", "applied_ver")
 
     def __init__(self, data: Data, home_rank: int = 0, wire_key: Any = None):
         self.data = data
@@ -194,6 +194,16 @@ class DTDTile:
         #: lazily on the first region-flagged access; None = the tile is
         #: tracked whole (the fast default path)
         self.lanes: Optional[Dict[Any, "_Lane"]] = None
+        #: highest WHOLE-COVERING version that actually LANDED on the
+        #: datum — whole-tile or extent-less-lane payload applies, and
+        #: completed local writes.  Distinct from ``version`` (bumped at
+        #: INSERT time): whole-covering applies on disjoint lanes take
+        #: no mutual dep edges, so an older payload (the v0 pristine
+        #: pull, a delayed extent-less lane frame) can physically arrive
+        #: after a newer value landed — and must not clobber it (the r6
+        #: region-lane stale-read race, reproduced with
+        #: ``delay_frame=tag:DTD,pm='ver': 0``)
+        self.applied_ver = 0
 
 
 class _Lane:
@@ -222,7 +232,7 @@ class _DTDState:
 
     __slots__ = ("task", "remaining", "successors", "done", "affinity",
                  "rank", "is_recv", "needed", "tile", "version", "payload",
-                 "remote_sends", "pushout", "region")
+                 "remote_sends", "pushout", "region", "local_writes")
 
     def __init__(self, task: Optional[Task], rank: int = 0):
         self.task = task
@@ -242,6 +252,10 @@ class _DTDState:
         self.region: Any = None
         #: (dst_rank, tile, version, lane) payloads to ship at completion
         self.remote_sends: set = set()
+        #: (tile, version, lane) writes this task performs locally —
+        #: dynamic_release advances each tile's applied_ver from them
+        #: once the body has actually run
+        self.local_writes: List[Tuple["DTDTile", int, Any]] = []
 
 
 _seq = itertools.count()
@@ -315,6 +329,10 @@ class DTDTaskpool(Taskpool):
             self._raise_context_error()
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"{self} wait timed out")
+        # a failed task also DRAINS the pool (complete_execution runs on
+        # the failure path), so the loop above can exit without ever
+        # polling: surface the error instead of reporting success
+        self._raise_context_error()
         if self.nranks > 1 and self.context.comm is not None:
             self._flush_home()
 
@@ -360,18 +378,23 @@ class DTDTaskpool(Taskpool):
         disjoint-lane appliers interleaving pull/overwrite would
         otherwise lose one lane's bytes."""
         with self._apply_lock:
-            if slices is not None:
-                _apply_payload(tile.data, arr, slices)
-                return
-            if not preserve:
-                _apply_payload(tile.data, arr)
-                return
-            copy = tile.data.pull_to_host()
-            cur = np.array(copy.payload, copy=True)
-            new = np.asarray(arr).reshape(cur.shape).copy()
-            for sl in preserve:
-                new[tuple(sl)] = cur[tuple(sl)]
-            tile.data.overwrite_host(new)
+            self._merge_payload_locked(tile, arr, slices, preserve)
+
+    def _merge_payload_locked(self, tile: DTDTile, arr: np.ndarray,
+                              slices: Optional[tuple],
+                              preserve: List[tuple]) -> None:
+        if slices is not None:
+            _apply_payload(tile.data, arr, slices)
+            return
+        if not preserve:
+            _apply_payload(tile.data, arr)
+            return
+        copy = tile.data.pull_to_host()
+        cur = np.array(copy.payload, copy=True)
+        new = np.asarray(arr).reshape(cur.shape).copy()
+        for sl in preserve:
+            new[tuple(sl)] = cur[tuple(sl)]
+        tile.data.overwrite_host(new)
 
     def _apply_flush(self, tile: DTDTile, arr: np.ndarray, lane: Any,
                      ver: int) -> None:
@@ -401,7 +424,18 @@ class DTDTaskpool(Taskpool):
                     sl = self._region_slices.get(lrid)
                     if sl is not None:
                         preserve.append(sl)
-        self._merge_payload(tile, arr, None, preserve)
+        with self._apply_lock:
+            # same WHOLE-COVERING landing-order guard as _apply_data: a
+            # flush-home payload delayed past a newer landing (an
+            # extent-less lane whose tile.lanes entry a later whole-tile
+            # write wiped slips the supersede check above, and the
+            # preserve list only protects SLICED lanes) must be dropped
+            # wholesale, not merged
+            if ver < tile.applied_ver:
+                self._trace_lane("flush_stale", tile.wire_key, lane, ver)
+                return
+            tile.applied_ver = ver
+            self._merge_payload_locked(tile, arr, None, preserve)
 
     def _raise_context_error(self) -> None:
         errs = getattr(self.context, "_errors", None)
@@ -929,7 +963,21 @@ class DTDTaskpool(Taskpool):
                         sl = self._region_slices.get(lrid)
                         if sl is not None:
                             preserve.append(sl)
-        self._merge_payload(tile, arr, None, preserve)
+        with self._apply_lock:
+            # WHOLE-COVERING landing order guard (the r6 region-lane
+            # stale-read race): disjoint-lane appliers take no mutual
+            # dep edges, and extent-less lanes have no byte extent the
+            # preserve list could protect — so an apply that lost the
+            # race to a NEWER whole-covering landing (payload apply or
+            # completed local write) must be dropped wholesale, not
+            # merged.  Checked and advanced atomically under the same
+            # lock the merge holds: two racing appliers serialize here
+            # and the older one sees the newer one's version.
+            if ver < tile.applied_ver:
+                self._trace_lane("apply_stale", tile.wire_key, lane, ver)
+                return
+            tile.applied_ver = ver
+            self._merge_payload_locked(tile, arr, None, preserve)
 
     def _recv_class(self) -> TaskClass:
         if self._recv_tc is None:
@@ -1111,6 +1159,7 @@ class DTDTaskpool(Taskpool):
                 self._edge(lw, state)
             tile.version += 1
             state.version = tile.version
+            state.local_writes.append((tile, tile.version, None))
             tile.last_writer = state
             tile.readers = []
             self._trace_lane("write", tile.wire_key, None, tile.version)
@@ -1198,6 +1247,7 @@ class DTDTaskpool(Taskpool):
             tile.version += 1
             state.version = tile.version
             state.region = rid
+            state.local_writes.append((tile, tile.version, rid))
             if rid is None:
                 # whole-tile write supersedes every lane's history
                 tile.lanes = {None: _Lane(state, version=tile.version)}
@@ -1215,6 +1265,15 @@ class DTDTaskpool(Taskpool):
         state = task.dtd
         if not isinstance(state, _DTDState):
             return []
+        # the body has run: its whole-covering writes are LANDED values
+        # now — advance each tile's applied_ver so an older whole-
+        # covering payload racing in from an unordered lane cannot
+        # clobber them (see _apply_data's landing-order guard)
+        for wtile, wver, wrid in state.local_writes:
+            if wrid is None or wrid not in self._region_slices:
+                with self._apply_lock:
+                    if wver > wtile.applied_ver:
+                        wtile.applied_ver = wver
         for tile in state.pushout:
             # PUSHOUT: force the produced version home now (reference:
             # PARSEC_PUSHOUT — eager writeback instead of lazy residency)

@@ -83,6 +83,10 @@ class JobHandle:
         self._result_ready = False
         self._exc: Optional[BaseException] = None
         self._failed_task = None
+        #: degraded-mode attribution: the peer rank whose death failed
+        #: this job (None for ordinary task failures) — set by the
+        #: service's containment route (PeerFailedError -> _job_error)
+        self.failed_rank: Optional[int] = None
         self._done = threading.Event()
         self._lock = threading.Lock()
 
@@ -168,6 +172,7 @@ class JobHandle:
             "finished_at": self.finished_at,
             "error": (None if self._exc is None
                       else f"{type(self._exc).__name__}: {self._exc}"),
+            "failed_rank": self.failed_rank,
         }
 
     def __repr__(self):

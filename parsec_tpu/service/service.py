@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from parsec_tpu.core.errors import PeerFailedError
 from parsec_tpu.core.taskpool import Compound, Taskpool
 from parsec_tpu.prof.gauges import JobGauges
 from parsec_tpu.service.job import (AdmissionError, JobHandle, JobStatus)
@@ -100,6 +101,14 @@ class JobService:
         self._jobs: Dict[int, JobHandle] = {}   # insertion-ordered history
         self._draining = False
         self._stop = False
+        #: DEGRADED MODE: ranks declared dead while the service runs.
+        #: Jobs whose taskpools touched a dead rank were failed by the
+        #: containment route (PeerFailedError -> error_sink -> _job_error)
+        #: — the service keeps admitting and serving every job that stays
+        #: off the dead ranks (single-rank pools, jobs on surviving
+        #: ranks); the set is surfaced through stats()/degraded for
+        #: operators and load balancers
+        self._degraded_ranks: set = set()
         self.gauges = JobGauges(self)
         self.gauges.install(context)
         self._thread = threading.Thread(target=self._dispatch_loop,
@@ -304,6 +313,13 @@ class JobService:
         THIS job and drain its pool; the context keeps serving."""
         job._exc = exc
         job._failed_task = task
+        if isinstance(exc, PeerFailedError):
+            # peer-death containment: the job dies, the SERVICE degrades
+            # — record the dead rank so operators see the reduced
+            # capacity while unaffected jobs keep running
+            job.failed_rank = exc.rank
+            with self._lock:
+                self._degraded_ranks.add(exc.rank)
         took = job._to(JobStatus.FAILED)
         debug_verbose(2, "service: %s failed on %s: %s", job.name, task,
                       exc)
@@ -343,6 +359,16 @@ class JobService:
         with self._lock:
             return self._jobs.get(job_id)
 
+    @property
+    def degraded(self) -> bool:
+        """True once any peer rank died under the service (containment
+        kept unaffected jobs running; capacity is reduced)."""
+        return bool(self._degraded_ranks)
+
+    def degraded_ranks(self) -> List[int]:
+        with self._lock:
+            return sorted(self._degraded_ranks)
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -351,6 +377,8 @@ class JobService:
                 "total": len(self._jobs),
                 "max_active": self._max_active,
                 "max_pending": self._max_pending,
+                "degraded": bool(self._degraded_ranks),
+                "degraded_ranks": sorted(self._degraded_ranks),
             }
 
     def drain(self, timeout: Optional[float] = None) -> bool:

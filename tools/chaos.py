@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Chaos harness: seeded fault plans vs 2-rank workloads, asserting the
+NO-HANG invariant.
+
+Every run must end, within its deadline, in exactly one of:
+
+  * correct completion — the workload validates its numbers internally,
+    so a silently wrong answer fails the run (zero silent corruption);
+  * a STRUCTURED failure — PeerFailedError / TaskRetryExhausted
+    somewhere in the collected per-rank tracebacks (kill plans
+    additionally require the SURVIVOR to report PeerFailedError).
+
+A run that neither completes nor errors before the harness deadline is
+a HANG — the one outcome the robustness layer exists to abolish.
+
+Usage:
+    python tools/chaos.py --seeds 12            # the acceptance run
+    python tools/chaos.py --seeds 3 --quick     # premerge smoke
+    python tools/chaos.py --list                # show the plan catalog
+
+Each seed rotates through the plan catalog (drop/dup/delay/trunc frame
+faults, hard-close and silent-hang rank kills, transient task faults
+with and without retry budget) over two workloads: a 2-rank tiled potrf
+(PTG/dataflow path, rendezvous traffic forced via a small eager limit)
+and a 2-rank DTD increment chain (lane/surrogate path, exact-value
+check).  The fault plan reaches the spawned ranks through
+``PARSEC_MCA_FAULT_PLAN`` in the environment (utils/faultinject.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# workloads (module-level: spawn pickling)
+# ---------------------------------------------------------------------------
+
+def _wait_s() -> float:
+    return float(os.environ.get("PARSEC_CHAOS_WAIT_S", "60"))
+
+
+def potrf_workload(ctx, rank, nranks):
+    """2-rank tiled Cholesky with an internal numerical check — the
+    PTG/remote-dep path (activations, rendezvous, writebacks)."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    n, mb = 96, 16
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, nodes=nranks,
+                          myrank=rank, name="A")
+    for m, nn in A.local_tiles():
+        np.asarray(A.data_of(m, nn).copy_on(0).payload)[:] = \
+            spd[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
+    ctx.add_taskpool(potrf_taskpool(A, device="cpu"))
+    ctx.wait(timeout=_wait_s())
+    # every rank knows the full answer (same seed): validate the LOCAL
+    # tiles — a silently wrong tile fails ITS rank
+    Lref = np.linalg.cholesky(spd.astype(np.float64))
+    for m, nn in A.local_tiles():
+        if nn > m:
+            continue
+        got = np.asarray(A.data_of(m, nn).pull_to_host().payload,
+                         dtype=np.float64)
+        ref = Lref[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
+        if m == nn:
+            got, ref = np.tril(got), np.tril(ref)
+        np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+    return "ok"
+
+
+def dtd_chain_workload(ctx, rank, nranks):
+    """2-rank DTD increment chain bouncing between ranks — the
+    lane/surrogate path, with an EXACT final-value check."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, DTDTaskpool
+
+    steps = 40
+    V = VectorTwoDimCyclic(mb=4, lm=4, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 0.0
+    tp = DTDTaskpool("chaos-chain")
+    ctx.add_taskpool(tp)
+    ctx.start()
+    t = tp.tile_of(V, 0)
+    for i in range(steps):
+        tp.insert_task(lambda T: T + 1.0, (t, INOUT),
+                       (i % nranks, AFFINITY))
+    tp.wait(timeout=_wait_s())
+    ctx.wait(timeout=_wait_s())
+    if rank == 0:
+        val = np.asarray(V.data_of(0).pull_to_host().payload)
+        np.testing.assert_allclose(val, float(steps))
+    return "ok"
+
+
+WORKLOADS = {"potrf": potrf_workload, "dtd": dtd_chain_workload}
+
+#: (name, plan template, workload, expected outcome, extra env).
+#: {s} is the seed.  Expected outcomes:
+#:   complete     both ranks return "ok" (numbers validated in-worker)
+#:   peer-failed  >= 1 rank reports a structured PeerFailedError
+#:   task-failed  >= 1 rank reports TaskRetryExhausted
+CATALOG = [
+    ("delay-frames",
+     "seed={s};delay_frame=tag:ACT,p=0.4,ms=40;"
+     "delay_frame=tag:DTD,p=0.4,ms=40",
+     "dtd", "complete", {}),
+    ("delay-v0",
+     "seed={s};delay_frame=tag:DTD,pm='ver': 0,ms=800",
+     "dtd", "complete", {}),
+    ("dup-frames",
+     "seed={s};dup_frame=tag:ACT,p=0.5;dup_frame=tag:DTD,p=0.5",
+     "dtd", "complete", {}),
+    ("dup-potrf",
+     "seed={s};dup_frame=tag:ACT,p=0.5;dup_frame=tag:GET_REQ,p=0.5",
+     "potrf", "complete", {}),
+    ("drop-getrep",
+     "seed={s};drop_frame=tag:GET_REP,p=0.5,n=3",
+     "potrf", "complete",
+     {"PARSEC_MCA_COMM_EAGER_LIMIT": "512",
+      "PARSEC_MCA_COMM_ADAPTIVE_EAGER": "0",
+      "PARSEC_MCA_COMM_RDV_RETRY_S": "0.5"}),
+    ("trunc-act",
+     "seed={s};trunc_frame=tag:ACT,n=1",
+     "potrf", "peer-failed", {}),
+    ("kill-close",
+     "seed={s};kill_rank=1@t+1.2s,mode=close;"
+     "delay_frame=tag:DTD,p=1,ms=60",
+     "dtd", "peer-failed", {"PARSEC_CHAOS_WAIT_S": "30"}),
+    ("kill-hang",
+     "seed={s};kill_rank=1@t+1.2s,mode=hang;"
+     "delay_frame=tag:DTD,p=1,ms=60",
+     "dtd", "peer-failed",
+     {"PARSEC_CHAOS_WAIT_S": "25",
+      "PARSEC_MCA_COMM_PEER_TIMEOUT_S": "2"}),
+    ("fail-task-retry",
+     "seed={s};fail_task=p=0.25,n=6",
+     "potrf", "complete", {"PARSEC_MCA_TASK_RETRY_MAX": "8"}),
+    ("fail-task-exhaust",
+     "seed={s};fail_task=key~POTRF(k=0),n=3",
+     "potrf", "task-failed", {"PARSEC_MCA_TASK_RETRY_MAX": "1"}),
+]
+
+_QUICK = ("delay-v0", "kill-close", "fail-task-retry")
+
+_CHAOS_ENV = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
+              "PARSEC_MCA_COMM_PEER_TIMEOUT_S",
+              "PARSEC_MCA_TASK_RETRY_MAX",
+              "PARSEC_MCA_COMM_EAGER_LIMIT",
+              "PARSEC_MCA_COMM_ADAPTIVE_EAGER",
+              "PARSEC_MCA_COMM_RDV_RETRY_S")
+
+
+def run_case(name, plan, workload, expect, env, timeout):
+    """One seeded plan against one workload; returns (ok, outcome,
+    detail)."""
+    from parsec_tpu.comm.launch import run_distributed
+
+    saved = {k: os.environ.get(k) for k in _CHAOS_ENV}
+    os.environ["PARSEC_MCA_FAULT_PLAN"] = plan
+    os.environ.update(env)
+    try:
+        try:
+            res = run_distributed(WORKLOADS[workload], 2, timeout=timeout)
+            outcome, detail = "complete", repr(res)
+        except TimeoutError as exc:
+            # the harness deadline fired with ranks unreported: a HANG —
+            # the invariant violation this tool exists to catch
+            outcome, detail = "hang", str(exc)[:300]
+        except RuntimeError as exc:
+            # one structured failure commonly cascades (a rank failing
+            # its pool tears its engine down, the PEER then reports the
+            # death): classify by which structured markers appear, with
+            # the EXPECTED one winning when present
+            text = str(exc)
+            found = [m for m, marker in
+                     (("task-failed", "TaskRetryExhausted"),
+                      ("peer-failed", "PeerFailedError"))
+                     if marker in text]
+            if expect in found:
+                outcome = expect
+            else:
+                outcome = found[0] if found else "error"
+            detail = text[:400]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return outcome == expect, outcome, detail
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=12,
+                    help="seeded plan runs (rotating over the catalog)")
+    ap.add_argument("--quick", action="store_true",
+                    help="premerge smoke: only the quick catalog subset")
+    ap.add_argument("--timeout", type=float, default=90.0,
+                    help="per-run harness deadline (hang detector)")
+    ap.add_argument("--only", default="",
+                    help="comma-separated catalog entry names")
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args(argv)
+
+    catalog = CATALOG
+    if args.quick:
+        catalog = [c for c in CATALOG if c[0] in _QUICK]
+    if args.only:
+        keep = set(args.only.split(","))
+        catalog = [c for c in CATALOG if c[0] in keep]
+    if args.list:
+        for name, plan, wl, expect, env in catalog:
+            print(f"{name:20s} [{wl}] expect={expect}  {plan}")
+        return 0
+
+    failures = 0
+    for i in range(args.seeds):
+        name, plan_t, wl, expect, env = catalog[i % len(catalog)]
+        plan = plan_t.format(s=i + 1)
+        t0 = time.monotonic()
+        ok, outcome, detail = run_case(name, plan, wl, expect, env,
+                                       args.timeout)
+        dt = time.monotonic() - t0
+        status = "PASS" if ok else "FAIL"
+        print(f"[{status}] seed={i + 1} {name:20s} [{wl}] "
+              f"expect={expect} got={outcome} ({dt:.1f}s)", flush=True)
+        if not ok:
+            failures += 1
+            print(f"       {detail}", flush=True)
+    print(f"chaos: {args.seeds - failures}/{args.seeds} plans held the "
+          "no-hang invariant")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

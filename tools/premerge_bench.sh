@@ -19,6 +19,12 @@
 # cost staying ~0 is covered by the default tasks probe itself: its
 # task_throughput gates against the last driver artifact above.
 #
+# r8 adds the CHAOS smoke: a seeded subset of tools/chaos.py fault
+# plans (delayed v0 DTD payload, hard rank kill, transient task faults
+# with retry) asserting the no-hang invariant — every run completes
+# correctly or fails with a structured error within its deadline.  The
+# full catalog is `python tools/chaos.py --seeds 12`.
+#
 # Usage:  sh tools/premerge_bench.sh [threshold] [trace_bound]
 #         threshold:   relative regression that fails (default 0.15)
 #         trace_bound: max tracing-on slowdown of tasks/s (default 0.50)
@@ -75,4 +81,8 @@ else
     rc=1
 fi
 rm -f "$tasks_off" "$on"
+echo "== premerge probe: chaos (seeded fault plans, no-hang invariant) =="
+if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 3 --quick; then
+    rc=1
+fi
 exit $rc
