@@ -272,18 +272,21 @@ class CommEngine:
         self.rank = rank
         self.nranks = nranks
         #: registered memory regions: id -> writable numpy view
-        #: (reference: memory registration handles of ce.mem_register)
+        #: (reference: memory registration handles of ce.mem_register;
+        #: guarded-by: _reg_lock)
         self._regions: Dict[int, Any] = {}
-        self._once_regions: Dict[int, float] = {}   # rid -> registered-at
-        self._region_seq = 0
+        self._once_regions: Dict[int, float] = {}   # guarded-by: _reg_lock
+        self._region_seq = 0                        # guarded-by: _reg_lock
         self._reg_lock = threading.Lock()
         #: completion callbacks of outstanding one-sided ops
+        #: (guarded-by: _reg_lock)
         self._osc: Dict[int, Callable] = {}
-        self._osc_seq = 0
-        self._callbacks: Dict[int, Callable] = {}
+        self._osc_seq = 0                           # guarded-by: _reg_lock
+        self._callbacks: Dict[int, Callable] = {}   # guarded-by: _cb_lock
         #: messages for tags nobody registered yet — replayed on register
         #: (the reference posts persistent recvs per tag at init; here a
-        #: peer may send before this rank finishes wiring its handlers)
+        #: peer may send before this rank finishes wiring its handlers;
+        #: guarded-by: _cb_lock)
         self._undelivered: Dict[int, List] = {}
         self._cb_lock = threading.Lock()
         # message counters (engine-level stats; the remote-dep layer keeps
@@ -295,10 +298,10 @@ class CommEngine:
         # reference: ce.sync) — shared by every transport
         self._bar_lock = threading.Lock()
         self._bar_cond = threading.Condition(self._bar_lock)
-        self._bar_gen = 0
-        self._bar_arrived: Dict[int, int] = {}
-        self._bar_released: set = set()
-        self._bar_aborted: set = set()
+        self._bar_gen = 0                        # guarded-by: _bar_cond
+        self._bar_arrived: Dict[int, int] = {}   # guarded-by: _bar_cond
+        self._bar_released: set = set()          # guarded-by: _bar_cond
+        self._bar_aborted: set = set()           # guarded-by: _bar_cond
         # registered HERE, next to the state it serves: a transport
         # that forgot the registration would hang every barrier to its
         # timeout with nothing pointing at the cause
@@ -307,10 +310,10 @@ class CommEngine:
         #: {offset (clock_peer - clock_mine, perf_counter seconds),
         #:  rtt, drift (s/s), measured_at (monotonic)} — fed by the
         #: TAG_CLOCK ping exchange, re-probed periodically by the
-        #: remote-dep progress/event loop
+        #: remote-dep progress/event loop (guarded-by: _clock_lock)
         self.clock: Dict[int, Dict[str, float]] = {}
         self._clock_lock = threading.Lock()
-        self._clock_pend: Dict[int, List] = {}
+        self._clock_pend: Dict[int, List] = {}   # guarded-by: _clock_lock
         self.tag_register(TAG_CLOCK, self._clock_cb)
         #: set by the remote-dep layer: fatal handler errors fail the rank
         #: fast instead of silently dropping the message
@@ -359,6 +362,7 @@ class CommEngine:
 
     # -- collective: flat barrier, generation-numbered (gather-to-0 +
     # release; reference: ce.sync) --------------------------------------
+    # lint: on-loop (AM callback: runs on the comm loop/recv thread)
     def _barrier_cb(self, src: int, payload: Any) -> None:
         kind, gen = payload
         with self._bar_cond:
@@ -371,8 +375,12 @@ class CommEngine:
             self._bar_cond.notify_all()
 
     def barrier(self, timeout: float = 60.0) -> None:
-        self._bar_gen += 1
-        gen = self._bar_gen
+        with self._bar_cond:
+            # under the lock: two app threads racing barrier() must not
+            # read the same generation number (found by PCL-LOCK when
+            # the guarded-by annotations landed)
+            self._bar_gen += 1
+            gen = self._bar_gen
         if self.nranks == 1:
             return
         with self._bar_cond:
@@ -453,6 +461,7 @@ class CommEngine:
                 self._bar_aborted.discard(gen)
 
     # -- clock alignment (causal traces): Cristian-style ping exchange --
+    # lint: on-loop (periodic hook on the comm loop/progress thread)
     def probe_clocks(self, samples: Optional[int] = None) -> None:
         """Fire one offset-probe round at every live peer: ``samples``
         pings whose pongs fold into ``self.clock`` asynchronously (the
@@ -474,6 +483,7 @@ class CommEngine:
                 except OSError:
                     break
 
+    # lint: on-loop (AM callback)
     def _clock_cb(self, src: int, msg: dict) -> None:
         if msg.get("k") == "ping":
             try:
@@ -525,6 +535,7 @@ class CommEngine:
             return {r: dict(st) for r, st in self.clock.items()}
 
     # -- active failure detection: heartbeats + silence timeout ---------
+    # lint: on-loop (AM callback)
     def _hb_cb(self, src: int, payload: Any) -> None:
         pass   # receipt alone is the signal (_note_heard at the framer)
 
@@ -532,6 +543,7 @@ class CommEngine:
         if src is not None:
             self._last_heard[src] = time.monotonic()
 
+    # lint: on-loop (periodic hook)
     def heartbeat_tick(self) -> None:
         """One heartbeat round at every live peer; rides the control
         lane so it measures protocol liveness, not bulk-queue depth.
@@ -556,6 +568,7 @@ class CommEngine:
         very hang it exists to catch."""
         self.send_am(TAG_HB, r, None)
 
+    # lint: on-loop (periodic hook)
     def check_peer_timeouts(self) -> None:
         """Declare peers silent past ``comm_peer_timeout_s`` dead — the
         detector for HUNG peers, whose sockets never close.  A starved
@@ -697,6 +710,45 @@ class CommEngine:
     def _send_raw_parts(self, dst: int, parts: List[Any]) -> None:
         raise NotImplementedError
 
+    def _recv_fault_hold(self, tag: int, src: int, payload: Any) -> bool:
+        """Recv-side delay injection (utils/faultinject ``delay_recv``):
+        hold a just-received, already-decoded frame for its directive's
+        ``ms`` while LATER frames — same peer and others — dispatch
+        first.  This is reorder coverage the send-side ``delay_frame``
+        cannot provide: TCP delivers each stream in order, so only a
+        post-framing hold reorders the RECEIVE path.  Returns True when
+        the frame was consumed (redelivery is scheduled); callers then
+        skip their normal dispatch.  Counters stay honest: the frame
+        was received (frames_recv already bumped), and the handler-side
+        Safra credit lands at the delayed dispatch — the in-flight
+        window is visible to the termination balance."""
+        f = self._fault
+        if f is None:
+            return False
+        ms = f.recv_delay_ms(tag, src, payload)
+        if ms is None:
+            return False
+        debug_verbose(3, "rank %d: FAULT delay_recv tag=%d src=%d ms=%g",
+                      self.rank, tag, src, ms)
+        t = threading.Timer(ms * 1e-3, self._deliver_held,
+                            args=(tag, src, payload))
+        t.daemon = True
+        t.start()
+        return True
+
+    def _deliver_held(self, tag: int, src: int, payload: Any) -> None:
+        """Timer-thread redelivery of a held frame.  Fine as-is on the
+        threaded transport (handlers already run on per-peer recv
+        threads); the funnelled event loop overrides to re-post onto
+        its loop thread."""
+        try:
+            self._dispatch(tag, src, payload)
+        except Exception as exc:
+            warning("rank %d: held-frame handler tag=%d failed: %s",
+                    self.rank, tag, exc)
+            if self.on_error is not None:
+                self.on_error(exc)
+
     # -- pack/unpack (reference: ce.pack/unpack) ------------------------
     @staticmethod
     def pack(arr) -> dict:
@@ -803,6 +855,7 @@ class CommEngine:
         waiter."""
         self.send_am(TAG_GET1_REP, dst, {"op": op, "error": why})
 
+    # lint: on-loop (AM callback)
     def _put_cb(self, src: int, msg: dict) -> None:
         import numpy as np
         # hold the lock across the copy: concurrent put/get on one
@@ -828,6 +881,7 @@ class CommEngine:
         self.send_am(TAG_GET1_REP, msg["from"],
                      {"op": msg["op"], "ack": True})
 
+    # lint: on-loop (AM callback)
     def _get1_cb(self, src: int, msg: dict) -> None:
         with self._reg_lock:
             target = self._regions.get(msg["rid"])
@@ -843,6 +897,7 @@ class CommEngine:
         self.send_am(TAG_GET1_REP, msg["from"],
                      {"op": msg["op"], **packed})
 
+    # lint: on-loop (AM callback)
     def _get1_rep_cb(self, src: int, msg: dict) -> None:
         with self._reg_lock:
             ent = self._osc.pop(msg["op"], None)
@@ -887,8 +942,11 @@ class SocketCE(CommEngine):
             raise ValueError(
                 f"comm_hosts names {len(self._hosts)} hosts for "
                 f"{nranks} ranks")
+        #: canonical peer sockets + per-peer send serialization; both
+        #: resized by accept/connect/death paths on different threads
+        #: (guarded-by: _plock)
         self._peers: Dict[int, socket.socket] = {}
-        self._send_locks: Dict[int, threading.Lock] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}  # guarded-by: _plock
         self._plock = threading.Lock()
         self._stop = False
         self._threads: List[threading.Thread] = []
@@ -1089,6 +1147,9 @@ class SocketCE(CommEngine):
                 self._peer_corrupt(src, conn,
                                    f"undecodable frame tag={tag}: {exc}")
                 return
+            if self._fault is not None and \
+                    self._recv_fault_hold(tag, src, payload):
+                continue   # redelivery scheduled; later frames flow
             try:
                 self._dispatch(tag, src, payload)
             except Exception as exc:   # handler error must not kill recv,
@@ -1561,7 +1622,9 @@ class EventLoopCE(CommEngine):
                 return
             for p in pending:
                 self._flush(p)
-            time.sleep(0.002)
+            # post-stop bounded drain: the loop is already exiting and
+            # nothing else runs on this thread
+            time.sleep(0.002)   # lint: allow-blocking (teardown drain)
 
     def _next_timeout(self) -> float:
         if not self._timers:
@@ -1599,7 +1662,7 @@ class EventLoopCE(CommEngine):
                     detector="connect"))
 
     # -- connection management ------------------------------------------
-    def _dial(self, dst: int) -> None:
+    def _dial(self, dst: int) -> None:   # lint: off-loop (init thread)
         """Blocking connect + handshake (init thread), then hand the
         socket to the loop."""
         peer_host = self._hosts[dst] if self._hosts else "127.0.0.1"
@@ -2050,6 +2113,9 @@ class EventLoopCE(CommEngine):
                 return False
         else:
             payload = None
+        if self._fault is not None and \
+                self._recv_fault_hold(tag, src, payload):
+            return peer.sock is not None   # redelivery scheduled
         self._safe_dispatch(tag, src, payload)
         return peer.sock is not None
 
@@ -2061,6 +2127,12 @@ class EventLoopCE(CommEngine):
                     self.rank, tag, exc)
             if self.on_error is not None:   # ...but must fail the rank
                 self.on_error(exc)
+
+    def _deliver_held(self, tag: int, src: int, payload: Any) -> None:
+        # funnelled contract: handlers run ONLY on the loop thread — a
+        # Timer-thread dispatch (the base-class redelivery) would race
+        # every lock-free structure the loop owns
+        self._post(("call", self._safe_dispatch, (tag, src, payload)))
 
     def _eof(self, peer: _EvPeer) -> None:
         if peer.r_stage == _ST_HDR and peer.r_got == 0:

@@ -151,29 +151,17 @@ class IciEngine:
         data movement; return {space: on-device array} for the requested
         targets (reference: the dataflow bcast trees, remote_dep.c:334-357
         — here the tree is the interconnect's native replication)."""
-        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from parsec_tpu.devices.xla import device_put_replicated_private
         want = set(dst_spaces)
         sharding = NamedSharding(self.mesh(), P())   # fully replicated
-        rep = jax.device_put(payload, sharding)
         # the replicated "copies" must be PRIVATE: on the CPU client the
         # shard co-located with the host buffer can alias it (the same
         # r8 wrong-R hazard device_put_private closes for put/stage-in)
         # — a later in-place mutation or donation of the source would
         # corrupt every consumer's tile
-        try:
-            sptr = payload.unsafe_buffer_pointer()
-        except Exception:
-            iface = getattr(payload, "__array_interface__", None)
-            sptr = iface["data"][0] if iface is not None else None
-        if sptr is not None:
-            try:
-                aliased = any(s.data.unsafe_buffer_pointer() == sptr
-                              for s in rep.addressable_shards)
-            except Exception:
-                aliased = False   # probe unsupported: transfers copy
-            if aliased:
-                rep = jax.device_put(np.asarray(payload).copy(), sharding)
+        rep = device_put_replicated_private(payload, sharding)
         out: Dict[int, Any] = {}
         by_jdev = {jd: sp for sp, jd in self._jdev.items()}
         for shard in rep.addressable_shards:
@@ -242,12 +230,16 @@ class IciEngine:
         for a in srcs.values():
             dtype = a.dtype
             break
+        from parsec_tpu.devices.xla import device_put_private
         shards = []
         for i, dev in enumerate(self.xla_devices):
             a = srcs.get(i)
             if a is None:
                 a = jnp.zeros(shape, dtype)
-            a = jax.device_put(a, dev.jdev)
+            # PRIVATE stage-in: ``a`` is a producer's live tile — a
+            # zero-copy device_put alias would let a concurrent donation
+            # of the source corrupt the program's input mid-permute
+            a = device_put_private(a, dev.jdev)
             shards.append(jnp.reshape(a, (1,) + shape))
         sharding = NamedSharding(mesh, P("d"))
         x = jax.make_array_from_single_device_arrays(
@@ -430,10 +422,18 @@ class IciEngine:
                 immediate = True
             else:
                 self._pending_edges.append((copy, space, now))
+                # flush when the batch completes a permutation round —
+                # OR when the oldest deferred edge has already outlived
+                # the window (under load the gaps between wavefront
+                # siblings stretch past it; without the age trigger the
+                # batch would sit until an idle worker happens by,
+                # losing every version race to lazy stage-in — the
+                # "wavefront permute did not fire" flake, ~1/7 loaded)
                 full_round = any(
                     e[0].device == copy.device or e[1] == space
                     for e in self._pending_edges[:-1]) \
-                    or len(self._pending_edges) >= self.ndev - 1
+                    or len(self._pending_edges) >= self.ndev - 1 \
+                    or now - self._pending_edges[0][2] >= window
                 if full_round:
                     flush_now, self._pending_edges = self._pending_edges, []
             self._last_edge = now

@@ -158,27 +158,30 @@ class RemoteDepEngine:
         self.nranks = ce.nranks
         self.eager = int(params.get("comm_eager_limit", 65536))
         self.bcast = params.get("comm_coll_bcast", "binomial")
+        #: rendezvous handle table (guarded-by: _hlock)
         self._handles: Dict[int, _Handle] = {}
         self._hlock = threading.Lock()
         #: activations buffered during one task's release_deps
+        #: (guarded-by: _outbox_lock)
         self._outbox: Dict[int, List] = {}
         self._outbox_lock = threading.Lock()
         #: activations for taskpools not yet registered locally
+        #: (guarded-by: _dlock)
         self._delayed: List[Tuple[int, dict]] = []
         self._dlock = threading.Lock()
         # Safra token state (reference counterpart: termdet fourcounter).
         # Only ACTIVATE/GET traffic counts toward the balance; token and
         # barrier messages are part of the detection algorithm itself.
-        self._color_black = False
+        self._color_black = False           # guarded-by: _term_lock
         self._term_lock = threading.Lock()
         self._terminated = threading.Event()
-        self._app_sent = 0
-        self._app_recv = 0
-        self._retry_pending = False
+        self._app_sent = 0                  # guarded-by: _term_lock
+        self._app_recv = 0                  # guarded-by: _term_lock
+        self._retry_pending = False         # guarded-by: _dlock
         #: dynamic taskpools holding a runtime action until the
         #: pool-scoped quiescence round proves global drain (the
         #: reference's dynamic/fourcounter termdet role for
-        #: %option dynamic pools)
+        #: %option dynamic pools; guarded-by: _term_lock)
         self._dyn_holds: List = []
         self._dyn_released = threading.Event()
         ce.on_error = self._on_handler_error
@@ -199,7 +202,8 @@ class RemoteDepEngine:
         #: causal tracer (prof/causal.py), attached by its install();
         #: None = zero tracing work on every send/recv path
         self.tracer = None
-        #: protocol counters (exported through stats() -> bench bw/rtt)
+        #: protocol counters (exported through stats() -> bench bw/rtt;
+        #: guarded-by: _proto_lock)
         self.proto: Dict[str, int] = {
             "act_eager": 0, "act_rdv": 0, "act_inline": 0,
             "eager_bytes": 0, "rdv_bytes": 0,
@@ -207,6 +211,7 @@ class RemoteDepEngine:
             "eager_downshift": 0, "eager_upshift": 0,
         }
         #: per-peer adaptive eager state: dst -> {"eager": cur, "base":..}
+        #: (guarded-by: _proto_lock)
         self._proto_peer: Dict[int, Dict[str, int]] = {}
         # adaptive-law constants cached off the task-retire hot path
         # (each params.get is a registry-lock round trip); the
@@ -221,9 +226,10 @@ class RemoteDepEngine:
         #: flush_activations runs concurrently on every worker stream
         self._proto_lock = threading.Lock()
         #: cross-task flush window: dst -> [(tag, msg), ...]
+        #: (guarded-by: _flush_lock)
         self._flushbox: Dict[int, List] = {}
         self._flush_lock = threading.Lock()
-        self._flush_deadline: Optional[float] = None
+        self._flush_deadline: Optional[float] = None  # guarded-by: _flush_lock
         # Progress model (reference: the comm thread + dep_cmd_queue,
         # remote_dep_mpi.c:461-503).  On a FUNNELLED transport (evloop)
         # the dep-engine work runs directly on the transport's single
@@ -253,9 +259,11 @@ class RemoteDepEngine:
         #: pending GET completions: handle -> (tp_id, deliveries)
         self._pending_gets: Dict[Tuple[int, int], dict] = {}
         #: DTD messages that raced their pool's registration on this rank
+        #: (guarded-by: _dlock)
         self._dtd_backlog: Dict[int, List] = {}
         #: outstanding DTD rendezvous pulls (Safra-visible in-flight work:
-        #: the one-sided GET itself rides uncounted CE messages)
+        #: the one-sided GET itself rides uncounted CE messages;
+        #: guarded-by: _term_lock)
         self.dtd_refs_pending = 0
         self._recv_handlers = {
             "activate": self._activate_cb,
@@ -314,6 +322,7 @@ class RemoteDepEngine:
             self._cmdq.put(("recv", kind, src, msg))
         return cb
 
+    # lint: on-loop (AM handler)
     def _batch_cb(self, src: int, msgs: List) -> None:
         """Unpack an aggregated frame into individual commands."""
         for tag, payload in msgs:
@@ -330,6 +339,7 @@ class RemoteDepEngine:
                 except OSError:
                     pass   # dead peer; its loss is already routed
 
+    # lint: on-loop (AM handler)
     def _utrig_cb(self, src: int, msg: dict) -> None:
         tp = self.context.taskpools.get(msg["tp"])
         if tp is None or tp.termdet is None:
@@ -363,16 +373,18 @@ class RemoteDepEngine:
             self._cmdq.put(("memcpy", dst_copy, src_copy))
 
     @staticmethod
+    # lint: on-loop (posted onto the comm loop by memcpy_shift)
     def _do_memcpy(dst_copy, src_copy) -> None:
         np.copyto(np.asarray(dst_copy.payload), np.asarray(src_copy.payload))
 
+    # lint: on-loop (periodic hook on the evloop thread)
     def _purge_stale_handles(self) -> None:
         """GC rendezvous handles no receiver ever pulled (reference gap
         closed: refcounted handles with no timeout would leak if a rank
         in the bcast tree dies or the eager race skips its GET).  Fully
         served handles linger for a short grace (dead_at) so a
         retransmitted GET_REQ can be re-served idempotently."""
-        ttl = float(params.get("comm_handle_timeout", 120.0))
+        ttl = float(params.get("comm_handle_timeout", 600.0))
         now = time.monotonic()
         stale = []
         with self._hlock:
@@ -505,6 +517,7 @@ class RemoteDepEngine:
             self._seen_fids.discard(self._fid_order.popleft())
         return False
 
+    # lint: on-loop (periodic hook)
     def _retry_rendezvous(self) -> None:
         """Bounded retry with exponential backoff for parked rendezvous
         pulls, and a terminal deadline: a GET whose source died or never
@@ -544,7 +557,9 @@ class RemoteDepEngine:
                     self._send_app(TAG_GET_REQ, root,
                                    {"handle": handle, "from": self.rank})
                 except (PeerFailedError, OSError):
-                    pass   # the next sweep sees dead_peers
+                    # lint: contained (the next sweep sees dead_peers
+                    # and fails the pull's pool with the terminal error)
+                    pass
 
     def _on_peer_dead(self, rank: int, exc: Exception) -> None:
         """Containment: a dead peer fails the taskpools that TOUCH it —
@@ -708,6 +723,7 @@ class RemoteDepEngine:
                     # drain does the same per child)
                     self.context.record_pool_error(tp, exc)
 
+    # lint: on-loop (periodic hook + opportunistic worker calls)
     def _drain_flush_window(self, force: bool = False) -> None:
         """Ship the cross-task flush window once its deadline passed
         (driven by the transport's periodic hook / the progress loop)."""
@@ -932,6 +948,7 @@ class RemoteDepEngine:
             self._color_black = True   # Safra: receiving blackens
             self._app_recv += 1
 
+    # lint: on-loop (AM handler: runs in place on the evloop thread)
     def _activate_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_ACTIVATE, src, msg)
         self._on_app_recv()   # exactly once per wire message
@@ -1005,6 +1022,7 @@ class RemoteDepEngine:
                 self._pending_gets.pop(key, None)
                 self.context.record_pool_error(tp, exc)
 
+    # lint: on-loop (AM handler)
     def _get_req_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_GET_REQ, src, msg)
         self._on_app_recv()
@@ -1024,6 +1042,8 @@ class RemoteDepEngine:
                                {"handle": h, "miss": True,
                                 "root": self.rank})
             except PeerFailedError:
+                # lint: contained (the requester died; its death was
+                # already routed into the pools that touch it)
                 pass
             return
         buf, dt, shape = handle.data
@@ -1032,7 +1052,9 @@ class RemoteDepEngine:
                            {"handle": h, "buf": buf, "dtype": dt,
                             "shape": shape, "root": self.rank})
         except PeerFailedError:
-            return   # requester died; keep the handle for live readers
+            # lint: contained (requester died — its death was already
+            # routed; keep the handle for live readers)
+            return
         with handle.lock:
             # fully-served handles LINGER (dead_at) for a grace period
             # instead of dropping instantly: a retransmitted GET_REQ
@@ -1066,6 +1088,7 @@ class RemoteDepEngine:
         with self._term_lock:
             self.dtd_refs_pending -= 1
 
+    # lint: on-loop (AM handler)
     def _dtd_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_DTD, src, msg)
         # For rendezvous refs the pending-pull count must become visible
@@ -1105,6 +1128,7 @@ class RemoteDepEngine:
         for src, msg in backlog:
             tp._dtd_incoming(src, msg)
 
+    # lint: on-loop (AM handler)
     def _get_rep_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_GET_REP, src, msg)
         self._on_app_recv()
@@ -1197,6 +1221,7 @@ class RemoteDepEngine:
         with self._term_lock:
             return self._app_sent - self._app_recv
 
+    # lint: on-loop (AM handler)
     def _termdet_cb(self, src: int, msg: dict) -> None:
         kind = msg.get("kind")
         if kind == "terminate":

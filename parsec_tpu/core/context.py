@@ -78,16 +78,16 @@ class Context:
         self.nranks = nranks
         self.nb_cores = nb_cores if nb_cores is not None \
             else params.get("runtime_num_cores", 4)
-        self.finished = False
+        self.finished = False                 # guarded-by: _lock, _cond
         self._lock = threading.RLock()
-        self._cond = threading.Condition(self._lock)
-        self._active_taskpools = 0
-        self._pending_start: List[Taskpool] = []
+        self._cond = threading.Condition(self._lock)   # same RLock
+        self._active_taskpools = 0            # guarded-by: _lock, _cond
+        self._pending_start: List[Taskpool] = []   # guarded-by: _lock, _cond
         #: taskpool_id -> taskpool; kept after completion so late remote
         #: messages (GET serving) still resolve (reference: taskpool
-        #: registry hash, parsec_internal.h)
+        #: registry hash, parsec_internal.h; guarded-by: _lock, _cond)
         self.taskpools: dict = {}
-        self._errors: List[tuple] = []
+        self._errors: List[tuple] = []        # guarded-by: _lock, _cond
         self._pins = {}
         self.comm = None               # comm engine (distributed layer)
         self.grapher = None            # DOT grapher (prof layer)

@@ -474,7 +474,7 @@ def _chain_jitted(key, node_specs, node_descs, wave_spec, wave_descs,
         return _chain_jit_cache.setdefault(key, jf)
 
 
-def device_put_private(payload, jdev):
+def device_put_private(payload, jdev):   # lint: alias-wrapper
     """``jax.device_put`` that GUARANTEES a private buffer.
 
     On the CPU client (virtual multi-device meshes, tests, the dryrun)
@@ -493,16 +493,43 @@ def device_put_private(payload, jdev):
         optr = out.unsafe_buffer_pointer()
     except Exception:
         return out   # probe unsupported on this backend: transfers copy
-    sptr = None
-    try:
-        sptr = payload.unsafe_buffer_pointer()
-    except Exception:
-        iface = getattr(payload, "__array_interface__", None)
-        if iface is not None:
-            sptr = iface["data"][0]
+    sptr = _source_pointer(payload)
     if sptr is not None and optr == sptr:
         out = jax.device_put(np.asarray(payload).copy(), jdev)
     return out
+
+
+def _source_pointer(payload):
+    """Best-effort raw buffer pointer of a host/device payload (the
+    alias probe shared by the private-put wrappers)."""
+    try:
+        return payload.unsafe_buffer_pointer()
+    except Exception:
+        iface = getattr(payload, "__array_interface__", None)
+        return iface["data"][0] if iface is not None else None
+
+
+def device_put_replicated_private(payload, sharding):   # lint: alias-wrapper
+    """``jax.device_put`` onto a (replicating) sharding that GUARANTEES
+    no shard aliases the source buffer — the multi-device sibling of
+    :func:`device_put_private`.  On the CPU client the shard co-located
+    with the host buffer can alias it, so a later in-place mutation or
+    donation of the source would corrupt every consumer's replica (the
+    same geqrf wrong-R hazard, through the broadcast path).  Real
+    accelerator transfers never alias; there the probe is one pointer
+    compare per shard and the defensive copy never runs."""
+    import jax
+    rep = jax.device_put(payload, sharding)
+    sptr = _source_pointer(payload)
+    if sptr is not None:
+        try:
+            aliased = any(s.data.unsafe_buffer_pointer() == sptr
+                          for s in rep.addressable_shards)
+        except Exception:
+            aliased = False   # probe unsupported: transfers copy
+        if aliased:
+            rep = jax.device_put(np.asarray(payload).copy(), sharding)
+    return rep
 
 
 #: marks an LRU entry as an in-progress adopt claim (distinguishable from
@@ -546,7 +573,7 @@ class XlaDevice(Device):
                                               "rocm"))
         self._chain_donate = self._donate and \
             bool(int(params.get("device_fuse_donate", 0)))
-        self._depth = max(1, int(params.get("device_inflight_depth", 4)))
+        self._depth = max(1, int(params.get("device_inflight_depth", 8)))
         self._runahead = max(self._depth,
                              int(params.get("device_runahead", 256)))
         cap_mb = int(params.get("device_mem_mb", 0))
@@ -1275,7 +1302,8 @@ class XlaDevice(Device):
                 dc = datum.create_copy(self.space)
             shape = copy.payload.shape
             dtype = copy.payload.dtype
-            dc.payload = jax.device_put(
+            dc.payload = jax.device_put(   # lint: private-ok (a fresh
+                # jnp.zeros has no host-side owner to alias)
                 jnp.zeros(shape, dtype=dtype), self.jdev)
             dc.version = copy.version
             datum.transfer_ownership(self.space, access)

@@ -268,7 +268,8 @@ class DTDTaskpool(Taskpool):
     def __init__(self, name: str = "dtd"):
         super().__init__(name=name)
         self._dep_lock = threading.Lock()
-        self._tiles: Dict[Any, DTDTile] = {}
+        self._tiles: Dict[Any, DTDTile] = {}   # guarded-by: _dep_lock, _window
+        #: guarded-by: _dep_lock, _window
         self._tiles_by_wire: Dict[Any, DTDTile] = {}
         #: region-lane byte extents, rid -> tuple of slices (populated
         #: identically on every rank by the SPMD insert stream — the
@@ -283,7 +284,7 @@ class DTDTaskpool(Taskpool):
         self._apply_lock = threading.Lock()
         self._dc_ids: Dict[int, int] = {}
         self._classes: Dict[Any, TaskClass] = {}
-        self._inflight = 0
+        self._inflight = 0                  # guarded-by: _dep_lock, _window
         self._window = threading.Condition(self._dep_lock)
         self._finished = False
         self.window_size = params.get("dtd_window_size", 2048)
@@ -293,10 +294,13 @@ class DTDTaskpool(Taskpool):
         self.nranks = 1
         self._new_seq = itertools.count()
         #: (wire_key, version) -> surrogate awaiting that payload
+        #: (guarded-by: _dep_lock, _window)
         self._expected: Dict[Any, _DTDState] = {}
         #: early-arrived payloads nobody expects yet
+        #: (guarded-by: _dep_lock, _window)
         self._received: Dict[Any, np.ndarray] = {}
         #: inbound tile-flush payloads queued until the local pool drains
+        #: (guarded-by: _dep_lock, _window)
         self._flush_queue: List[Tuple[Any, np.ndarray]] = []
         self._drained = False
         self._recv_tc: Optional[TaskClass] = None
@@ -896,7 +900,7 @@ class DTDTaskpool(Taskpool):
         pred.successors.append(succ)
         succ.remaining += 1
 
-    def _mark_needed(self, d: "_DTDState",
+    def _mark_needed(self, d: "_DTDState",   # holds-lock: _dep_lock
                      to_schedule: List[Task]) -> None:
         """First local consumer of a surrogate's version: make it a real
         (counted, schedulable) task expecting the network payload (caller

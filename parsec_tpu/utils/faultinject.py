@@ -23,6 +23,13 @@ Directives (``;``-separated; fields ``,``-separated):
                   dedup must recover)
 ``delay_frame``   hold a matching frame for ``ms`` before sending
                   (reorders it past later frames — the race amplifier)
+``delay_recv``    hold a matching RECEIVED frame for ``ms`` before
+                  dispatching its handler, while later frames from the
+                  same (and every other) peer flow — reorder coverage
+                  on the RECEIVE path, where send-side delays cannot
+                  reach (a frame reordered by the network arrives
+                  in-order per TCP stream; this reorders AFTER framing).
+                  ``rank=<src>`` scopes to one source rank
 ``trunc_frame``   replace a matching frame with an undecodable one (the
                   receiver severs the connection: wire-corruption path)
 ``kill_rank``     ``<rank>@t+<sec>s`` — at ``sec`` seconds after the
@@ -83,6 +90,9 @@ TAG_NAMES: Dict[str, int] = {
 _APP_TAGS = frozenset((1, 2, 3, 6, 7, 9, 10, 11))
 
 _FRAME_KINDS = ("drop_frame", "dup_frame", "delay_frame", "trunc_frame")
+
+#: receive-side directives (matched at the receiver, after framing)
+_RECV_KINDS = ("delay_recv",)
 
 
 class _Directive:
@@ -194,6 +204,7 @@ class CommFaults:
     def __init__(self, plan: FaultPlan, rank: int):
         self.rng = random.Random(plan.seed + 1000 * rank)
         self.frame_dirs = plan.of_kind(*_FRAME_KINDS)
+        self.recv_dirs = plan.of_kind(*_RECV_KINDS)
         self.kill = next((d for d in plan.of_kind("kill_rank")
                           if d.rank == rank), None)
 
@@ -214,6 +225,27 @@ class CommFaults:
                 text = repr(payload)[:512] if payload is not None else ""
             if d.take(self.rng, text):
                 return (d.kind[:-6], d.ms)   # strip "_frame"
+        return None
+
+    def recv_delay_ms(self, tag: int, src: int,
+                      payload: Any) -> Optional[float]:
+        """First matching ``delay_recv`` directive's hold time for a
+        just-received frame (``rank=`` scopes by SOURCE rank here), or
+        None.  The transport re-delivers the frame after the hold —
+        later frames dispatch first, which is the coverage."""
+        text = None
+        for d in self.recv_dirs:
+            if d.rank is not None and d.rank != src:
+                continue
+            if d.tag is None:
+                if tag not in _APP_TAGS:
+                    continue
+            elif d.tag != tag:
+                continue
+            if d.pm is not None and text is None:
+                text = repr(payload)[:512] if payload is not None else ""
+            if d.take(self.rng, text):
+                return d.ms
         return None
 
 
@@ -275,7 +307,7 @@ def comm_faults(rank: int) -> Optional[CommFaults]:
     if plan is None:
         return None
     cf = CommFaults(plan, rank)
-    if not cf.frame_dirs and cf.kill is None:
+    if not cf.frame_dirs and not cf.recv_dirs and cf.kill is None:
         return None
     return cf
 

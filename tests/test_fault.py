@@ -64,6 +64,62 @@ def test_fault_plan_take_counts_and_determinism():
     assert not faultinject.ARMED
 
 
+def test_delay_recv_parsing_and_matching():
+    """ISSUE 7 satellite: recv-side per-frame delay (reorder coverage
+    on the RECEIVE path — send-side delays cannot reorder what TCP
+    delivers in stream order)."""
+    plan = faultinject.FaultPlan(
+        "seed=4;delay_recv=tag:DTD,p=0.5,ms=120,rank=1")
+    (d,) = plan.directives
+    assert d.kind == "delay_recv" and d.tag == 6 and d.ms == 120.0 \
+        and d.rank == 1 and d.p == 0.5
+    faultinject.arm("seed=4;delay_recv=tag:DTD,n=1,ms=50,rank=1")
+    try:
+        cf = faultinject.comm_faults(0)
+        assert cf is not None and len(cf.recv_dirs) == 1
+        # rank= scopes by SOURCE rank on the receive side
+        assert cf.recv_delay_ms(6, 2, None) is None
+        assert cf.recv_delay_ms(6, 1, None) == 50.0
+        assert cf.recv_delay_ms(6, 1, None) is None   # n=1 consumed
+        # outbound frame directives unaffected by a recv-only plan
+        assert cf.frame_action(6, 1, None) is None
+    finally:
+        faultinject.disarm()
+
+
+def test_delay_recv_reorders_dispatch_on_receive_path():
+    """Two frames sent in order on one TCP stream dispatch REVERSED at
+    the receiver when a delay_recv holds the first — the hook must not
+    stall the loop (later frames flow during the hold), and the held
+    frame's handler still runs (on the loop thread: the funnelled
+    redelivery re-posts instead of dispatching off-thread)."""
+    from parsec_tpu.comm.launch import _probe_port_base
+
+    # WIDE margin (hold 1.2s vs 0.1s send gap): strict-order asserts
+    # with tight margins are exactly the load-sensitive flake class
+    # this repo keeps retiring — the second frame has >1s of slack to
+    # dispatch before the held frame's redelivery timer fires
+    faultinject.arm("seed=1;delay_recv=tag:16,n=1,ms=1200")
+    try:
+        ce0, ce1 = _pair_of_engines(_probe_port_base(2))
+        try:
+            got = []
+            ce1.tag_register(16, lambda src, msg: got.append(msg["i"]))
+            time.sleep(0.3)   # both lanes dialed in
+            ce0.send_am(16, 1, {"i": 1})   # held 1.2s at the receiver
+            time.sleep(0.1)
+            ce0.send_am(16, 1, {"i": 2})   # flows past the held frame
+            deadline = time.monotonic() + 6.0
+            while len(got) < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert got == [2, 1], got
+        finally:
+            ce0.fini()
+            ce1.fini()
+    finally:
+        faultinject.disarm()
+
+
 def test_unarmed_hooks_are_inert():
     assert faultinject.comm_faults(0) is None
     assert faultinject.runtime() is None

@@ -1,0 +1,546 @@
+"""parseclint pass corpus + clean-tree gate (ISSUE 7).
+
+Each lint pass is exercised against a KNOWN-BAD snippet reproducing the
+historical bug class it encodes — including the exact pre-fix shapes of
+the geqrf ``device_put`` aliasing (r8 wrong-R) and the blocking
+``sendmsg`` heartbeat (PR 5) — plus a known-good twin proving the pass
+accepts the disciplined form.  The final test runs the full analyzer
+over the real tree against the checked-in baseline: zero new findings
+is a tier-1 invariant, which is what wires parseclint into the build.
+"""
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.parseclint import FileCtx, Finding  # noqa: E402
+from tools.parseclint.passes import (assert_hazard, device_put,  # noqa: E402
+                                     evloop_blocking, except_hygiene,
+                                     lock_discipline, mca_knobs)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ctx(src: str, rel: str = "parsec_tpu/comm/snippet.py") -> FileCtx:
+    return FileCtx("/" + rel, rel, textwrap.dedent(src))
+
+
+def _ids(findings):
+    return [f.pass_id for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# PCL-LOCK: guarded-by discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._handles = {}        # guarded-by: _lock
+            self._seq = 0             # guarded-by: _lock
+
+        def method(self):
+            __BODY__
+"""
+
+
+def _lock_findings(body: str):
+    src = _LOCKED_CLASS.replace("__BODY__", body)
+    return lock_discipline.check(_ctx(src))
+
+
+def test_lock_flags_unlocked_write():
+    fs = _lock_findings("self._seq += 1")
+    assert _ids(fs) == ["PCL-LOCK"] and "Engine._seq" in fs[0].message
+
+
+def test_lock_flags_unlocked_container_mutation():
+    assert _lock_findings("self._handles[1] = 2")       # subscript store
+    assert _lock_findings("self._handles.pop(1, None)")  # mutator call
+    assert _lock_findings("del self._handles[1]")        # subscript del
+
+
+def test_lock_accepts_locked_write():
+    assert not _lock_findings(
+        "with self._lock:\n                self._seq += 1\n"
+        "                self._handles[self._seq] = 1")
+
+
+def test_lock_accepts_reads_unlocked():
+    assert not _lock_findings("return self._handles.get(1)")
+
+
+def test_lock_holds_lock_annotation():
+    src = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._seq = 0   # guarded-by: _lock
+
+            def _bump_locked(self):   # holds-lock: _lock
+                self._seq += 1
+    """
+    assert not lock_discipline.check(_ctx(src))
+
+
+def test_lock_condition_alias_either_suffices():
+    """guarded-by: _lock, _cond — the Condition-wrapping-the-same-lock
+    idiom (core/context.py): a write under EITHER passes."""
+    src = """
+        import threading
+
+        class Ctx:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._cond = threading.Condition(self._lock)
+                self._errors = []   # guarded-by: _lock, _cond
+
+            def record(self, exc):
+                with self._cond:
+                    self._errors.append(exc)
+
+            def admit(self):
+                with self._lock:
+                    self._errors.append(None)
+    """
+    assert not lock_discipline.check(_ctx(src))
+
+
+def test_lock_inline_suppression():
+    fs = _lock_findings(
+        "self._seq += 1   # lint: ignore[PCL-LOCK] init-only path")
+    assert not fs
+
+
+def test_lock_subclass_inherits_base_annotations():
+    src = """
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._peers = {}   # guarded-by: _lock
+
+        class Derived(Base):
+            def drop(self, r):
+                self._peers.pop(r, None)
+    """
+    fs = lock_discipline.check(_ctx(src))
+    assert _ids(fs) == ["PCL-LOCK"] and "Derived._peers" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# PCL-EVLOOP: blocking calls reachable from loop callbacks
+# ---------------------------------------------------------------------------
+
+def test_evloop_flags_time_sleep_in_funnelled_method():
+    src = """
+        import time
+
+        class EvCE:
+            FUNNELLED = True
+
+            def _on_timer(self):
+                time.sleep(0.1)
+    """
+    fs = evloop_blocking.check(_ctx(src))
+    assert _ids(fs) == ["PCL-EVLOOP"] and "time.sleep" in fs[0].message
+
+
+def test_evloop_flags_blocking_heartbeat_sendmsg():
+    """The EXACT pre-fix PR 5 shape: the heartbeat path reaches a bare
+    blocking sendmsg — the hung-peer detector wedges behind the very
+    hang it exists to catch.  Reintroducing it must flag."""
+    src = """
+        class EvCE:
+            FUNNELLED = True
+
+            def heartbeat_tick(self):
+                for r in self._peers:
+                    self._hb_send(r)
+
+            def _hb_send(self, r):
+                s = self._peers[r]
+                self._sendmsg_all(s, [b"hb"])
+
+            def _sendmsg_all(self, s, parts):
+                views = [memoryview(p) for p in parts]
+                while views:
+                    sent = s.sendmsg(views)
+                    views = views[1:]
+    """
+    fs = evloop_blocking.check(_ctx(src))
+    assert any("sendmsg" in f.message for f in fs), fs
+
+
+def test_evloop_accepts_nonblocking_sendmsg_discipline():
+    """The post-fix shape: sendmsg wrapped in the BlockingIOError
+    try — the event loop's nonblocking contract — passes."""
+    src = """
+        class EvCE:
+            FUNNELLED = True
+
+            def _flush(self, peer):
+                try:
+                    sent = peer.sock.sendmsg(peer.wire)
+                except (BlockingIOError, InterruptedError):
+                    return
+    """
+    assert not evloop_blocking.check(_ctx(src))
+
+
+def test_evloop_flags_select_select():
+    """The PR 5 round-3 fd>=1024 hazard: select.select in loop-reachable
+    code dies on a resident service holding thousands of fds."""
+    src = """
+        import select
+
+        class EvCE:
+            FUNNELLED = True
+
+            def _writable(self, s):
+                return bool(select.select([], [s], [], 0)[1])
+    """
+    fs = evloop_blocking.check(_ctx(src))
+    assert _ids(fs) == ["PCL-EVLOOP"] and "fd>=1024" in fs[0].message
+
+
+def test_evloop_flags_blocking_acquire_allows_nonblocking():
+    src = """
+        class EvCE:
+            FUNNELLED = True
+
+            def bad(self):
+                self._lk.acquire()
+
+            def good(self):
+                if self._lk.acquire(blocking=False):
+                    self._lk.release()
+    """
+    fs = evloop_blocking.check(_ctx(src))
+    assert len(fs) == 1 and ".acquire()" in fs[0].message
+
+
+def test_evloop_on_loop_marker_and_reachability():
+    """A method marked on-loop is a root even outside a FUNNELLED
+    class, and the pass follows self-calls to find the sleep."""
+    src = """
+        import time
+
+        class Handlers:
+            # lint: on-loop (AM handler)
+            def _activate_cb(self, src, msg):
+                self._slow_path()
+
+            def _slow_path(self):
+                time.sleep(1.0)
+
+            def off_loop_helper(self):
+                time.sleep(1.0)   # not reachable from a root: no flag
+    """
+    fs = evloop_blocking.check(_ctx(src))
+    assert len(fs) == 1 and "_slow_path" in fs[0].message
+
+
+def test_evloop_off_loop_and_waiver():
+    src = """
+        import time
+
+        class EvCE:
+            FUNNELLED = True
+
+            def _dial(self, dst):   # lint: off-loop (init thread)
+                time.sleep(0.05)
+
+            def _shutdown_drain(self):
+                time.sleep(0.002)   # lint: allow-blocking (teardown)
+    """
+    assert not evloop_blocking.check(_ctx(src))
+
+
+# ---------------------------------------------------------------------------
+# PCL-ALIAS: raw device_put / jnp.asarray stage-ins
+# ---------------------------------------------------------------------------
+
+def test_alias_flags_geqrf_prefix_shape():
+    """The EXACT pre-fix r8 wrong-R shape: stage-in assigns a raw
+    jax.device_put of a live payload — on the CPU client the 'copy'
+    aliases the source, and a later donation corrupts the consumer's
+    tile.  Reintroducing it in devices/ must flag."""
+    src = """
+        import jax
+
+        class XlaDevice:
+            def stage_in(self, datum, copy, payload):
+                dc = datum.copy_on(self.space)
+                dc.payload = jax.device_put(payload, self.jdev)
+                dc.version = copy.version
+                return dc
+    """
+    fs = device_put.check(_ctx(src, rel="parsec_tpu/devices/xla.py"))
+    assert _ids(fs) == ["PCL-ALIAS"] and "wrong-R" in fs[0].message
+
+
+def test_alias_flags_jnp_asarray_and_ici_scope():
+    src = """
+        import jax.numpy as jnp
+
+        def put(self, payload, dst_space):
+            return jnp.asarray(payload)
+    """
+    assert device_put.check(_ctx(src, rel="parsec_tpu/comm/ici.py"))
+
+
+def test_alias_wrapper_and_waiver_accepted():
+    src = """
+        import jax
+
+        def device_put_private(payload, jdev):   # lint: alias-wrapper
+            out = jax.device_put(payload, jdev)
+            return out
+
+        def zeros_path(self, shape, dtype):
+            return jax.device_put(   # lint: private-ok (fresh zeros)
+                jnp.zeros(shape, dtype), self.jdev)
+    """
+    assert not device_put.check(_ctx(src, rel="parsec_tpu/devices/xla.py"))
+
+
+def test_alias_out_of_scope_files_untouched():
+    src = "import jax\n\ndef f(x, d):\n    return jax.device_put(x, d)\n"
+    assert not device_put.check(_ctx(src, rel="parsec_tpu/apps/gemm.py"))
+
+
+# ---------------------------------------------------------------------------
+# PCL-MCA: knob drift
+# ---------------------------------------------------------------------------
+
+def _mca_run(sources, tmp_path):
+    """sources: {rel: code}.  tmp_path has no parsec_tpu package, so
+    the full-package gate is vacuously open (synthetic-tree mode)."""
+    ctxs = {rel: _ctx(src, rel=rel) for rel, src in sources.items()}
+    facts = [mca_knobs.facts(c) for c in ctxs.values()]
+    return mca_knobs.tree_check(facts, str(tmp_path), ctxs)
+
+
+def test_mca_flags_unregistered_read(tmp_path):
+    fs = _mca_run({"parsec_tpu/comm/x.py":
+                   'params.register("comm_foo", 1, "h")\n'
+                   'v = params.get("comm_fooo", 1)\n'}, tmp_path)
+    assert any("UNREGISTERED" in f.message and "comm_fooo" in f.message
+               for f in fs)
+
+
+def test_mca_flags_unread_registration(tmp_path):
+    fs = _mca_run({"parsec_tpu/comm/x.py":
+                   'params.register("comm_dead_knob", 1, "h")\n'},
+                  tmp_path)
+    assert any("never read" in f.message for f in fs)
+
+
+def test_mca_flags_default_drift(tmp_path):
+    """The drift class this pass caught FOR REAL on landing:
+    comm_handle_timeout registered 600.0, read with fallback 120.0."""
+    fs = _mca_run({"parsec_tpu/comm/x.py":
+                   'params.register("comm_ttl", 600.0, "h")\n'
+                   'v = params.get("comm_ttl", 120.0)\n'}, tmp_path)
+    assert any("drifted" in f.message for f in fs)
+
+
+def test_mca_flags_env_typo(tmp_path):
+    fs = _mca_run({"parsec_tpu/comm/x.py":
+                   'import os\n'
+                   'params.register("comm_foo", 1, "h")\n'
+                   'v = params.get("comm_foo")\n'
+                   'w = os.environ.get("PARSEC_MCA_COMM_FOOO")\n'},
+                  tmp_path)
+    assert any("PARSEC_MCA_COMM_FOOO" in f.message for f in fs)
+
+
+def test_mca_doc_table_cross_check(tmp_path):
+    (tmp_path / "COMPONENTS.md").write_text(
+        "| knob | `PARSEC_MCA_COMM_TYPO` selects it |\n")
+    fs = _mca_run({"parsec_tpu/comm/x.py":
+                   'params.register("comm_foo", 1, "h")\n'
+                   'v = params.get("comm_foo")\n'}, tmp_path)
+    assert any(f.path == "COMPONENTS.md" and "doc drift" in f.message
+               for f in fs)
+
+
+def test_mca_clean_roundtrip(tmp_path):
+    fs = _mca_run({"parsec_tpu/comm/x.py":
+                   'params.register("comm_foo", 4096, "h")\n'
+                   'v = params.get("comm_foo", 4096)\n'}, tmp_path)
+    assert fs == []
+
+
+def test_mca_partial_scan_is_silent():
+    """A subtree scan of the REAL repo (anything short of the whole
+    parsec_tpu package) keeps the cross-checks off — registrations live
+    all over the package, so a partial view would emit false
+    'unregistered'/'doc drift' findings for knobs registered outside
+    the scanned subtree."""
+    ctx = _ctx('v = params.get("anything_at_all")\n',
+               rel="parsec_tpu/comm/x.py")
+    fs = mca_knobs.tree_check([mca_knobs.facts(ctx)], REPO,
+                              {ctx.rel: ctx,
+                               "parsec_tpu/utils/mca.py": ctx})
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# PCL-EXCEPT: containment hygiene
+# ---------------------------------------------------------------------------
+
+def test_except_flags_context_global_record():
+    """The PR 5 round-4 class: a handler catching the structured
+    PeerFailedError re-records it context-globally, poisoning every
+    pool on the rank."""
+    src = """
+        from parsec_tpu.core.errors import PeerFailedError
+
+        class Layer:
+            def push(self, dst, msg):
+                try:
+                    self.send(dst, msg)
+                except PeerFailedError as exc:
+                    self.context.record_error(exc, None)
+    """
+    fs = except_hygiene.check(_ctx(src))
+    assert _ids(fs) == ["PCL-EXCEPT"] and "CONTEXT-GLOBALLY" in fs[0].message
+
+
+def test_except_flags_broad_catch_global_record():
+    src = """
+        from parsec_tpu.core.errors import PeerFailedError
+
+        def drain(self):
+            try:
+                self.flush()
+            except Exception as exc:
+                self.context.record_error(exc, None)
+    """
+    assert except_hygiene.check(_ctx(src))
+
+
+def test_except_flags_silent_swallow_and_accepts_waiver():
+    bad = """
+        from parsec_tpu.core.errors import PeerFailedError
+
+        def push(self):
+            try:
+                self.send()
+            except PeerFailedError:
+                pass
+    """
+    assert except_hygiene.check(_ctx(bad))
+    waived = bad.replace(
+        "pass",
+        "# lint: contained (death already routed)\n                pass")
+    assert not except_hygiene.check(_ctx(waived))
+
+
+def test_except_accepts_pool_routed_handler():
+    src = """
+        from parsec_tpu.core.errors import PeerFailedError
+
+        def push(self, tp):
+            try:
+                self.send()
+            except PeerFailedError as exc:
+                self.context.record_pool_error(tp, exc)
+    """
+    assert not except_hygiene.check(_ctx(src))
+
+
+def test_except_accepts_task_attributed_record():
+    src = """
+        from parsec_tpu.core.errors import PeerFailedError
+
+        def run(self, task):
+            try:
+                task.body()
+            except Exception as exc:
+                self.context.record_error(exc, task)
+    """
+    assert not except_hygiene.check(_ctx(src))
+
+
+# ---------------------------------------------------------------------------
+# PCL-ASSERT: -O hazards
+# ---------------------------------------------------------------------------
+
+def test_assert_flags_module_level():
+    """The TAG_NAMES class: an import-time wire-protocol invariant as
+    an assert vanishes under python -O."""
+    src = """
+        TAGS = {"ACT": 1}
+        assert TAGS["ACT"] == 1
+    """
+    fs = assert_hazard.check(_ctx(src))
+    assert _ids(fs) == ["PCL-ASSERT"] and "module-level" in fs[0].message
+
+
+def test_assert_flags_side_effecting_condition():
+    src = """
+        def f(q):
+            assert q.pop() == 1
+    """
+    fs = assert_hazard.check(_ctx(src))
+    assert _ids(fs) == ["PCL-ASSERT"] and ".pop" in fs[0].message
+
+
+def test_assert_accepts_pure_conditions():
+    src = """
+        def f(xs, x):
+            assert len(xs) > 0
+            assert isinstance(x, int)
+            assert x > 0, "message"
+    """
+    assert not assert_hazard.check(_ctx(src))
+
+
+def test_assert_inline_suppression():
+    src = """
+        def f(q):
+            assert q.flush()   # lint: ignore[PCL-ASSERT] test helper
+    """
+    assert not assert_hazard.check(_ctx(src))
+
+
+# ---------------------------------------------------------------------------
+# driver: baseline + the clean-tree tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    from tools.parseclint.engine import load_baseline, write_baseline
+    f1 = Finding("a.py", 10, "PCL-LOCK", "msg one")
+    f2 = Finding("b.py", 20, "PCL-MCA", "msg two")
+    path = str(tmp_path / "baseline.txt")
+    write_baseline([f1, f2], path)
+    allowed = load_baseline(path)
+    assert allowed[f1.baseline_key()] == 1
+    # line shifts keep the identity: same path/pass/message matches
+    shifted = Finding("a.py", 99, "PCL-LOCK", "msg one")
+    assert shifted.baseline_key() in allowed
+
+
+def test_clean_tree_zero_findings():
+    """THE gate: the real tree, against the checked-in baseline, has
+    zero new findings.  Every guarded-by/on-loop annotation, waiver,
+    and knob-table entry in the repo is live input to this test —
+    tier-1 fails on any new violation, which is what makes parseclint
+    a pre-merge invariant rather than advice."""
+    from tools.parseclint.engine import run
+    new, baselined, errors = run(
+        [os.path.join(REPO, "parsec_tpu")], use_processes=False)
+    assert errors == [], errors
+    assert new == [], "\n".join(f.render() for f in new)

@@ -1,0 +1,1 @@
+"""Repo tooling (bench guard, chaos harness, parseclint, trace tools)."""

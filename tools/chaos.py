@@ -120,6 +120,14 @@ CATALOG = [
     ("delay-v0",
      "seed={s};delay_frame=tag:DTD,pm='ver': 0,ms=800",
      "dtd", "complete", {}),
+    ("delay-recv",
+     # RECEIVE-side holds: frames arrive in TCP order but dispatch out
+     # of order (utils/faultinject delay_recv) — the reorder coverage
+     # send-side delays cannot reach.  The DTD lane landing-order
+     # guards and versioned surrogates must still converge exactly.
+     "seed={s};delay_recv=tag:DTD,p=0.5,ms=150;"
+     "delay_recv=tag:ACT,p=0.3,ms=80",
+     "dtd", "complete", {}),
     ("dup-frames",
      "seed={s};dup_frame=tag:ACT,p=0.5;dup_frame=tag:DTD,p=0.5",
      "dtd", "complete", {}),
@@ -153,7 +161,7 @@ CATALOG = [
      "potrf", "task-failed", {"PARSEC_MCA_TASK_RETRY_MAX": "1"}),
 ]
 
-_QUICK = ("delay-v0", "kill-close", "fail-task-retry")
+_QUICK = ("delay-v0", "delay-recv", "kill-close", "fail-task-retry")
 
 _CHAOS_ENV = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
               "PARSEC_MCA_COMM_PEER_TIMEOUT_S",

@@ -1,0 +1,19 @@
+"""Pass registry: every pass module exports ``PASS_ID`` and
+``check(ctx) -> [Finding]``; tree-level passes additionally export
+``facts(ctx) -> dict`` (collected per file, possibly in parallel) and
+``tree_check(all_facts, repo_root, ctxs) -> [Finding]`` (run once in
+the driver).  Adding a pass = adding a module here and listing it in
+``ALL_PASSES``."""
+
+from tools.parseclint.passes import (assert_hazard, device_put,
+                                     evloop_blocking, except_hygiene,
+                                     lock_discipline, mca_knobs)
+
+ALL_PASSES = (
+    lock_discipline,
+    evloop_blocking,
+    device_put,
+    mca_knobs,
+    except_hygiene,
+    assert_hazard,
+)

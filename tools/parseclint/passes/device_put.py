@@ -1,0 +1,72 @@
+"""PCL-ALIAS — raw ``jax.device_put``/``jnp.asarray`` stage-ins.
+
+On the CPU client (virtual meshes, tests, the dryrun) ``jax.device_put``
+of an aligned host buffer — and ``jnp.asarray`` of one — can silently
+ALIAS the source instead of copying.  A later donation or in-place
+update of either side then corrupts the other: the geqrf wrong-R root
+cause, which escaped twice more after the first fix because new
+stage-in sites kept calling the raw API.
+
+Rule: in the device layer (``devices/``) and the ICI transport
+(``comm/ici.py``), every ``jax.device_put(...)`` / ``jnp.asarray(...)``
+call is a finding UNLESS
+
+* it sits inside a sanctioned wrapper — a function whose ``def`` line
+  carries ``# lint: alias-wrapper`` (``device_put_private`` and
+  ``device_put_replicated_private`` in devices/xla.py, which probe the
+  output buffer pointer and defensively copy on alias); or
+* the call line carries ``# lint: private-ok (reason)`` — for sites
+  that are alias-safe by construction (e.g. staging a freshly created
+  ``jnp.zeros`` that cannot alias host state).
+
+Everything else must go through ``device_put_private`` (point-to-point)
+or ``device_put_replicated_private`` (sharded replication).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.parseclint import FileCtx, Finding
+
+PASS_ID = "PCL-ALIAS"
+
+_SCOPED = ("devices/", "comm/ici.py")
+
+
+def _in_scope(rel: str) -> bool:
+    r = rel.replace("\\", "/")
+    return any(s in r for s in _SCOPED)
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    if not _in_scope(ctx.rel):
+        return []
+    findings: List[Finding] = []
+
+    def scan(node: ast.AST, wrapped: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            wrapped = wrapped or ctx.has_marker(node.lineno,
+                                                "alias-wrapper")
+        if isinstance(node, ast.Call) and not wrapped:
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name):
+                mod, attr = f.value.id, f.attr
+                hit = (mod == "jax" and attr == "device_put") or \
+                      (mod == "jnp" and attr == "asarray")
+                if hit and not ctx.ignored(node.lineno, PASS_ID) and \
+                        not ctx.has_marker(node.lineno, "private-ok"):
+                    findings.append(Finding(
+                        ctx.rel, node.lineno, PASS_ID,
+                        f"raw {mod}.{attr}() stage-in can alias the "
+                        "host buffer (geqrf wrong-R class) — use "
+                        "device_put_private / "
+                        "device_put_replicated_private, or waive with "
+                        "'lint: private-ok (reason)'"))
+        for child in ast.iter_child_nodes(node):
+            scan(child, wrapped)
+
+    scan(ctx.tree, False)
+    return findings

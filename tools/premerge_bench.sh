@@ -28,12 +28,23 @@
 # Usage:  sh tools/premerge_bench.sh [threshold] [trace_bound]
 #         threshold:   relative regression that fails (default 0.15)
 #         trace_bound: max tracing-on slowdown of tasks/s (default 0.50)
+# r9 prepends the PARSECLINT gate: the project static analyzer
+# (tools/parseclint — lock discipline, event-loop blocking calls,
+# device_put aliasing, MCA knob drift, containment exception hygiene,
+# -O assert hazards) must be clean against its baseline BEFORE any
+# bench cycle is spent; a violation fails the premerge outright.
 set -e
 repo="$(cd "$(dirname "$0")/.." && pwd)"
 threshold="${1:-0.15}"
 trace_bound="${2:-0.50}"
 rc=0
 tasks_off=""
+echo "== premerge gate: parseclint (static analysis) =="
+if ! (cd "$repo" && python -m tools.parseclint parsec_tpu); then
+    echo "premerge: parseclint found violations (fix, waive with a"
+    echo "          'lint:' comment, or baseline in tools/parseclint/)"
+    exit 1
+fi
 for mode in tasks rtt bw; do
     echo "== premerge probe: $mode =="
     out="/tmp/premerge_${mode}_$$.json"
@@ -82,7 +93,7 @@ else
 fi
 rm -f "$tasks_off" "$on"
 echo "== premerge probe: chaos (seeded fault plans, no-hang invariant) =="
-if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 3 --quick; then
+if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 4 --quick; then
     rc=1
 fi
 exit $rc
