@@ -577,7 +577,8 @@ def run_rtt_bench(hops: int = 400):
             log(f"rtt trace attribution FAILED: {exc!r}")
         finally:
             shutil.rmtree(trace_dir, ignore_errors=True)
-    return value, {"protocol": _protocol_breakdown(res), **extras}
+    return value, {"protocol": _protocol_breakdown(res),
+                   "host": _host_info(), **extras}
 
 
 def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
@@ -607,7 +608,19 @@ def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
             else:
                 os.environ[key] = val
     value = float(np.mean([r[1] for r in res]))
-    return value, {"protocol": _protocol_breakdown(res)}
+    return value, {"protocol": _protocol_breakdown(res),
+                   "host": _host_info()}
+
+
+def _host_info() -> dict:
+    """Host core inventory for the bw/rtt JSON lines (the BENCH.md r6
+    'evloop frees a core' claim is only testable where cores >= 2, so
+    every datapoint records where it was measured)."""
+    try:
+        avail = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        avail = os.cpu_count() or 1
+    return {"cpu_count": os.cpu_count() or 1, "cores_available": avail}
 
 
 def _empty_pool(n):
@@ -648,6 +661,56 @@ def run_tasks_bench(n: int = 20000):
             mod.uninstall(ctx)
             tr.uninstall(ctx)
     return n / dt
+
+
+def run_telemetry_bench(n: int = 20000):
+    """Always-on telemetry overhead, as a ratio: the tasks probe with
+    the metrics registry AND flight recorder armed vs both off —
+    the premerge telemetry gate's measurement (bound <= 5%, an order
+    cheaper than the causal tracer's 50% gate).  Four back-to-back
+    off/on pairs; the reported value is the MINIMUM pair ratio (the
+    min-RTT discipline — see the inline rationale) so one loaded host
+    window cannot fake a gate failure, while a real regression, which
+    shows in every pair, still trips it."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.utils.mca import params as _params
+
+    def rate(armed: int) -> float:
+        _params.set("metrics_enabled", armed)
+        _params.set("flightrec_enabled", armed)
+        try:
+            with Context(nb_cores=int(os.environ.get(
+                    "PARSEC_BENCH_CORES", 4))) as ctx:
+                ctx.add_taskpool(_empty_pool(n // 10))   # warm
+                ctx.wait()
+                t0 = time.perf_counter()
+                ctx.add_taskpool(_empty_pool(n))
+                ctx.wait()
+                return n / (time.perf_counter() - t0)
+        finally:
+            _params.unset("metrics_enabled")
+            _params.unset("flightrec_enabled")
+
+    # minimum over back-to-back pair ratios — the clock estimator's
+    # min-RTT principle applied to an overhead gate: host-load noise
+    # on a shared CI core spans ~10% run to run (an order above the
+    # effect measured) and contaminates individual pairs in either
+    # direction, but a REAL regression shows in every pair, so the
+    # cleanest pair bounds the true overhead from below while staying
+    # immune to one loaded window faking a gate failure
+    pairs = []
+    off = on = 0.0
+    for _ in range(4):
+        o, a = rate(0), rate(1)
+        off, on = max(off, o), max(on, a)
+        if a:
+            pairs.append(max(0.0, o / a - 1.0))
+    overhead = min(pairs) if pairs else 1.0
+    log(f"telemetry overhead: {overhead:+.1%} (min of "
+        f"{['%+.1f%%' % (p * 100) for p in pairs]}; best off "
+        f"{off:.0f} -> armed {on:.0f} tasks/s)")
+    return overhead, {"tasks_off": round(off, 1),
+                      "tasks_on": round(on, 1)}
 
 
 def run_stencil_bench(mb: int = 0, nt: int = 8, steps: int = 0):
@@ -751,6 +814,8 @@ _AUX_MODES = {
     "rtt": (run_rtt_bench, "task_rtt", "us/hop", 1000.0, False),
     "bw": (run_bw_bench, "dataflow_bandwidth", "MB/s", 1000.0, True),
     "tasks": (run_tasks_bench, "task_throughput", "tasks/s", 10000.0, True),
+    "telemetry": (run_telemetry_bench, "telemetry_overhead", "ratio",
+                  0.05, False),
     "stencil": (run_stencil_bench, "stencil_throughput", "points/s",
                 1e8, True),
     "tracer": (run_tracer_bench, "tracer_overhead", "us/task", 1.0, False),
@@ -1444,7 +1509,11 @@ def main():
         extras = {}
         if isinstance(value, tuple):
             value, extras = value
-        vs = (value / target) if higher else (target / value if value else 0)
+        # lower-is-better ratios cap at 100: a PERFECT reading (the
+        # telemetry mode's 0.0 overhead is common) must score best,
+        # not divide to zero and read as a collapse to artifact diffs
+        vs = (value / target) if higher \
+            else (min(100.0, target / value) if value else 100.0)
         print(json.dumps({
             "metric": metric,
             "value": round(value, 3),

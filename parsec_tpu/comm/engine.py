@@ -60,6 +60,8 @@ TAG_GET1 = 10     # one-sided get request
 TAG_GET1_REP = 11
 TAG_CLOCK = 12    # clock-offset ping/pong (causal-trace alignment)
 TAG_HB = 13       # heartbeat (active failure detection of HUNG peers)
+TAG_METRICS = 14  # telemetry pull/push (cross-rank /metrics aggregation)
+TAG_FLIGHT = 15   # flight-recorder incident dump request (prof/flightrec)
 TAG_USER = 16     # first tag available to applications
 
 # the fault injector names tags without importing this module (it is
@@ -341,6 +343,27 @@ class CommEngine:
         #: processes frames (sockets stay open — the silent-hang fault)
         self._muted = False
         self.tag_register(TAG_HB, self._hb_cb)
+        #: telemetry plane (prof/metrics.py): a provider returns this
+        #: rank's sample list for TAG_METRICS pulls; replies to OUR
+        #: pulls land in _metrics_replies keyed by request id
+        self.metrics_provider: Optional[Callable[[], Any]] = None
+        #: every ACCEPTED clock-probe round trip feeds the frame-RTT
+        #: histogram (control-lane protocol latency over time, not
+        #: just the latest per-peer gauge)
+        self.on_clock_rtt: Optional[Callable[[float], None]] = None
+        self._metrics_cond = threading.Condition()
+        self._metrics_replies: Dict[int, Dict[int, Any]] = {}  # guarded-by: _metrics_cond
+        self._metrics_req = 0                    # guarded-by: _metrics_cond
+        self.tag_register(TAG_METRICS, self._metrics_cb)
+        #: flight recorder (prof/flightrec.py): a peer's incident
+        #: broadcast asks this rank to dump its ring into the bundle
+        self.on_flight_dump: Optional[Callable[[str], None]] = None
+        self.tag_register(TAG_FLIGHT, self._flight_cb)
+        #: starved-checker rebase accounting (observability of the
+        #: failure detector): per-peer silence-clock rebases; written
+        #: only by the single thread running check_peer_timeouts
+        self.hb_rebase_total = 0
+        self._hb_rebases: Dict[int, int] = {}
 
     def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
         """cb(src_rank, payload) runs on the comm receive thread."""
@@ -506,33 +529,106 @@ class CommEngine:
     def _clock_update(self, src: int, samples: List) -> None:
         off, rtt = clock_offset_estimate(samples)
         now = time.monotonic()
+        accepted = True
         with self._clock_lock:
             st = self.clock.get(src)
             if st is None:
                 self.clock[src] = {"offset": off, "rtt": rtt,
                                    "drift": 0.0, "measured_at": now}
-                return
-            dt = now - st["measured_at"]
-            # a round whose best rtt is much worse than what we have
-            # seen is congestion, not clock motion — keep the old
-            # estimate unless it has gone stale (then anything beats
-            # extrapolating a minute-old offset)
-            if rtt > 2.0 * st["rtt"] and dt < 60.0:
-                return
-            if dt > 1.0:
-                st["drift"] = (off - st["offset"]) / dt
-            st["offset"] = off
-            # the ACCEPTED sample's rtt, not an all-time minimum: the
-            # recorded value must bound the stored offset's error
-            # (rtt/2), and a ratcheted floor would make the congestion
-            # veto above monotonically stricter as host load rises
-            st["rtt"] = rtt
-            st["measured_at"] = now
+            else:
+                dt = now - st["measured_at"]
+                # a round whose best rtt is much worse than what we
+                # have seen is congestion, not clock motion — keep the
+                # old estimate unless it has gone stale (then anything
+                # beats extrapolating a minute-old offset)
+                if rtt > 2.0 * st["rtt"] and dt < 60.0:
+                    accepted = False
+                else:
+                    if dt > 1.0:
+                        st["drift"] = (off - st["offset"]) / dt
+                    st["offset"] = off
+                    # the ACCEPTED sample's rtt, not an all-time
+                    # minimum: the recorded value must bound the
+                    # stored offset's error (rtt/2), and a ratcheted
+                    # floor would make the congestion veto above
+                    # monotonically stricter as host load rises
+                    st["rtt"] = rtt
+                    st["measured_at"] = now
+        if not accepted:
+            return
+        cb = self.on_clock_rtt
+        if cb is not None:
+            try:
+                cb(rtt)
+            except Exception:   # telemetry must never hurt clock sync
+                pass
 
     def clock_table(self) -> Dict[int, Dict[str, float]]:
         """Snapshot of the per-peer alignment state (trace headers)."""
         with self._clock_lock:
             return {r: dict(st) for r, st in self.clock.items()}
+
+    # -- telemetry plane: TAG_METRICS pull/push + TAG_FLIGHT dumps ------
+    # lint: on-loop (AM callback: builds a snapshot — short lock holds
+    # in the registry — and replies on the control lane)
+    def _metrics_cb(self, src: int, msg: dict) -> None:
+        if msg.get("k") == "pull":
+            provider = self.metrics_provider
+            try:
+                samples = provider() if provider is not None else []
+            except Exception:   # a broken provider must not kill the loop
+                samples = []
+            try:
+                self.send_am(TAG_METRICS, src,
+                             {"k": "push", "req": msg.get("req"),
+                              "rank": self.rank, "samples": samples})
+            except OSError:
+                pass   # puller died; its gather times out
+            return
+        with self._metrics_cond:
+            pend = self._metrics_replies.get(msg.get("req"))
+            if pend is not None:
+                pend[int(msg.get("rank", src))] = msg.get("samples") or []
+                self._metrics_cond.notify_all()
+
+    def gather_metrics(self, timeout: float = 2.0) -> Dict[int, Any]:
+        """Pull every live peer's telemetry snapshot over TAG_METRICS;
+        returns rank -> sample list (missing ranks timed out or died).
+        Blocks the CALLER — scrape threads (service/server.py), never
+        the comm loop itself."""
+        targets = [r for r in range(self.nranks)
+                   if r != self.rank and r not in self.dead_peers]
+        if not targets:
+            return {}
+        with self._metrics_cond:
+            self._metrics_req += 1
+            req = self._metrics_req
+            self._metrics_replies[req] = {}
+        reached = []
+        for r in targets:
+            try:
+                self.send_am(TAG_METRICS, r, {"k": "pull", "req": req})
+                reached.append(r)
+            except OSError:
+                pass   # died since the dead_peers check: don't wait on it
+        with self._metrics_cond:
+            if reached:
+                self._metrics_cond.wait_for(
+                    lambda: len(self._metrics_replies[req])
+                    >= len(reached),
+                    timeout=timeout)
+            return self._metrics_replies.pop(req, {})
+
+    # lint: on-loop (AM callback — hands the dump to a timer thread so
+    # file I/O never stalls the comm loop)
+    def _flight_cb(self, src: int, msg: dict) -> None:
+        cb = self.on_flight_dump
+        if cb is None:
+            return
+        t = threading.Timer(0.0, cb, args=(
+            str((msg or {}).get("reason", f"peer rank {src}")),))
+        t.daemon = True
+        t.start()
 
     # -- active failure detection: heartbeats + silence timeout ---------
     # lint: on-loop (AM callback)
@@ -573,25 +669,51 @@ class CommEngine:
         """Declare peers silent past ``comm_peer_timeout_s`` dead — the
         detector for HUNG peers, whose sockets never close.  A starved
         checker (GIL/compile storm froze US, not them) rebases instead
-        of declaring: our own silence proves nothing about theirs."""
+        of declaring: our own silence proves nothing about theirs.
+
+        The rebase is PER PEER (the PR 5 tradeoff refined): only peers
+        whose last frame predates the stall window restart their
+        silence clock — we were frozen for their whole silence, so it
+        proves nothing.  A peer heard DURING the stall (socket recv
+        threads, or the loop between stalls, kept stamping
+        ``_last_heard``) keeps its real silence age, so one wedged
+        SO_SNDTIMEO send no longer resets every OTHER peer's detection
+        latency.  Rebases are counted per peer (``hb_rebase_total`` /
+        ``hb_rebases``) so the detector's own behavior is observable
+        in the metrics plane."""
         timeout = float(params.get("comm_peer_timeout_s", 15.0))
         if timeout <= 0 or self.nranks == 1 or self._muted:
             return
         now = time.monotonic()
-        starved = now - self._hb_check_at > timeout
+        stall_start = self._hb_check_at
+        starved = now - stall_start > timeout
         self._hb_check_at = now
-        if starved:
-            for r in list(self._last_heard):
-                self._last_heard[r] = now
-            return
         for r, at in list(self._last_heard.items()):
             if r in self.dead_peers:
+                continue
+            if starved:
+                # a starved round never DECLARES — a process-wide
+                # freeze (GIL/compile storm) may have parked unread
+                # frames in the kernel, so every age is suspect.  But
+                # only peers whose last frame predates the stall
+                # restart their clock; one heard DURING the stall
+                # keeps its true age, and the next healthy check —
+                # one period away — declares on it if the silence is
+                # real
+                if at <= stall_start:
+                    self._last_heard[r] = now
+                    self.hb_rebase_total += 1
+                    self._hb_rebases[r] = self._hb_rebases.get(r, 0) + 1
                 continue
             if now - at > timeout:
                 self.declare_peer_dead(r, PeerFailedError(
                     r, f"rank {self.rank}: no frames from rank {r} for "
                        f"{now - at:.1f}s (comm_peer_timeout_s="
                        f"{timeout:g})", detector="heartbeat"))
+
+    def hb_rebases(self) -> Dict[int, int]:
+        """Per-peer starved-checker rebase counts (metrics export)."""
+        return dict(self._hb_rebases)
 
     def declare_peer_dead(self, r: int, exc: Exception) -> None:
         """Shared death path (EOF, corruption, heartbeat silence): mark,
@@ -631,6 +753,9 @@ class CommEngine:
         for r, at in list(self._last_heard.items()):   # recv threads insert
             out[r] = {"last_heard_age_s": round(now - at, 3),
                       "dead": r in self.dead_peers}
+            reb = self._hb_rebases.get(r)
+            if reb:
+                out[r]["hb_rebases"] = reb
         for r in list(self.dead_peers):
             out.setdefault(r, {"dead": True})
         return out
@@ -1343,7 +1468,7 @@ class SocketCE(CommEngine):
 #: frames (a termination token or GET request must not wait behind a
 #: multi-MB payload drain); a partially-written frame is never preempted
 _CTL_TAGS = frozenset((TAG_TERMDET, TAG_BARRIER, TAG_GET_REQ, TAG_UTRIG,
-                       TAG_CLOCK, TAG_HB))
+                       TAG_CLOCK, TAG_HB, TAG_METRICS, TAG_FLIGHT))
 
 #: receive state machine stages
 _ST_HS, _ST_HDR, _ST_BODY, _ST_BLEN, _ST_BUF = range(5)

@@ -199,9 +199,15 @@ class RemoteDepEngine:
         self._fid_seq = itertools.count(1)
         self._seen_fids: set = set()
         self._fid_order: "deque" = deque()
-        #: causal tracer (prof/causal.py), attached by its install();
-        #: None = zero tracing work on every send/recv path
-        self.tracer = None
+        #: causal tracer (prof/causal.py) and flight recorder
+        #: (prof/flightrec.py), attached through the ``tracer`` /
+        #: ``flightrec`` properties below; ``_sinks`` is the maintained
+        #: fan-out tuple every trace site iterates — one shape to
+        #: extend when the next sink arrives.  Empty = zero tracing
+        #: work on every send/recv path
+        self._tracer = None
+        self._flightrec = None
+        self._sinks: Tuple = ()
         #: protocol counters (exported through stats() -> bench bw/rtt;
         #: guarded-by: _proto_lock)
         self.proto: Dict[str, int] = {
@@ -294,6 +300,18 @@ class RemoteDepEngine:
         self._rdv_retry = max(0.05, float(params.get("comm_rdv_retry_s",
                                                      2.0)))
         self._rdv_timeout = float(params.get("comm_rdv_timeout_s", 60.0))
+        # telemetry plane wiring — BEFORE the progress machinery arms:
+        # the first clock-probe round fires at attach on the loop
+        # thread, and its accepted RTT must find on_clock_rtt wired
+        # (the always-on registry serves TAG_METRICS pulls, an armed
+        # flight recorder answers TAG_FLIGHT dump requests)
+        m = getattr(context, "metrics", None)
+        if m is not None:
+            ce.metrics_provider = m.samples
+            ce.on_clock_rtt = m.comm_frame_rtt.observe
+        fr = getattr(context, "_flightrec", None)
+        if fr is not None:
+            fr.attach_comm(self)
         if self.funnelled:
             self._progress = None
             ce.add_periodic(self._purge_stale_handles, 5.0)
@@ -668,7 +686,7 @@ class RemoteDepEngine:
                 "ranks": ranks,
             }
             tp.peer_ranks.update(ranks)   # containment attribution
-            if self.tracer is not None:
+            if self._sinks:
                 # producer identity for the causal DAG: the same oid the
                 # task_profiler's exec interval carries (forwarders keep
                 # it, so tree hops still attribute to the producer)
@@ -878,7 +896,7 @@ class RemoteDepEngine:
         with self._term_lock:
             self._color_black = True
             self._app_sent += 1
-        if self.tracer is not None:
+        if self._sinks:
             payload = self._traced(tag, dst, payload)
         self._post_send(tag, dst, payload)
 
@@ -892,7 +910,7 @@ class RemoteDepEngine:
         with self._term_lock:
             self._color_black = True
             self._app_sent += len(items)
-        if self.tracer is not None:
+        if self._sinks:
             # per inner message: each gets its own correlation id; the
             # receiver's _batch_cb re-dispatches them individually, so
             # every flow edge survives coalescing
@@ -905,34 +923,66 @@ class RemoteDepEngine:
             self.proto["coalesced_msgs"] += len(items)
         self._post_send(TAG_BATCH, dst, list(items))
 
-    # -- causal tracing (prof/causal.py): every traced app frame carries
-    # a send timestamp + (src_rank, event_seq) correlation id; matched
-    # comm_send/comm_recv events become the merged trace's flow edges --
+    # -- causal tracing (prof/causal.py) + flight recorder: every traced
+    # app frame carries a send timestamp + (src_rank, event_seq)
+    # correlation id; matched comm_send/comm_recv events become the
+    # merged trace's flow edges.  The ``tracer``/``flightrec``
+    # properties maintain ``_sinks`` so every site below fans out over
+    # ONE tuple — adding a sink touches nothing here.
+    @property
+    def tracer(self):
+        return self._tracer
+
+    @tracer.setter
+    def tracer(self, value) -> None:
+        self._tracer = value
+        self._resinks()
+
+    @property
+    def flightrec(self):
+        return self._flightrec
+
+    @flightrec.setter
+    def flightrec(self, value) -> None:
+        self._flightrec = value
+        self._resinks()
+
+    def _resinks(self) -> None:
+        # tracer FIRST: its counter issues the correlation id when both
+        # are live, so the ring's flow edges match the full trace's
+        self._sinks = tuple(s for s in (self._tracer, self._flightrec)
+                            if s is not None)
+
     def _traced(self, tag: int, dst: int, payload):
-        tr = self.tracer
-        if tr is None or not isinstance(payload, dict):
+        sinks = self._sinks
+        if not sinks or not isinstance(payload, dict):
             return payload
-        corr = tr.next_corr()
+        corr = sinks[0].next_corr()
         now = time.perf_counter()
         # shallow copy: tree forwarding reuses one msg dict for several
         # children — each SEND is its own flow edge with its own id
         payload = dict(payload, _corr=corr, _sent_at=now)
         tp = payload.get("tp")
         root = payload.get("root")
-        tr.comm_send(tag, dst, corr, payload.get("_oid"),
-                     _msg_nbytes(payload), now,
-                     tpid=tp if isinstance(tp, int) else 0,
-                     src_rank=root if isinstance(root, int) else None)
+        tpid = tp if isinstance(tp, int) else 0
+        src_rank = root if isinstance(root, int) else None
+        nbytes = _msg_nbytes(payload)
+        for sink in sinks:
+            sink.comm_send(tag, dst, corr, payload.get("_oid"),
+                           nbytes, now, tpid=tpid, src_rank=src_rank)
         return payload
 
     def _trace_recv(self, tag: int, src: int, msg) -> None:
-        tr = self.tracer
-        if tr is None or not isinstance(msg, dict):
+        sinks = self._sinks
+        if not sinks or not isinstance(msg, dict):
             return
         corr = msg.get("_corr")
-        if corr is not None:
-            tr.comm_recv(tag, src, corr, msg.get("_sent_at"),
-                         _msg_nbytes(msg))
+        if corr is None:
+            return
+        sent_at = msg.get("_sent_at")
+        nbytes = _msg_nbytes(msg)
+        for sink in sinks:
+            sink.comm_recv(tag, src, corr, sent_at, nbytes)
 
     def _post_send(self, tag: int, dst: int, payload) -> None:
         if self.funnelled:
@@ -1170,17 +1220,19 @@ class RemoteDepEngine:
             copy = datum.create_copy(0, payload=array,
                                      coherency=Coherency.SHARED, version=1)
         from parsec_tpu.data.reshape import as_dtt, needs_reshape
-        tracer = self.tracer
+        sinks = self._sinks
         for tc_name, locs, dflow in deliveries:
             tc = tp.task_classes.get(tc_name)
             if tc is None:
                 raise RuntimeError(f"unknown task class {tc_name!r}")
-            if tracer is not None:
+            if sinks:
                 try:
-                    tracer.dep_deliver(corr, hash(tc.make_key(locs)),
-                                       tpid=tp.taskpool_id)
+                    oid = hash(tc.make_key(locs))
                 except Exception:
-                    pass   # un-keyable locals: skip the trace, not the dep
+                    oid = None   # un-keyable locals: skip the trace
+                if oid is not None:
+                    for sink in sinks:
+                        sink.dep_deliver(corr, oid, tpid=tp.taskpool_id)
             dcopy = copy
             if copy is not None:
                 # receiver-side datatype resolution: the consumer's IN

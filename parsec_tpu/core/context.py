@@ -92,6 +92,15 @@ class Context:
         self.comm = None               # comm engine (distributed layer)
         self.grapher = None            # DOT grapher (prof layer)
         self._causal_tracer = None     # prof/causal.py CausalTracer
+        self.metrics = None            # prof/metrics.py RuntimeMetrics
+        self._flightrec = None         # prof/flightrec.py FlightRecorder
+        #: schedule() stamps Task.ready_at only when a telemetry
+        #: consumer wants it (causal tracer or metrics registry), and
+        #: devices/xla.py fires device_dispatch/device_done PINS only
+        #: when someone subscribed; both maintained by
+        #: _recompute_ready_stamp on (un)install
+        self._ready_stamp = False
+        self._device_spans = False
         #: transient-task retry budget, cached off the worker hot path
         #: (core/scheduling.task_progress probes it per task)
         self._retry_max = int(params.get("task_retry_max", 0))
@@ -169,8 +178,42 @@ class Context:
         from parsec_tpu.prof.pins import install_selected
         self._pins_modules = install_selected(self)
 
+        # telemetry plane: the always-on metrics registry (PAPI-SDE
+        # counterpart grown into a scrapeable registry) and the
+        # crash-dump flight recorder (armed via flightrec_enabled)
+        if int(params.get("metrics_enabled", 1)):
+            from parsec_tpu.prof.metrics import RuntimeMetrics
+            RuntimeMetrics(rank=self.rank).install(self)
+        if int(params.get("flightrec_enabled", 0)):
+            from parsec_tpu.prof.flightrec import FlightRecorder
+            FlightRecorder(self).install(self)
+        self._recompute_ready_stamp()
+
         debug_verbose(3, "context up: %d streams, scheduler=%s",
                       self.nb_cores, self.scheduler.name)
+
+    def _recompute_ready_stamp(self) -> None:
+        """Telemetry-consumer gates: schedule() stamps Task.ready_at
+        iff someone consumes it, and the device layer emits its
+        dispatch/done span events iff someone registered for them."""
+        self._ready_stamp = (self._causal_tracer is not None
+                             or self.metrics is not None)
+        fr = self._flightrec
+        self._device_spans = (self._causal_tracer is not None
+                              or (fr is not None
+                                  and "device" in fr.classes))
+
+    def telemetry_incident(self, reason: str):
+        """Fire the flight recorder's incident dump (no-op unarmed).
+        Called from containment/error paths — must never raise."""
+        fr = self._flightrec
+        if fr is None:
+            return None
+        try:
+            return fr.incident(reason)
+        except Exception as exc:
+            debug_verbose(1, "flight recorder incident failed: %s", exc)
+            return None
 
     # -- PINS registration -------------------------------------------------
     def pins_register(self, event: str, cb: Callable) -> None:
@@ -347,6 +390,12 @@ class Context:
         # per-pool error isolation (job service): a pool carrying an
         # error_sink keeps its failures to itself — one job's crash must
         # not poison the context for concurrently-running jobs
+        from parsec_tpu.core.errors import PeerFailedError
+        if isinstance(exc, PeerFailedError):
+            # containment fired: capture what just happened before the
+            # ring overwrites it (no-op unless the recorder is armed)
+            self.telemetry_incident(
+                f"PeerFailedError rank={exc.rank} ({exc.detector})")
         tp = getattr(task, "taskpool", None)
         sink = getattr(tp, "error_sink", None) if tp is not None else None
         if sink is not None:
@@ -364,6 +413,9 @@ class Context:
         peer, a rendezvous timeout) through the pool's error sink —
         containment for service jobs — falling back to the context-wide
         error list exactly like record_error."""
+        self.telemetry_incident(
+            f"pool {getattr(tp, 'taskpool_id', '?')} error: "
+            f"{type(exc).__name__}")
         sink = getattr(tp, "error_sink", None) if tp is not None else None
         if sink is not None:
             try:
@@ -416,6 +468,14 @@ class Context:
                     lines.append("comm: " + repr(dbg()))
                 except Exception as exc:   # the autopsy must never raise
                     lines.append(f"comm: <debug_state failed: {exc}>")
+        # armed flight recorder: the last-N-seconds ring is worth more
+        # than this snapshot — dump it and point the reader at the
+        # bundle (merge with tools/trace2chrome.py --merge)
+        bundle = self.telemetry_incident("hang-autopsy")
+        if bundle is not None:
+            lines.append(f"flight recorder incident bundle: {bundle} "
+                         "(open: python tools/trace2chrome.py --merge "
+                         f"{bundle}/rank*.ptt)")
         return "\n".join(lines)
 
     # -- remote deps (filled in by the comm layer) ------------------------
@@ -449,6 +509,10 @@ class Context:
             unins = getattr(mod, "uninstall", None)
             if unins is not None:   # reference: pins_fini unregisters
                 unins(self)
+        if self.metrics is not None:
+            self.metrics.uninstall(self)
+        if self._flightrec is not None:
+            self._flightrec.uninstall(self)
 
     def __enter__(self):
         return self

@@ -25,10 +25,12 @@ def schedule(es, tasks: List[Task], distance: int = 0) -> None:
     """Enter ready tasks into the scheduler (reference: __parsec_schedule)."""
     if not tasks:
         return
-    if es.context._causal_tracer is not None:
+    if es.context._ready_stamp:
         # one stamp for the batch: the tasks became ready at this same
-        # moment, and the causal tracer closes select - ready_at into a
-        # queue-wait span.  Gated so the untraced hot path stays free
+        # moment; the causal tracer closes select - ready_at into a
+        # queue-wait span and the metrics registry samples it into the
+        # queue-wait histogram.  Gated (Context._ready_stamp) so a
+        # telemetry-disabled hot path stays free
         now = time.perf_counter()
         for t in tasks:
             t.status = TaskStatus.READY

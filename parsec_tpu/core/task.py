@@ -344,7 +344,7 @@ class Task:
     __slots__ = ("task_class", "taskpool", "locals", "key", "priority",
                  "status", "data", "input_sources", "pinned_flows",
                  "chore_mask", "seq", "device", "prof", "dtd",
-                 "ready_at", "retries", "retry_snap")
+                 "ready_at", "mtr_t0", "retries", "retry_snap")
 
     def __init__(self, task_class: TaskClass, taskpool, locals_: Dict[str, int]):
         self.task_class = task_class
@@ -373,8 +373,14 @@ class Task:
         self.dtd = None     # DTD dep-bookkeeping state, if dynamically inserted
         #: perf_counter stamp of the moment the task became READY
         #: (schedule()); the causal tracer turns select - ready_at into
-        #: the task's queue-wait span.  None unless a tracer is installed
+        #: the task's queue-wait span, and the metrics registry samples
+        #: it into the queue-wait histogram.  None unless a telemetry
+        #: consumer is installed (Context._ready_stamp)
         self.ready_at = None
+        #: metrics sampling stamp (prof/metrics.py RuntimeMetrics):
+        #: select-time perf_counter of a SAMPLED task; complete_exec
+        #: closes it into the task-latency histogram
+        self.mtr_t0 = None
         #: transient-failure retry bookkeeping (core/scheduling
         #: _maybe_retry; active only when task_retry_max > 0)
         self.retries = 0

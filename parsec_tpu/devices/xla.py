@@ -102,15 +102,16 @@ params.register("device_fuse_panel", 1,
                 "Python scheduling latency between them (the measured "
                 "potrf tunnel-state sensitivity).  0 restores the "
                 "per-kernel panel path (the A/B attribution knob)")
-params.register("device_fuse_donate", 0,
+params.register("device_fuse_donate", 1,
                 "allow input-buffer donation inside CHAINED launches "
-                "(device_fuse_panel programs).  Default OFF as a "
-                "regression guard: the r8 loaded A/B attributed the "
-                "intermittent geqrf wrong-R to donation in chained "
-                "programs; the underlying aliasing is root-caused and "
-                "fixed (device_put_private), and donation-on re-tested "
-                "clean under the same load — flips back to 1 after a "
-                "longer soak.  Plain launches keep donating "
+                "(device_fuse_panel programs).  Default ON since the "
+                "ROADMAP-mandated soak (the slow "
+                "test_fused_chain_donation_soak: 50+ fused-chain "
+                "geqrf/potrf iterations under delay_dispatch load, 0 "
+                "wrong results) — the r8 wrong-R aliasing was "
+                "root-caused and fixed at the zero-copy device_put "
+                "stage-in (device_put_private).  0 is the off-switch "
+                "regression guard.  Plain launches donate regardless "
                 "(device_donate)")
 params.register("device_dispatchers", 2,
                 "manager (launch) threads per XLA device: each dispatch "
@@ -572,7 +573,7 @@ class XlaDevice(Device):
                         and self.platform in ("tpu", "axon", "gpu", "cuda",
                                               "rocm"))
         self._chain_donate = self._donate and \
-            bool(int(params.get("device_fuse_donate", 0)))
+            bool(int(params.get("device_fuse_donate", 1)))
         self._depth = max(1, int(params.get("device_inflight_depth", 8)))
         self._runahead = max(self._depth,
                              int(params.get("device_runahead", 256)))
@@ -896,11 +897,14 @@ class XlaDevice(Device):
                     copy.arena.release_unheld(copy)
             raise
         self.stats.executed_tasks += n
-        if self.es.context._causal_tracer is not None:
+        if self.es.context._device_spans:
             # device span opens at dispatch (the wave just entered the
             # accelerator pipeline); the matching device_done fires when
             # the outputs materialize (_finalize) — together the
-            # dispatch->done device segment of the causal trace
+            # dispatch->done device segment of the causal trace (and of
+            # the flight recorder's incident ring).  The gate is
+            # maintained by Context._recompute_ready_stamp, so a
+            # recorder whose classes exclude 'device' costs nothing
             for task, _spec2, _load2 in batch:
                 self.es.pins("device_dispatch", task)
         with self._cond:
@@ -1515,7 +1519,7 @@ class XlaDevice(Device):
             self.stats.faults += 1
             inf.es.context.record_error(exc, inf.task)
         finally:
-            if inf.es.context._causal_tracer is not None:
+            if inf.es.context._device_spans:
                 # outputs are materialized (or the failure surfaced):
                 # close the dispatch->done device span
                 inf.es.pins("device_done", inf.task)

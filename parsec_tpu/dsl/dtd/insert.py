@@ -457,11 +457,18 @@ class DTDTaskpool(Taskpool):
         a region-ordering race shows up as an out-of-order apply in ONE
         merged timeline instead of needing rerun roulette."""
         ctx = self.context
-        tr = getattr(ctx, "_causal_tracer", None) if ctx is not None \
-            else None
-        if tr is not None:
-            tr.dtd_event(op, wire, lane, ver,
-                         _chain_val(arr) if arr is not None else None)
+        if ctx is None:
+            return
+        tr = getattr(ctx, "_causal_tracer", None)
+        fr = getattr(ctx, "_flightrec", None)
+        if fr is not None and "dtd" not in fr.classes:
+            fr = None   # class-gated out: no numpy work on its account
+        if tr is None and fr is None:
+            return
+        val = _chain_val(arr) if arr is not None else None
+        for sink in (tr, fr):
+            if sink is not None:
+                sink.dtd_event(op, wire, lane, ver, val)
 
     # -- tiles -------------------------------------------------------------
     def tile_of(self, dc: DataCollection, *indices) -> DTDTile:

@@ -83,6 +83,7 @@ class CausalTracer:
     def install(self, context) -> "CausalTracer":
         self.rank = context.rank
         context._causal_tracer = self
+        context._recompute_ready_stamp()
         context.pins_register("select", self._select)
         context.pins_register("deliver_dep", self._deliver_dep)
         context.pins_register("device_dispatch", self._dev_dispatch)
@@ -94,6 +95,7 @@ class CausalTracer:
     def uninstall(self, context) -> None:
         if getattr(context, "_causal_tracer", None) is self:
             context._causal_tracer = None
+            context._recompute_ready_stamp()
         context.pins_unregister("select", self._select)
         context.pins_unregister("deliver_dep", self._deliver_dep)
         context.pins_unregister("device_dispatch", self._dev_dispatch)

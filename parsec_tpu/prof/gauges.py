@@ -155,6 +155,14 @@ class JobGauges:
             return {"tasks_enabled": row[0], "tasks_retired": row[1],
                     "tasks_discarded": row[2]}
 
+    def job_task_rows(self):
+        """Bounded (job_id, [enabled, retired, discarded]) rows — the
+        metrics registry's per-job family rides this window, so its
+        label cardinality is capped by max_jobs exactly like the
+        gauge keys."""
+        with self._lock:
+            return [(jid, list(row)) for jid, row in self._tasks.items()]
+
     def snapshot(self) -> Dict[str, float]:
         import time
         counts: Dict[str, int] = {}

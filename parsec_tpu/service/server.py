@@ -18,6 +18,12 @@ Requests are one JSON object; every request gets one JSON reply with an
     {"op": "result", "job": 7, "timeout": 60}-> {"ok": true, "result": {...}}
     {"op": "cancel", "job": 7}               -> {"ok": true, "cancelled": b}
     {"op": "jobs"} / {"op": "stats"} / {"op": "gauges"} / {"op": "apps"}
+    {"op": "metrics"}  -> {"ok": true, "text": <Prometheus exposition>,
+                           "ranks": [...]}   (cross-rank via TAG_METRICS)
+
+The same port also answers a plain HTTP ``GET /metrics`` (the first
+four bytes disambiguate: framed requests lead with the PTJS magic), so
+a stock Prometheus scraper or curl needs no client library.
 
 Named apps (the multi-tenant demo catalog) build small self-contained
 problems from JSON params and return JSON-able result summaries — the
@@ -56,8 +62,13 @@ def send_msg(conn: socket.socket, obj: Dict[str, Any]) -> None:
     conn.sendall(_HDR.pack(_MAGIC, _VERSION, len(payload)) + payload)
 
 
-def recv_msg(conn: socket.socket) -> Optional[Dict[str, Any]]:
-    hdr = _recv_exact(conn, _HDR.size)
+def recv_msg(conn: socket.socket,
+             pre: bytes = b"") -> Optional[Dict[str, Any]]:
+    """Read one framed request.  ``pre`` is bytes the caller already
+    consumed while sniffing the protocol (the HTTP-vs-framed dispatch
+    in JobServer._serve_conn)."""
+    rest = _recv_exact(conn, _HDR.size - len(pre))
+    hdr = pre + rest if rest is not None else None
     if hdr is None:
         return None
     magic, ver, n = _HDR.unpack(hdr)
@@ -237,8 +248,21 @@ class JobServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         with conn:
             while not self._stop:
+                # protocol sniff: the framed wire always leads with the
+                # PTJS magic, so a plain-HTTP scraper (Prometheus, curl)
+                # is recognizable from its first four bytes and served
+                # a one-shot text exposition on the SAME port
                 try:
-                    req = recv_msg(conn)
+                    head = _recv_exact(conn, 4)
+                except OSError:
+                    return
+                if head is None:
+                    return
+                if head in (b"GET ", b"HEAD"):
+                    self._serve_http(conn, head)
+                    return
+                try:
+                    req = recv_msg(conn, pre=head)
                 except (ConnectionError, ValueError) as exc:
                     warning("job-server: dropping connection: %s", exc)
                     return
@@ -253,6 +277,51 @@ class JobServer:
                     send_msg(conn, reply)
                 except OSError:
                     return
+
+    def _serve_http(self, conn: socket.socket, head: bytes) -> None:
+        """One-shot HTTP scrape: ``GET /metrics`` answers the Prometheus
+        text exposition (cross-rank aggregated); anything else 404s.
+        The request head is drained (bounded) so pipelined headers do
+        not linger in the kernel buffer past the close; a stalled
+        scraper (slow-loris) trips the socket timeout instead of
+        pinning this connection thread forever — this path invites
+        arbitrary external HTTP clients onto the port."""
+        try:
+            conn.settimeout(10.0)
+        except OSError:
+            return
+        data = head
+        while b"\r\n\r\n" not in data and len(data) < 8192:
+            try:
+                chunk = conn.recv(1024)
+            except OSError:
+                return
+            if not chunk:
+                break
+            data += chunk
+        line = data.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+        parts = line.split()
+        path = (parts[1] if len(parts) > 1 else "/").split("?", 1)[0]
+        if path.rstrip("/") == "/metrics" or path == "/":
+            from parsec_tpu.prof.metrics import cluster_exposition
+            try:
+                text, _ranks = cluster_exposition(self.service.context)
+            except Exception as exc:   # scrape must answer, not hang up
+                text = f"# scrape failed: {exc}\n"
+            status, body = "200 OK", text.encode()
+        else:
+            status = "404 Not Found"
+            body = b"parsec_tpu job server: scrape GET /metrics\n"
+        hdrs = (f"HTTP/1.0 {status}\r\n"
+                "Content-Type: text/plain; version=0.0.4; "
+                "charset=utf-8\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n")
+        try:
+            conn.sendall(hdrs.encode()
+                         + (b"" if head == b"HEAD" else body))
+        except OSError:
+            pass
 
     # -- request handling --------------------------------------------------
     def _job_of(self, req: Dict[str, Any]):
@@ -287,6 +356,13 @@ class JobServer:
             return {"ok": True, "stats": self.service.stats()}
         if op == "gauges":
             return {"ok": True, "gauges": self.service.gauges.snapshot()}
+        if op == "metrics":
+            from parsec_tpu.prof.metrics import cluster_exposition
+            text, ranks = cluster_exposition(
+                self.service.context,
+                aggregate=bool(req.get("aggregate", True)),
+                timeout=float(req.get("timeout", 2.0)))
+            return {"ok": True, "text": text, "ranks": ranks}
         if op == "apps":
             return {"ok": True, "apps": sorted(APPS)}
         raise ValueError(f"unknown op {op!r}")

@@ -111,6 +111,13 @@ class JobService:
         self._degraded_ranks: set = set()
         self.gauges = JobGauges(self)
         self.gauges.install(context)
+        # the always-on metrics registry (prof/metrics.py) folds the
+        # service view into its scrape: job queue depths, degraded
+        # flag, per-job task counters over the JobGauges window, and
+        # the admission->completion SLO histograms fed by the job_*
+        # PINS events this service already emits
+        if getattr(context, "metrics", None) is not None:
+            context.metrics.attach_service(self)
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="job-service", daemon=True)
         self._thread.start()
@@ -419,6 +426,8 @@ class JobService:
             self._space.notify_all()
         self._thread.join(timeout=5)
         self.gauges.uninstall(self.context)
+        if getattr(self.context, "metrics", None) is not None:
+            self.context.metrics.detach_service(self)
         if self._own_context:
             self.context.fini()
 

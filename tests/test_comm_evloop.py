@@ -523,3 +523,60 @@ def test_undelivered_backlog_replayed_on_register():
         assert got == [(1, {"early": True})]
     finally:
         _fini([ce0, ce1])
+
+
+# -- multi-core-host validation of the evloop freed-core claim ---------------
+# (BENCH.md r6 residual: the r6 threads-vs-evloop parity was measured on
+# a 1-core host, where the freed progress-thread core cannot show up.)
+
+def _mc_pingpong_worker(ctx, rank, nranks, nbytes, hops):
+    from parsec_tpu.apps.pingpong import run_pingpong
+    run_pingpong(ctx, nbytes, 4)            # warm the link
+    per_hop, mbps = run_pingpong(ctx, nbytes, hops)
+    return per_hop, mbps, ctx.comm.stats()["transport"]
+
+
+@pytest.mark.slow
+def test_evloop_threads_parity_multicore():
+    """Paired A/B on a host with >= 2 cores: the evloop transport must
+    hold parity with the threads transport (generous band — CI hosts
+    are noisy), and the datapoint is archived to a JSON file + the
+    test log so the BENCH.md r6 freed-core claim accumulates real
+    multi-core evidence (bw/rtt bench lines now record the host core
+    inventory for the same reason)."""
+    import json
+    import os
+    import tempfile
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    if cores < 2:
+        pytest.skip("multi-core validation needs >= 2 available cores "
+                    f"(have {cores}); the 1-core parity leg is BENCH.md "
+                    "r6")
+    results = {}
+    for transport in ("threads", "evloop"):
+        prior = os.environ.get("PARSEC_MCA_COMM_TRANSPORT")
+        os.environ["PARSEC_MCA_COMM_TRANSPORT"] = transport
+        try:
+            res = run_distributed(_mc_pingpong_worker, 2,
+                                  args=(1 << 20, 24), timeout=240)
+        finally:
+            if prior is None:
+                os.environ.pop("PARSEC_MCA_COMM_TRANSPORT", None)
+            else:
+                os.environ["PARSEC_MCA_COMM_TRANSPORT"] = prior
+        assert all(r[2] == transport for r in res), res
+        results[transport] = round(max(r[1] for r in res), 1)  # MB/s
+    ratio = results["evloop"] / results["threads"]
+    datapoint = {"cpu_count": os.cpu_count(), "cores_available": cores,
+                 "bw_mbps": results, "evloop_over_threads": round(ratio, 3)}
+    out = os.path.join(tempfile.gettempdir(),
+                       "parsec_evloop_multicore.json")
+    with open(out, "w") as fh:
+        json.dump(datapoint, fh)
+    print(f"multicore evloop datapoint (archived {out}): {datapoint}")
+    # parity band: evloop must not collapse where cores stop being
+    # shared; the freed-core UPSIDE is informational (the datapoint)
+    assert ratio >= 0.5, datapoint

@@ -572,3 +572,165 @@ def test_dtd_region_ordering_under_delay_plan_20x():
         res = _run_distributed_with_env(_region_ordering_only, 2, env,
                                         timeout=120)
         assert res == ["ok"] * 2, f"seed {seed}"
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder's incident path (ISSUE 8): chaos kill under an
+# armed ring must yield a merged, clock-aligned incident bundle
+# ---------------------------------------------------------------------------
+
+def test_flightrec_kill_rank_yields_merged_bundle(tmp_path):
+    """chaos ``kill_rank`` with the flight recorder armed: both ranks'
+    rings land in ONE bundle directory, the merged trace is
+    clock-aligned with matched comm_send/dep_deliver pairs covering
+    the kill window, and ``tools/trace2chrome.py --merge`` opens it
+    unchanged."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chaos
+    bundle = str(tmp_path / "bundle")
+    with pytest.raises(RuntimeError) as ei:
+        # the potrf workload rides the PTG activation path, so the ring
+        # holds dep_deliver points (the DTD path's deliveries are lane
+        # applies); frame delays stretch the run past the kill instant
+        _run_distributed_with_env(
+            chaos.potrf_workload, 2,
+            {"PARSEC_MCA_FAULT_PLAN":
+                 "seed=7;kill_rank=1@t+0.8s,mode=close;"
+                 "delay_frame=tag:ACT,p=1,ms=150",
+             "PARSEC_MCA_FLIGHTREC_ENABLED": "1",
+             "PARSEC_MCA_FLIGHTREC_DIR": bundle,
+             "PARSEC_CHAOS_WAIT_S": "30"})
+    assert "PeerFailedError" in str(ei.value)
+    # the survivor's containment dumped its ring; the killed rank's own
+    # failing sends dumped the other side of every flow edge
+    import glob
+    traces = sorted(glob.glob(os.path.join(bundle, "rank*.ptt")))
+    assert len(traces) == 2, traces
+    from parsec_tpu.prof.flightrec import summarize_bundle
+    s = summarize_bundle(bundle)
+    assert s["ranks"] == [0, 1]
+    assert s["events"] > 0
+    assert s["flows"]["matched"] >= 1, s
+    assert s["incidents"] and any("PeerFailedError" in i["reason"]
+                                  or "error" in i["reason"]
+                                  for i in s["incidents"])
+    # the merged trace pairs sends with deliveries on the consumer oid
+    from parsec_tpu.prof.critpath import merge_traces
+    df, _metas = merge_traces(traces)
+    sends = {tuple(r.info["corr"]) for r in
+             df[df["name"] == "comm_send"].itertuples()
+             if r.info and r.info.get("corr")}
+    delivers = {tuple(r.info["corr"]) for r in
+                df[df["name"] == "dep_deliver"].itertuples()
+                if r.info and r.info.get("corr")}
+    assert sends & delivers, (len(sends), len(delivers))
+    # trace2chrome --merge opens the bundle unchanged
+    out = str(tmp_path / "incident.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace2chrome.py"),
+         "--merge", *traces, "-o", out],
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json as json_mod
+    with open(out) as fh:
+        chrome = json_mod.load(fh)
+    assert chrome["traceEvents"], "empty merged timeline"
+    pids = {e.get("pid") for e in chrome["traceEvents"]}
+    assert {0, 1} <= pids, pids
+
+
+def test_flightrec_autopsy_names_bundle(tmp_path):
+    """The hang autopsy's text points the reader at the incident
+    bundle when the recorder is armed (and dumps it)."""
+    from parsec_tpu.core.context import Context
+    params.set("flightrec_enabled", 1)
+    params.set("flightrec_dir", str(tmp_path))
+    try:
+        with Context(nb_cores=1) as ctx:
+            report = ctx.hang_autopsy()
+            assert "flight recorder incident bundle" in report
+            assert str(tmp_path) in report
+            assert "trace2chrome.py --merge" in report
+            # the dump runs on its own thread (containment must not
+            # stall the comm loop): wait for it to land
+            deadline = time.monotonic() + 10
+            while ctx._flightrec.incidents < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ctx._flightrec.incidents == 1
+            assert (tmp_path / "rank0.ptt").exists()
+            # the dump is rate-limited: a second autopsy re-reports the
+            # SAME bundle instead of thrashing the disk
+            ctx.hang_autopsy()
+            time.sleep(0.1)
+            assert ctx._flightrec.incidents == 1
+    finally:
+        params.unset("flightrec_enabled")
+        params.unset("flightrec_dir")
+
+
+# ---------------------------------------------------------------------------
+# the donation soak (ISSUE 8 satellite): device_fuse_donate default flip
+# ---------------------------------------------------------------------------
+
+def test_fuse_donate_default_on():
+    """Post-soak default: chained launches donate; the knob remains the
+    off-switch."""
+    assert int(params.get("device_fuse_donate", 1)) == 1
+
+
+@pytest.mark.slow
+def test_fused_chain_donation_soak():
+    """The ROADMAP-mandated soak behind the device_fuse_donate=1 flip:
+    50+ fused-chain geqrf/potrf iterations under seeded delay_dispatch
+    load, asserting ZERO wrong results.  (The r8 wrong-R reproduced at
+    ~2/22 under this load before the device_put_private fix; the flip
+    rides this green loop.)"""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    from parsec_tpu.apps.qr import qr_taskpool
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    assert int(params.get("device_fuse_donate", 1)) == 1
+    faultinject.arm("seed=53;delay_dispatch=ms=3,p=0.3")
+    try:
+        mb, nt = 16, 5
+        n = mb * nt
+        rng = np.random.default_rng(8)
+        with Context(nb_cores=4) as ctx:
+            chained0 = sum(d.stats.chained_launches
+                           for d in ctx.device_registry.accelerators)
+            for i in range(52):
+                if i % 2 == 0:
+                    a = rng.standard_normal((n, n)).astype(np.float32)
+                    Q = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n,
+                                          name=f"soakQ{i}").from_array(
+                        a.copy())
+                    Q.distribute_devices(ctx)
+                    ctx.add_taskpool(qr_taskpool(Q, device="tpu"))
+                    ctx.wait(timeout=120)
+                    R = np.triu(Q.to_array())
+                    ata = (a.T @ a).astype(np.float64)
+                    qerr = np.abs(R.astype(np.float64).T @ R - ata).max() \
+                        / np.abs(ata).max()
+                    assert qerr < 1e-4, f"iter {i}: wrong R ({qerr:.3e})"
+                else:
+                    b = rng.standard_normal((n, n)).astype(np.float32)
+                    spd = (b @ b.T + n * np.eye(n)).astype(np.float32)
+                    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n,
+                                          name=f"soakA{i}").from_array(
+                        spd.copy())
+                    A.distribute_devices(ctx)
+                    ctx.add_taskpool(potrf_taskpool(A, device="tpu"))
+                    ctx.wait(timeout=120)
+                    L = np.tril(A.to_array()).astype(np.float64)
+                    perr = np.abs(L @ L.T - spd).max() / np.abs(spd).max()
+                    assert perr < 1e-4, f"iter {i}: wrong L ({perr:.3e})"
+            chained = sum(d.stats.chained_launches
+                          for d in ctx.device_registry.accelerators)
+        # the soak must actually have exercised chained (donating)
+        # launches, not the plain path
+        assert chained > chained0, "no fused chains ran — soak is void"
+    finally:
+        faultinject.disarm()

@@ -35,8 +35,16 @@ import os
 import re
 import sys
 
+#: ratio-valued metrics compared by ABSOLUTE delta, smaller is better:
+#: their normal baseline is 0.0 (the telemetry gate's min-of-pairs
+#: clamps there), where relative change is undefined and any ratio or
+#: cap scheme turns noise into a discontinuity.  Their derived
+#: vs_baseline is skipped for the same reason — the value IS the gate.
+ABSOLUTE_DELTA = ("telemetry_overhead",)
+
 #: metrics where SMALLER is better (everything else: bigger is better)
-LOWER_IS_BETTER = ("task_rtt", "tracer_overhead", "backward_error",
+LOWER_IS_BETTER = ("task_rtt", "tracer_overhead", "telemetry_overhead",
+                   "backward_error",
                    "factorization_residual",
                    # bw/rtt protocol-mix guards (the r6 event-loop
                    # transport): more wire frames or more syscalls per
@@ -69,7 +77,11 @@ SKIP_KEYS = {"metric", "unit", "storage", "note", "ib",
              # informational: the buckets reshuffle with host load and
              # have no regression direction; the tracer-overhead gate
              # is the off-vs-on tasks comparison in premerge_bench.sh
-             "attribution"}
+             "attribution",
+             # host core inventory on bw/rtt lines (where the number
+             # was measured, not what was measured) and the telemetry
+             # mode's raw side readings (the gated value is the ratio)
+             "host", "tasks_off", "tasks_on"}
 
 
 def _load(path: str) -> dict:
@@ -178,6 +190,17 @@ def compare(new: dict, prev: dict, threshold: float):
     lines = []
     for path in sorted(set(new_f) & set(prev_f)):
         a, b = prev_f[path], new_f[path]
+        if any(tag in path for tag in ABSOLUTE_DELTA):
+            if path.endswith("vs_baseline"):
+                continue
+            delta = b - a
+            bad = delta > threshold
+            mark = "REGRESSION" if bad else "ok"
+            lines.append(f"  {path}: {a:g} -> {b:g} "
+                         f"({delta:+.3f} abs) {mark}")
+            if bad:
+                regressions.append((path, a, b, delta))
+            continue
         if a == 0:
             continue
         change = (b - a) / abs(a)

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Scrape a resident parsec_tpu job server's telemetry plane.
+
+One-shot (prints the Prometheus text exposition, cross-rank aggregated
+over TAG_METRICS by the server) or ``--watch`` (re-scrapes on an
+interval and prints per-second rates for counter families):
+
+    python tools/metrics_client.py --port 41990
+    python tools/metrics_client.py --watch 2
+    python tools/metrics_client.py --grep parsec_comm
+    curl http://127.0.0.1:41990/metrics        # same data, plain HTTP
+
+The framed request is ``{"op": "metrics"}`` (service/server.py); pass
+``--local`` to skip the cross-rank pull and read only the server
+rank's registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+def scrape(host: str, port: int, aggregate: bool = True,
+           timeout: float = 10.0) -> str:
+    from parsec_tpu.service.server import request
+    reply = request(host, port, {"op": "metrics", "aggregate": aggregate},
+                    timeout=timeout)
+    if not reply.get("ok"):
+        raise RuntimeError(f"scrape failed: {reply.get('error')}")
+    return reply["text"]
+
+
+def _parse_counters(text: str):
+    """name{labels} -> value for counter-typed series (rate display)."""
+    out = {}
+    typ = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _h, _t, name, kind = line.split()
+            typ[name] = kind
+            continue
+        if line.startswith("#") or not line.strip():
+            continue
+        try:
+            key, val = line.rsplit(" ", 1)
+            base = key.split("{", 1)[0]
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix):
+                    base = base[:-len(suffix)]
+            if typ.get(base) == "counter":   # labeled series included
+                out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=None,
+                    help="job-server port (default: the registered "
+                         "service_port knob, 41990)")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=0.0,
+                    help="re-scrape on this interval; counter families "
+                         "print per-second rates alongside totals")
+    ap.add_argument("--grep", default="",
+                    help="only print lines containing this substring")
+    ap.add_argument("--local", action="store_true",
+                    help="server rank only (skip the TAG_METRICS "
+                         "cross-rank pull)")
+    args = ap.parse_args(argv)
+    port = args.port
+    if port is None:
+        from parsec_tpu.utils.mca import params
+        port = int(params.get("service_port", 41990))
+
+    def emit(text: str) -> None:
+        for line in text.splitlines():
+            if args.grep and args.grep not in line:
+                continue
+            print(line)
+
+    if args.watch <= 0:
+        emit(scrape(args.host, port, aggregate=not args.local))
+        return 0
+
+    prev = None
+    prev_t = None
+    while True:
+        text = scrape(args.host, port, aggregate=not args.local)
+        now = time.monotonic()
+        print(f"--- scrape @ {time.strftime('%H:%M:%S')} ---")
+        emit(text)
+        cur = _parse_counters(text)
+        if prev is not None and now > prev_t:
+            dt = now - prev_t
+            rates = [(k, (v - prev.get(k, 0.0)) / dt)
+                     for k, v in sorted(cur.items())
+                     if v != prev.get(k, 0.0)]
+            if rates:
+                print("--- rates (per second) ---")
+                for k, r in rates:
+                    if not args.grep or args.grep in k:
+                        print(f"{k} {r:.1f}/s")
+        prev, prev_t = cur, now
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

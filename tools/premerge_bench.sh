@@ -25,9 +25,21 @@
 # correctly or fails with a structured error within its deadline.  The
 # full catalog is `python tools/chaos.py --seeds 12`.
 #
-# Usage:  sh tools/premerge_bench.sh [threshold] [trace_bound]
+# r10 adds the TELEMETRY-OVERHEAD gate: the always-on metrics registry
+# plus an ARMED flight recorder must cost <= $telemetry_bound (default
+# 5%) on the tasks probe — an order cheaper than the causal tracer's
+# 50% gate, which is the point of the production telemetry plane.  The
+# measurement is bench.py's telemetry mode (four back-to-back off/on
+# pairs in one process, gating on the MINIMUM pair ratio — host-load
+# noise contaminates single pairs in either direction but a real
+# regression shows in all of them; bench_guard knows
+# telemetry_overhead as lower-is-better should future artifacts
+# record it).
+#
+# Usage:  sh tools/premerge_bench.sh [threshold] [trace_bound] [telemetry_bound]
 #         threshold:   relative regression that fails (default 0.15)
 #         trace_bound: max tracing-on slowdown of tasks/s (default 0.50)
+#         telemetry_bound: max metrics+flightrec slowdown (default 0.05)
 # r9 prepends the PARSECLINT gate: the project static analyzer
 # (tools/parseclint — lock discipline, event-loop blocking calls,
 # device_put aliasing, MCA knob drift, containment exception hygiene,
@@ -37,6 +49,7 @@ set -e
 repo="$(cd "$(dirname "$0")/.." && pwd)"
 threshold="${1:-0.15}"
 trace_bound="${2:-0.50}"
+telemetry_bound="${3:-0.05}"
 rc=0
 tasks_off=""
 echo "== premerge gate: parseclint (static analysis) =="
@@ -92,6 +105,34 @@ else
     rc=1
 fi
 rm -f "$tasks_off" "$on"
+echo "== premerge probe: telemetry overhead (metrics + flight recorder armed) =="
+tel="/tmp/premerge_telemetry_$$.json"
+if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=telemetry \
+     python "$repo/bench.py" > "$tel" 2>/dev/null; then
+    if ! python - "$tel" "$telemetry_bound" <<'EOF'
+import json, sys
+def last_json(path):
+    for line in reversed(open(path).read().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"premerge: no JSON in {path}")
+obj = last_json(sys.argv[1])
+overhead = obj["value"]
+bound = float(sys.argv[2])
+print(f"premerge: telemetry overhead {overhead:+.1%} "
+      f"(bound {bound:.0%}; off {obj.get('tasks_off')} -> "
+      f"armed {obj.get('tasks_on')} tasks/s)")
+sys.exit(1 if overhead > bound else 0)
+EOF
+    then
+        rc=1
+    fi
+else
+    echo "premerge: telemetry probe FAILED to run"
+    rc=1
+fi
+rm -f "$tel"
 echo "== premerge probe: chaos (seeded fault plans, no-hang invariant) =="
 if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 4 --quick; then
     rc=1
