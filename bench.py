@@ -501,6 +501,10 @@ def _pp_worker(ctx, rank, nranks, nbytes, hops):
              if isinstance(v, (int, float)) and not isinstance(v, bool)
              and isinstance(before.get(k), (int, float))}
     delta["transport"] = after.get("transport")
+    # which native paths were live on this rank (the r11 A/B record):
+    # a 1 here with zero frames_parsed_native movement is a no-op
+    # native path — exactly what the premerge pairing exists to catch
+    delta["sched_native"] = 1 if ctx.scheduler.name == "native" else 0
     if trace_dir:
         mod.uninstall(ctx)
         tr.uninstall(ctx)
@@ -535,6 +539,8 @@ def _protocol_breakdown(res) -> dict:
     mb = max(agg.get("bytes_sent", 0) + agg.get("bytes_recv", 0), 1) / 1e6
     out = {
         "transport": res[0][2].get("transport"),
+        "sched_native": 1 if agg.get("sched_native") else 0,
+        "frames_parsed_native": int(agg.get("frames_parsed_native", 0)),
         "frames_sent": int(agg.get("frames_sent", 0)),
         "act_eager": int(agg.get("act_eager", 0)),
         "act_rdv": int(agg.get("act_rdv", 0)),
@@ -592,17 +598,26 @@ def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
     from parsec_tpu.comm.launch import run_distributed
     prior = os.environ.get("PARSEC_MCA_comm_eager_limit")
     prior_ad = os.environ.get("PARSEC_MCA_comm_adaptive_eager")
+    prior_ring = os.environ.get("PARSEC_MCA_COMM_SHM_RING_MB")
     os.environ.setdefault("PARSEC_MCA_comm_eager_limit",
                           str(nbytes * 2))
     # the probe PINS its protocol: adaptation would let a loaded host
     # demote hops to rendezvous mid-run and flip what is being measured
     os.environ.setdefault("PARSEC_MCA_comm_adaptive_eager", "0")
+    # shm: size the ring for the probe's payload class (4x message —
+    # measured r11: 8MB ring 379, 16MB 538, 32MB 708 MB/s at 8MB
+    # payloads; a ring the producer can stream a whole frame into
+    # without interleaving the consumer's parse wins).  The same MCA
+    # tuning the eager pin above is; no-op on the TCP transports.
+    os.environ.setdefault("PARSEC_MCA_COMM_SHM_RING_MB",
+                          str(max(8, (nbytes * 4) >> 20)))
     try:
         res = run_distributed(_pp_worker, 2, args=(nbytes, hops),
                               timeout=300)
     finally:
         for key, val in (("PARSEC_MCA_comm_eager_limit", prior),
-                         ("PARSEC_MCA_comm_adaptive_eager", prior_ad)):
+                         ("PARSEC_MCA_comm_adaptive_eager", prior_ad),
+                         ("PARSEC_MCA_COMM_SHM_RING_MB", prior_ring)):
             if val is None:
                 os.environ.pop(key, None)
             else:
@@ -660,7 +675,9 @@ def run_tasks_bench(n: int = 20000):
         if mod is not None:
             mod.uninstall(ctx)
             tr.uninstall(ctx)
-    return n / dt
+        native = {"sched_native":
+                  1 if ctx.scheduler.name == "native" else 0}
+    return n / dt, {"native": native}
 
 
 def run_telemetry_bench(n: int = 20000):

@@ -191,7 +191,7 @@ class CommStats:
 
     FIELDS = ("frames_sent", "frames_recv", "bytes_sent", "bytes_recv",
               "syscalls_send", "syscalls_recv", "partial_writes",
-              "wakeups")
+              "wakeups", "frames_parsed_native")
 
     __slots__ = FIELDS
 
@@ -236,10 +236,31 @@ def _dial_peer(host: str, port: int, myrank: int,
     return s
 
 
+_nat_parts = None
+_nat_parts_tried = False
+
+
+def _native_parts():
+    """commext.frame_parts when the native frame path is on and builds
+    (resolved once per process — the A/B knob is read at first frame)."""
+    global _nat_parts, _nat_parts_tried
+    if not _nat_parts_tried:
+        _nat_parts_tried = True
+        from parsec_tpu.comm.frames import params as _p
+        if int(_p.get("comm_frame_native", 1)):
+            from parsec_tpu.native import load_commext
+            cx = load_commext()
+            if cx is not None:
+                _nat_parts = cx.frame_parts
+    return _nat_parts
+
+
 def _frame_parts(tag: int, payload: Any) -> List[Any]:
     """Serialize one AM into its wire parts (header, pickle body, then
     per-buffer length + raw buffer).  Large array payloads ride OUT OF
-    BAND (pickle protocol 5) — no full-payload serialization copy."""
+    BAND (pickle protocol 5) — no full-payload serialization copy.
+    The part-list assembly (every length header) is one C call when
+    the native frame path is armed (commext.frame_parts)."""
     bufs: List[Any] = []
     raws: List[Any] = []
     if payload is not None:
@@ -253,6 +274,9 @@ def _frame_parts(tag: int, payload: Any) -> List[Any]:
             raws = []
     else:
         data = b""
+    nat = _native_parts()
+    if nat is not None:
+        return nat(tag, data, raws)
     parts: List[Any] = [_LEN.pack(tag, len(data), len(raws)), data]
     for raw in raws:
         parts.append(_BUFLEN.pack(raw.nbytes))
@@ -269,6 +293,8 @@ class CommEngine:
     #: layer queries to pick eager vs rendezvous and threading mode)
     CAP_ONESIDED = True     # put/get over registered regions
     CAP_MT = True           # sends are thread-safe
+    #: transport name recorded in stats()/bench protocol breakdowns
+    TRANSPORT = "base"
 
     def __init__(self, rank: int, nranks: int):
         self.rank = rank
@@ -485,19 +511,23 @@ class CommEngine:
 
     # -- clock alignment (causal traces): Cristian-style ping exchange --
     # lint: on-loop (periodic hook on the comm loop/progress thread)
-    def probe_clocks(self, samples: Optional[int] = None) -> None:
+    def probe_clocks(self, samples: Optional[int] = None) -> int:
         """Fire one offset-probe round at every live peer: ``samples``
         pings whose pongs fold into ``self.clock`` asynchronously (the
         estimator keeps the minimum-RTT sample).  TAG_CLOCK rides the
         control lane (_CTL_TAGS) so a ping measures protocol latency,
-        not the bulk queue it would otherwise sit behind."""
+        not the bulk queue it would otherwise sit behind.  Returns the
+        number of peers probed — the threaded progress loop retries
+        quickly until the FIRST round actually went out."""
         if self.nranks == 1:
-            return
+            return 0
         n = samples if samples is not None \
             else max(1, int(params.get("comm_clock_samples", 4)))
+        probed = 0
         for r in range(self.nranks):
             if r == self.rank or r in self.dead_peers:
                 continue
+            probed += 1
             for _ in range(n):
                 try:
                     self.send_am(TAG_CLOCK, r,
@@ -505,6 +535,7 @@ class CommEngine:
                                   "t0": time.perf_counter()})
                 except OSError:
                     break
+        return probed
 
     # lint: on-loop (AM callback)
     def _clock_cb(self, src: int, msg: dict) -> None:
@@ -1047,9 +1078,53 @@ class CommEngine:
                 return
         cb(src, payload)
 
+    def _safe_dispatch(self, tag: int, src: int, payload: Any) -> None:
+        try:
+            self._dispatch(tag, src, payload)
+        except Exception as exc:   # handler error must not kill the loop,
+            warning("rank %d: AM handler tag=%d failed: %s",
+                    self.rank, tag, exc)
+            if self.on_error is not None:   # ...but must fail the rank
+                self.on_error(exc)
+
+    def _deliver_frames(self, frames, src: int, native: bool,
+                        sever: Callable[[str], None],
+                        alive: Callable[[], bool]) -> bool:
+        """Shared delivery of parser-completed frames (the evloop and
+        shm transports' one dispatch loop): stats, unpickle, recv-side
+        fault holds, dispatch.  ``sever(why)`` is the transport's
+        corruption path; ``alive()`` says whether to keep dispatching
+        after a handler ran (it may have torn the peer down).  Returns
+        False when the caller must stop reading this peer."""
+        for tag, body, oob in frames:
+            self.recv_msgs += 1
+            self.stats.frames_recv += 1
+            if native:
+                self.stats.frames_parsed_native += 1
+            self._note_heard(src)
+            if body is not None:
+                try:
+                    payload = pickle.loads(body, buffers=oob)
+                except Exception as exc:
+                    sever(f"undecodable frame tag={tag}: {exc}")
+                    return False
+            else:
+                payload = None
+            if self._fault is not None and \
+                    self._recv_fault_hold(tag, src, payload):
+                if not alive():
+                    return False
+                continue   # redelivery scheduled; later frames flow
+            self._safe_dispatch(tag, src, payload)
+            if not alive():
+                return False
+        return True
+
 
 class SocketCE(CommEngine):
     """TCP active-message engine (the mpi_funnelled analog)."""
+
+    TRANSPORT = "threads"
 
     def __init__(self, rank: int, nranks: int,
                  port_base: Optional[int] = None):
@@ -1336,6 +1411,31 @@ class SocketCE(CommEngine):
         with self._send_locks[dst]:
             self._sendmsg_all(s, parts)
 
+    def probe_clocks(self, samples: Optional[int] = None) -> int:
+        # clock pings ride send_am, and send_am to an undialed higher
+        # rank parks in _connect's 30s wait — which would starve the
+        # progress thread that also runs the failure detectors (the
+        # _hb_send lesson).  Probe ESTABLISHED peers only; a peer still
+        # dialing in gets its first round once the progress loop sees
+        # it established (the fast first-round retry).
+        if self.nranks == 1 or self._muted:
+            return 0
+        n = samples if samples is not None \
+            else max(1, int(params.get("comm_clock_samples", 4)))
+        with self._plock:
+            established = [r for r in self._peers
+                           if r != self.rank and
+                           r not in self.dead_peers]
+        for r in established:
+            for _ in range(n):
+                try:
+                    self.send_am(TAG_CLOCK, r,
+                                 {"k": "ping", "n": n,
+                                  "t0": time.perf_counter()})
+                except OSError:
+                    break
+        return len(established)
+
     def _hb_send(self, r: int) -> None:
         # NEVER block the progress thread on a heartbeat: only beat
         # ESTABLISHED connections (send_am to an undialed higher rank
@@ -1489,6 +1589,9 @@ class _EvPeer:
         # receive state machine
         "r_stage", "r_want", "r_got", "r_view", "r_buf", "r_small",
         "r_tag", "r_ln", "r_nbufs", "r_body", "r_oob",
+        # native frame parser (comm/frames.py make_parser): when set,
+        # the receive path feeds it instead of the inline machinery
+        "fparser", "fp_native",
         # send side: queued frames -> wire-committed views -> kernel
         "q_ctl", "q_bulk", "wire", "marks", "out_bytes", "want_write",
         # adaptive-protocol feedback (updated as frames drain)
@@ -1509,6 +1612,8 @@ class _EvPeer:
         self.r_tag = self.r_ln = self.r_nbufs = 0
         self.r_body: Any = b""
         self.r_oob: List[bytearray] = []
+        self.fparser = None
+        self.fp_native = False
         self.q_ctl: deque = deque()
         self.q_bulk: deque = deque()
         self.wire: deque = deque()   # memoryviews committed to wire order
@@ -1545,6 +1650,7 @@ class EventLoopCE(CommEngine):
 
     FUNNELLED = True   # callbacks + sends are funnelled onto ONE thread
     CAP_MT = True      # send_am remains thread-safe (via the ring)
+    TRANSPORT = "evloop"
 
     def __init__(self, rank: int, nranks: int,
                  port_base: Optional[int] = None):
@@ -1795,6 +1901,13 @@ class EventLoopCE(CommEngine):
         s.setblocking(False)
         self._post(("adopt", s, dst))
 
+    def _attach_parser(self, peer: _EvPeer) -> None:
+        """Arm the native frame parser for a post-handshake stream
+        (comm_frame_native); None keeps the inline Python machinery —
+        which IS the A/B fallback path here."""
+        from parsec_tpu.comm.frames import make_parser
+        peer.fparser, peer.fp_native = make_parser(self._max_frame)
+
     def _adopt(self, sock: socket.socket, rank: int) -> None:
         peer = self._peers.get(rank)
         if peer is not None and peer.sock is None:
@@ -1803,6 +1916,9 @@ class EventLoopCE(CommEngine):
         else:
             peer = _EvPeer(rank, sock)
             self._peers[rank] = peer
+        # outbound stream: WE sent the handshake, the peer's bytes are
+        # frames from the first one — parse natively when available
+        self._attach_parser(peer)
         self._sel.register(sock, selectors.EVENT_READ, ("peer", peer))
         peer.registered = True
         self._note_heard(rank)
@@ -2056,6 +2172,9 @@ class EventLoopCE(CommEngine):
     def _on_read(self, peer: _EvPeer) -> None:
         if self._muted:
             return   # injected silent hang: stop consuming
+        if peer.fparser is not None:
+            self._on_read_native(peer)
+            return
         budget = _RECV_BUDGET
         scratch = self._scratch
         smv = self._scratch_mv
@@ -2111,8 +2230,67 @@ class EventLoopCE(CommEngine):
                 if n < len(scratch):
                     return        # socket drained
 
+    def _on_read_native(self, peer: _EvPeer) -> None:
+        """Receive path over the native frame parser: the per-frame
+        state machine runs in ONE C crossing per read (commext.c), and
+        an in-progress large payload is recv_into'd straight into the
+        parser's own buffer — the zero-copy out-of-band path."""
+        budget = _RECV_BUDGET
+        scratch = self._scratch
+        smv = self._scratch_mv
+        stats = self.stats
+        fp = peer.fparser
+        while budget > 0 and peer.sock is not None:
+            tgt = fp.bulk_target()
+            want = len(tgt) if tgt is not None else len(scratch)
+            try:
+                n = peer.sock.recv_into(tgt if tgt is not None
+                                        else scratch)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as exc:
+                self._peer_down(peer, f"recv failed: {exc}")
+                return
+            if n == 0:
+                self._eof(peer)
+                return
+            stats.syscalls_recv += 1
+            stats.bytes_recv += n
+            # liveness per chunk, not per completed frame (see the
+            # fallback path's rationale)
+            self._note_heard(peer.rank)
+            budget -= n
+            try:
+                frames = fp.bulk_commit(n) if tgt is not None \
+                    else fp.feed(smv[:n])
+            except ValueError as exc:
+                self._sever(peer, str(exc))
+                return
+            if frames and not self._dispatch_frames(peer, frames):
+                return
+            if n < want:
+                return        # socket drained
+
+    def _dispatch_frames(self, peer: _EvPeer, frames) -> bool:
+        """Deliver parser-completed frames; False = stop reading this
+        peer (severed / handed off by a handler)."""
+        return self._deliver_frames(
+            frames, peer.rank, peer.fp_native,
+            lambda why: self._sever(peer, why),
+            lambda: peer.sock is not None)
+
     def _feed(self, peer: _EvPeer, mv: memoryview) -> bool:
         while len(mv):
+            if peer.fparser is not None:
+                # the handshake completed inside this read and armed
+                # the parser: the remaining bytes are frame stream
+                try:
+                    frames = peer.fparser.feed(mv)
+                except ValueError as exc:
+                    self._sever(peer, str(exc))
+                    return False
+                return self._dispatch_frames(peer, frames) if frames \
+                    else peer.sock is not None
             take = peer.r_want - peer.r_got
             if take > len(mv):
                 take = len(mv)
@@ -2167,6 +2345,10 @@ class EventLoopCE(CommEngine):
             self._anon.discard(peer)
             self._note_heard(src)
             self._expect_hdr(peer)
+            # handshake done: the rest of the stream is frames — hand
+            # it to the native parser (any bytes that followed the
+            # handshake in this same read are routed by _feed)
+            self._attach_parser(peer)
             self._flush(peer)
             return peer.sock is not None
         if st == _ST_HDR:
@@ -2244,15 +2426,6 @@ class EventLoopCE(CommEngine):
         self._safe_dispatch(tag, src, payload)
         return peer.sock is not None
 
-    def _safe_dispatch(self, tag: int, src: int, payload: Any) -> None:
-        try:
-            self._dispatch(tag, src, payload)
-        except Exception as exc:   # handler error must not kill the loop,
-            warning("rank %d: AM handler tag=%d failed: %s",
-                    self.rank, tag, exc)
-            if self.on_error is not None:   # ...but must fail the rank
-                self.on_error(exc)
-
     def _deliver_held(self, tag: int, src: int, payload: Any) -> None:
         # funnelled contract: handlers run ONLY on the loop thread — a
         # Timer-thread dispatch (the base-class redelivery) would race
@@ -2260,6 +2433,12 @@ class EventLoopCE(CommEngine):
         self._post(("call", self._safe_dispatch, (tag, src, payload)))
 
     def _eof(self, peer: _EvPeer) -> None:
+        if peer.fparser is not None:
+            if peer.fparser.idle():
+                self._peer_down(peer, None)  # closed between frames
+            else:
+                self._peer_down(peer, "peer died mid-frame")
+            return
         if peer.r_stage == _ST_HDR and peer.r_got == 0:
             self._peer_down(peer, None)      # closed between frames
         elif peer.r_stage == _ST_HS:
@@ -2316,13 +2495,23 @@ class EventLoopCE(CommEngine):
 def make_ce(rank: int, nranks: int,
             port_base: Optional[int] = None) -> CommEngine:
     """Transport factory: ``comm_transport`` MCA knob (env
-    ``PARSEC_MCA_COMM_TRANSPORT``) selects ``evloop`` (default) or
-    ``threads`` — the pre-event-loop path kept selectable for A/B
-    attribution, mirroring the device_fuse_* knob convention."""
+    ``PARSEC_MCA_COMM_TRANSPORT``) selects ``evloop`` (default),
+    ``threads`` (the pre-event-loop path kept selectable for A/B
+    attribution), or ``shm`` (same-host mmap ring pairs, comm/shm.py;
+    multi-host address books fall back to evloop with a warning)."""
     transport = str(params.get("comm_transport", "evloop")
                     or "evloop").lower()
     if transport in ("threads", "thread", "socketce"):
         return SocketCE(rank, nranks, port_base)
-    if transport not in ("evloop", "eventloop", "select"):
+    if transport in ("shm", "sharedmem", "ring"):
+        hosts = str(params.get("comm_hosts", "") or
+                    os.environ.get("PARSEC_COMM_HOSTS", "")).strip()
+        if hosts:
+            warning("comm_transport=shm is same-host only but "
+                    "comm_hosts is set: using evloop")
+        else:
+            from parsec_tpu.comm.shm import ShmCE
+            return ShmCE(rank, nranks, port_base)
+    elif transport not in ("evloop", "eventloop", "select"):
         warning("unknown comm_transport %r: using evloop", transport)
     return EventLoopCE(rank, nranks, port_base)

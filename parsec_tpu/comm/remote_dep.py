@@ -331,6 +331,18 @@ class RemoteDepEngine:
                 target=self._progress_loop,
                 name=f"parsec-comm-{self.rank}", daemon=True)
             self._progress.start()
+            if self._clock_on:
+                # attach-time first round, like the funnelled path's
+                # ce.post above: a run shorter than the first timer
+                # tick must still feed clock tables + the frame-RTT
+                # histogram.  Safe off the progress thread: SocketCE's
+                # probe_clocks pings ESTABLISHED peers only (never
+                # parks in _connect); peers still dialing in are
+                # covered by the progress loop's fast first-round retry
+                try:
+                    ce.probe_clocks()
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------------
     # funnelled comm progress (reference: remote_dep_dequeue_main)
@@ -421,9 +433,12 @@ class RemoteDepEngine:
 
     def _progress_loop(self) -> None:
         next_purge = time.monotonic() + 5.0
-        # first clock round shortly after attach (peers are dialing in);
+        # first clock round right after attach, like the funnelled
+        # transport's attach-time post — safe now that SocketCE's
+        # probe_clocks pings ESTABLISHED peers only (a short run must
+        # still feed the frame-RTT histogram at least one round);
         # then every probe period for drift
-        next_clock = time.monotonic() + 0.2 if self._clock_on \
+        next_clock = time.monotonic() + 0.05 if self._clock_on \
             else float("inf")
         next_hb = time.monotonic() + self._hb_period if self._hb_on \
             else float("inf")
@@ -433,11 +448,16 @@ class RemoteDepEngine:
                 self._purge_stale_handles()
                 next_purge = time.monotonic() + 5.0
             if time.monotonic() > next_clock:
+                probed = 0
                 try:
-                    self.ce.probe_clocks()
+                    probed = self.ce.probe_clocks()
                 except OSError:
                     pass
-                next_clock = time.monotonic() + self._clock_period
+                # a round that reached nobody (peers still dialing in)
+                # retries fast: short runs must still get their first
+                # accepted sample into the frame-RTT histogram
+                next_clock = time.monotonic() + \
+                    (self._clock_period if probed else 0.1)
             if time.monotonic() > next_hb:
                 try:
                     self.ce.heartbeat_tick()
@@ -830,7 +850,14 @@ class RemoteDepEngine:
         out.update(self.ce.stats.as_dict())
         out["msgs_sent"] = self.ce.sent_msgs
         out["msgs_recv"] = self.ce.recv_msgs
-        out["transport"] = "evloop" if self.funnelled else "threads"
+        out["transport"] = getattr(self.ce, "TRANSPORT",
+                                   "evloop" if self.funnelled
+                                   else "threads")
+        extra = getattr(self.ce, "extra_stats", None)
+        if extra is not None:
+            # transport-specific counters (the shm ring exports
+            # ring_full stalls + doorbell traffic through here)
+            out.update(extra())
         return out
 
     # -- bcast topologies (reference: remote_dep.c:334-357, virtual

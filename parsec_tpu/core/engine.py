@@ -73,6 +73,35 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
     succ_locals = succ_tc.complete_locals(succ_locals)
     key = succ_tc.make_key(succ_locals)
 
+    nd = taskpool._native_deps
+    if nd is not None:
+        # native dep-countdown (parsec_tpu/native/schedext.c DepTable,
+        # gated by sched_native): the counter decrement, the input/
+        # source recording, and the ready-transition test ride one C
+        # crossing per arrival (two on the first, which installs the
+        # record).  The GIL is the bucket lock; create() keeps an
+        # existing record, so two workers racing the first arrivals
+        # cannot wipe each other's count.
+        res = nd.arrive(key, flow_name, copy, source)
+        if res is False:
+            nd.create(key, succ_tc.nb_task_inputs(succ_locals),
+                      dict(succ_locals))
+            res = nd.arrive(key, flow_name, copy, source)
+        if res is None:
+            return None
+        locals_, inputs, sources = res
+        task = Task(succ_tc, taskpool, locals_)
+        if taskpool.dynamic:
+            # see the non-native branch below for the ordering contract
+            taskpool.termdet.taskpool_addto_nb_tasks(taskpool, 1)
+        if inputs is not None:
+            task.data.update(inputs)
+            task.pinned_flows.update(k for k, v in inputs.items()
+                                     if v is not None)
+        if sources is not None:
+            task.input_sources.update(sources)
+        return task
+
     def fn(rec):
         if rec is None:
             rec = _rec_pool.alloc()
@@ -121,7 +150,14 @@ def prepare_input(es, task: Task) -> None:
     through the coherency protocol; NEW flows allocate from the arena.
     """
     tp = task.taskpool
-    for flow in task.task_class.flows:
+    tc = task.task_class
+    data = task.data
+    # flows with no input deps can only bind None (class-partitioned
+    # once, core/task.py); the resolution loop walks the rest
+    for name in tc._noin_flow_names:
+        if name not in data:
+            data[name] = None
+    for flow in tc._in_flows:
         if flow.name in task.data:
             continue
         dep = flow.active_input(task.locals)
@@ -321,7 +357,10 @@ def release_deps(es, task: Task) -> List[Task]:
     #: QR NEW-temporary leak on distributed runs)
     remote_only_arena: List[DataCopy] = []
 
-    for flow in tc.flows:
+    # only flows with output deps can deliver anything (class-level
+    # partition, core/task.py): a CTL-only or sink flow skips the whole
+    # delivery bookkeeping below
+    for flow in tc._out_flows:
         copy = task.data.get(flow.name)
         # gather this flow's local deliveries first: a copy fanning out to
         # several consumers must hand any WRITE-consumer a copy-on-write

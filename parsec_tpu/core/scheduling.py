@@ -21,11 +21,33 @@ from parsec_tpu.utils import faultinject as _fi
 from parsec_tpu.utils.output import debug_verbose, warning
 
 
+#: hoisted enum constants: a class-attribute load per task adds up at
+#: 100k+ tasks/s (the native hot-path PR's bytecode diet)
+_READY = TaskStatus.READY
+_PREPARED = TaskStatus.PREPARED
+_RUNNING = TaskStatus.RUNNING
+_COMPLETE = TaskStatus.COMPLETE
+_DONE = HookReturn.DONE
+_ASYNC = HookReturn.ASYNC
+_AGAIN = HookReturn.AGAIN
+_NEXT = HookReturn.NEXT
+_DISABLE = HookReturn.DISABLE
+
+
 def schedule(es, tasks: List[Task], distance: int = 0) -> None:
     """Enter ready tasks into the scheduler (reference: __parsec_schedule)."""
     if not tasks:
         return
-    if es.context._ready_stamp:
+    ctx = es.context
+    sched = ctx.scheduler
+    if sched.NATIVE_BATCH:
+        # native ready queue (sched/native.py): READY transition,
+        # ready_at stamping, and the priority-ordered insert all ride
+        # ONE C crossing for the whole ring
+        sched.schedule(es, tasks, distance)
+        ctx.ring_doorbell(len(tasks))
+        return
+    if ctx._ready_stamp:
         # one stamp for the batch: the tasks became ready at this same
         # moment; the causal tracer closes select - ready_at into a
         # queue-wait span and the metrics registry samples it into the
@@ -33,13 +55,13 @@ def schedule(es, tasks: List[Task], distance: int = 0) -> None:
         # telemetry-disabled hot path stays free
         now = time.perf_counter()
         for t in tasks:
-            t.status = TaskStatus.READY
+            t.status = _READY
             t.ready_at = now
     else:
         for t in tasks:
-            t.status = TaskStatus.READY
-    es.context.scheduler.schedule(es, tasks, distance)
-    es.context.ring_doorbell(len(tasks))
+            t.status = _READY
+    sched.schedule(es, tasks, distance)
+    ctx.ring_doorbell(len(tasks))
 
 
 def execute(es, task: Task) -> HookReturn:
@@ -47,7 +69,8 @@ def execute(es, task: Task) -> HookReturn:
     (reference: __parsec_execute chore loop, scheduling.c:138-198)."""
     tc = task.task_class
     host_staged = False
-    for idx, (dev_type, hook) in enumerate(list(tc.incarnations)):
+    # no list() copy: NEXT/DISABLE mutate masks, never the list itself
+    for idx, (dev_type, hook) in enumerate(tc.incarnations):
         if not (task.chore_mask & (1 << idx)):
             continue
         if tc.chore_disabled_mask & (1 << idx):
@@ -61,11 +84,11 @@ def execute(es, task: Task) -> HookReturn:
             # any other return value (arrays, bools, None...) means DONE
             ret = (HookReturn(ret)
                    if isinstance(ret, int) and not isinstance(ret, bool)
-                   else HookReturn.DONE)
-        if ret == HookReturn.NEXT:
+                   else _DONE)
+        if ret == _NEXT:
             task.chore_mask &= ~(1 << idx)
             continue
-        if ret == HookReturn.DISABLE:
+        if ret == _DISABLE:
             # disable class-wide without mutating the list (indices — and
             # other tasks' chore masks — stay stable)
             tc.chore_disabled_mask |= 1 << idx
@@ -86,7 +109,7 @@ def task_progress(es, task: Task, distance: int = 0) -> None:
         # ready task holds predecessor repo entries (input_sources,
         # filled at dep delivery) — release them or the warm context
         # leaks the cancelled frontier's arena tiles
-        task.status = TaskStatus.COMPLETE
+        task.status = _COMPLETE
         es.pins("task_discard", task)
         try:
             engine.consume_inputs(task)
@@ -94,18 +117,21 @@ def task_progress(es, task: Task, distance: int = 0) -> None:
             debug_verbose(2, "discard %s: consume_inputs: %s", task, exc)
         tp.termdet.taskpool_addto_nb_tasks(tp, -1)
         return
-    es.pins("exec_begin", task)
+    cbs = es._pins_map.get("exec_begin")   # inlined es.pins (hot path)
+    if cbs:
+        for cb in cbs:
+            cb(es, "exec_begin", task)
     try:
-        if task.status < TaskStatus.PREPARED:
+        if task.status < _PREPARED:
             engine.prepare_input(es, task)
-            task.status = TaskStatus.PREPARED
+            task.status = _PREPARED
         if es.context._retry_max > 0 and task.retries == 0:
             _snapshot_write_flows(task)
         if _fi.ARMED and _fi.task_fault(task):
             # fault plan fail_task directive: a transient, retryable
             # body failure (utils/faultinject.py)
             raise FaultInjected(f"{task}: injected transient fault")
-        task.status = TaskStatus.RUNNING
+        task.status = _RUNNING
         ret = execute(es, task)
     except Exception as exc:  # body/binding error: retry or fail the pool
         if _maybe_retry(es, task, exc, distance):
@@ -117,14 +143,17 @@ def task_progress(es, task: Task, distance: int = 0) -> None:
         es.context.record_error(exc, task)
         complete_execution(es, task, failed=True)
         return
-    if ret == HookReturn.DONE:
-        es.pins("exec_end", task)
+    if ret == _DONE:
+        cbs = es._pins_map.get("exec_end")   # inlined es.pins
+        if cbs:
+            for cb in cbs:
+                cb(es, "exec_end", task)
         complete_execution(es, task)
-    elif ret == HookReturn.ASYNC:
+    elif ret == _ASYNC:
         # a device module owns the task now; it will call complete_execution
         es.pins("exec_async", task)
-    elif ret == HookReturn.AGAIN:
-        task.status = TaskStatus.READY
+    elif ret == _AGAIN:
+        task.status = _READY
         schedule(es, [task], distance + 1)
     else:
         es.context.record_error(
@@ -181,11 +210,10 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
     tc = task.task_class
     if not failed:
         try:
-            for flow in tc.flows:
-                if flow.access & ACCESS_WRITE:
-                    copy = task.data.get(flow.name)
-                    if copy is not None and copy.data is not None:
-                        copy.data.complete_write(copy.device)
+            for flow in tc._write_flows:
+                copy = task.data.get(flow.name)
+                if copy is not None and copy.data is not None:
+                    copy.data.complete_write(copy.device)
             ready = engine.release_deps(es, task)
             if ready:
                 schedule(es, ready)
@@ -193,23 +221,32 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
             # a dep-expression or write-back error must fail the context,
             # not silently kill the worker thread
             es.context.record_error(exc, task)
-    try:
-        engine.consume_inputs(task)
-    except Exception as exc:
-        es.context.record_error(exc, task)
-    task.status = TaskStatus.COMPLETE
-    es.pins("complete_exec", task)
+    if task.input_sources:
+        try:
+            engine.consume_inputs(task)
+        except Exception as exc:
+            es.context.record_error(exc, task)
+    task.status = _COMPLETE
+    cbs = es._pins_map.get("complete_exec")   # inlined es.pins
+    if cbs:
+        for cb in cbs:
+            cb(es, "complete_exec", task)
     es.nb_tasks_done += 1
-    task.taskpool.termdet.taskpool_addto_nb_tasks(task.taskpool, -1)
+    tp = task.taskpool
+    tp.termdet.taskpool_addto_nb_tasks(tp, -1)
 
 
 def worker_loop(es) -> None:
     """Steady-state worker (reference: __parsec_context_wait hot loop)."""
     ctx = es.context
     sched = ctx.scheduler
+    # native hot path: pop straight off the C ready queue, skipping the
+    # select() frame (one Python call per task at 100k+ tasks/s)
+    pop = sched._q.pop if sched.NATIVE_BATCH else None
+    pins_map = es._pins_map
     misses = 0
     while not ctx.finished:
-        task = sched.select(es)
+        task = pop() if pop is not None else sched.select(es)
         if task is None:
             misses += 1
             # idle moment: drain any deferred wavefront placements whose
@@ -219,6 +256,9 @@ def worker_loop(es) -> None:
             ctx.doorbell_wait(min(0.0002 * (1 << min(misses, 8)), 0.05))
             continue
         misses = 0
-        es.pins("select", task)
+        cbs = pins_map.get("select")   # inlined es.pins
+        if cbs:
+            for cb in cbs:
+                cb(es, "select", task)
         task_progress(es, task)
     debug_verbose(9, "worker %d: %d tasks", es.th_id, es.nb_tasks_done)

@@ -242,6 +242,22 @@ class TaskClass:
         for i, f in enumerate(self.flows):
             f.flow_index = i
         self._flow_by_name = {f.name: f for f in self.flows}
+        # hot-path partitions, computed once per CLASS instead of
+        # filtered per task instance (flows are fixed at construction;
+        # the per-task loops in prepare_input / release_deps /
+        # complete_execution walk only the flows that can matter)
+        self._in_flows = [f for f in self.flows if f.inputs]
+        self._noin_flow_names = [f.name for f in self.flows
+                                 if not f.inputs]
+        self._out_flows = [f for f in self.flows if f.outputs]
+        self._write_flows = [f for f in self.flows
+                             if f.access & ACCESS_WRITE]
+        #: task-fed input deps only (the dep-countdown universe); an
+        #: empty list makes nb_task_inputs O(1) — the dominant case for
+        #: independent-task pools is "no task-fed inputs at all"
+        self._ft_inputs = [d for f in self.flows for d in f.inputs
+                           if isinstance(d.end, FromTask)]
+        self._param_names = tuple(p for p, _ in self.params)
         self.incarnations = list(incarnations)
         if body is not None:
             self.incarnations.append(("cpu", body))
@@ -259,7 +275,9 @@ class TaskClass:
     def make_key(self, locals_: Dict[str, int]) -> Tuple:
         if self.key_fn is not None:
             return (self.name, self.key_fn(locals_))
-        return (self.name,) + tuple(locals_[p] for p, _ in self.params)
+        # map + the C-level __getitem__ beats a genexpr at 100k keys/s
+        return (self.name,) + tuple(map(locals_.__getitem__,
+                                        self._param_names))
 
     def key_to_locals(self, key: Tuple) -> Dict[str, int]:
         return {p: key[1 + i] for i, (p, _) in enumerate(self.params)}
@@ -290,6 +308,14 @@ class TaskClass:
     def iter_space(self, globals_: Dict[str, Any]) -> Iterable[Dict[str, int]]:
         """Enumerate the full parameter space (generated startup loops in the
         reference, jdf2c.c:2989)."""
+        if len(self.params) == 1:
+            # flat spaces (the independent-task shape) skip the
+            # recursive generator: one dict literal per instance
+            name, range_fn = self.params[0]
+            for v in range_fn(globals_, {}):
+                yield {name: v}
+            return
+
         def rec(i: int, locals_: Dict[str, int]):
             if i == len(self.params):
                 yield dict(locals_)
@@ -307,11 +333,13 @@ class TaskClass:
         edge).  Data flows have mutually-exclusive guards (one source), but
         CTL flows may gather through several simultaneously-applying deps,
         and each counts."""
+        deps = self._ft_inputs
+        if not deps:
+            return 0    # startup-enumeration fast path
         n = 0
-        for f in self.flows:
-            for dep in f.inputs:
-                if dep.applies(locals_) and isinstance(dep.end, FromTask):
-                    n += dep.multiplicity(locals_)
+        for dep in deps:
+            if dep.applies(locals_):
+                n += dep.multiplicity(locals_)
         return n
 
     def rank_of(self, locals_: Dict[str, int]) -> int:

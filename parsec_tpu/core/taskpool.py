@@ -28,6 +28,28 @@ from parsec_tpu.core.task import Task, TaskClass
 
 _tp_ids = itertools.count(1)
 
+_ndep_cls = None
+_ndep_tried = False
+
+
+def _native_dep_table():
+    """A native dep-countdown table (schedext.DepTable) when the
+    scheduler hot path is on and the extension builds, else None — the
+    per-pool gate engine.deliver_dep dispatches on.  The class resolves
+    once per process; the ``sched_native`` knob stays a live read so an
+    A/B flip affects pools created after it."""
+    global _ndep_cls, _ndep_tried
+    from parsec_tpu.utils.mca import params
+    if not int(params.get("sched_native", 1)):
+        return None
+    if not _ndep_tried:
+        _ndep_tried = True
+        from parsec_tpu.native import load_schedext
+        se = load_schedext()
+        if se is not None:
+            _ndep_cls = se.DepTable
+    return _ndep_cls() if _ndep_cls is not None else None
+
 
 class TaskpoolState(IntEnum):
     CREATED = 0
@@ -59,8 +81,12 @@ class Taskpool:
         self.termdet_name: Optional[str] = None
         self.task_classes: Dict[str, TaskClass] = {}
         self.arenas: Dict[str, Arena] = {}
-        #: dep-countdown records for not-yet-ready tasks
+        #: dep-countdown records for not-yet-ready tasks; the native
+        #: twin (schedext.DepTable) replaces it wholesale when the
+        #: scheduler hot path is on — ONE of the two holds this pool's
+        #: records, selected once at construction (engine.deliver_dep)
         self.deps_table = ConcurrentHashTable()
+        self._native_deps = _native_dep_table()
         #: collection datums whose host copy a writeback replaced; their
         #: user-visible backing re-links at termination (engine._writeback)
         self.dirty_data: set = set()
@@ -184,13 +210,20 @@ class ParameterizedTaskpool(Taskpool):
         myrank = self.context.rank if self.context else 0
         nb_local = 0
         ready: List[Task] = []
+        append = ready.append
         for tc in self.task_classes.values():
+            aff = tc.affinity
+            if aff is None and myrank != 0:
+                continue   # rank_of is the constant 0: nothing local
+            # classes with no task-fed inputs skip the per-instance
+            # countdown probe entirely (class-level partition, task.py)
+            all_ready = not tc._ft_inputs
             for locals_ in tc.iter_space(self.globals):
-                if tc.rank_of(locals_) != myrank:
+                if aff is not None and aff(locals_).rank != myrank:
                     continue
                 nb_local += 1
-                if tc.nb_task_inputs(locals_) == 0:
-                    ready.append(Task(tc, self, locals_))
+                if all_ready or tc.nb_task_inputs(locals_) == 0:
+                    append(Task(tc, self, locals_))
         if nb_local:
             self.termdet.taskpool_addto_nb_tasks(self, nb_local)
         return ready

@@ -260,6 +260,18 @@ class TaskBuilder:
         names = [p.name for p in inspect.signature(fn).parameters.values()]
         writable = [f.name for f in self._flows if f.access & ACCESS_WRITE]
 
+        if not names and not writable:
+            # zero-arg, zero-write body (CTL-only probes, barriers):
+            # skip the kwargs binding loop — the empty-task hot path
+            def hook(es, task):
+                ret = fn()
+                return ret if ret is None or isinstance(ret, HookReturn) \
+                    else None
+            hook.__ptg_fn__ = fn
+            hook.__ptg_writable__ = writable
+            self._incarnations.append((device, hook))
+            return self
+
         def hook(es, task):
             kwargs = {}
             for n in names:

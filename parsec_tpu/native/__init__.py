@@ -36,30 +36,59 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
+#: build-degradation warning, rate-limited to ONE per process across
+#: every extension (a missing compiler on a 4-extension import chain
+#: must not spam four warnings per rank — and never one per import:
+#: the per-extension _tried caches make later loads silent anyway)
+_toolchain_warned = False
 
-def _build() -> bool:
+
+def _warn_build(what: str, detail: str) -> None:
+    global _toolchain_warned
+    if not _toolchain_warned:
+        _toolchain_warned = True
+        warning("%s unavailable (falling back to the pure-Python "
+                "path; further native build failures this process "
+                "are logged at debug level): %s", what, detail)
+    else:
+        debug_verbose(3, "%s unavailable: %s", what, detail)
+
+
+def _stale(so: str, src: str) -> bool:
+    """Rebuild when the artifact is missing or older than its source
+    (an edited .c next to a stale .so must never load the old code)."""
+    return not os.path.exists(so) or \
+        os.path.getmtime(so) < os.path.getmtime(src)
+
+
+def _compile(cmd: list, so: str, what: str) -> bool:
     """Compile to a temp name and rename into place: spawned rank
     processes may build concurrently on a fresh checkout, and a reader
     must never dlopen a half-written .so (rename is atomic)."""
-    tmp = f"{_SO}.tmp.{os.getpid()}"
+    tmp = f"{so}.tmp.{os.getpid()}"
     try:
-        r = subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
-             "-shared", "-o", tmp, os.path.join(_HERE, "core.cpp")],
-            capture_output=True, text=True, timeout=120)
+        r = subprocess.run(cmd + ["-o", tmp],
+                           capture_output=True, text=True, timeout=120)
         if r.returncode != 0:
-            warning("native core build failed:\n%s", r.stderr[-2000:])
+            _warn_build(what, "build failed:\n" + r.stderr[-2000:])
             return False
-        os.replace(tmp, _SO)
+        os.replace(tmp, so)
         return True
     except (OSError, subprocess.TimeoutExpired) as exc:
-        warning("native core build unavailable: %s", exc)
+        _warn_build(what, f"build tool error: {exc}")
         return False
     finally:
         try:
             os.unlink(tmp)
         except OSError:
             pass
+
+
+def _build() -> bool:
+    return _compile(
+        ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+         "-shared", os.path.join(_HERE, "core.cpp")],
+        _SO, "native core")
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -72,15 +101,13 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         if not int(params.get("native_core", 1)):
             return None
-        if not os.path.exists(_SO) or \
-                os.path.getmtime(_SO) < os.path.getmtime(
-                    os.path.join(_HERE, "core.cpp")):
+        if _stale(_SO, os.path.join(_HERE, "core.cpp")):
             if not _build():
                 return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError as exc:
-            warning("native core load failed: %s", exc)
+            _warn_build("native core", f"load failed: {exc}")
             return None
         _sign(lib)
         _lib = lib
@@ -92,9 +119,41 @@ def available() -> bool:
     return load() is not None
 
 
-_pinsext = None
-_pinsext_tried = False
-_PINS_SO = os.path.join(_HERE, "pinsext.so")
+#: CPython extension modules (pinsext, schedext, commext) share one
+#: build + import path; name -> loaded module or None (build/load
+#: failed: the Python fallback serves this process)
+_cexts: dict = {}
+
+
+def _load_cext(name: str):
+    """Build (once per process) and import the CPython extension
+    ``<name>.c`` -> ``<name>.so``; None when disabled or the
+    toolchain/headers are missing — callers keep a Python fallback."""
+    with _lock:
+        if name in _cexts:
+            return _cexts[name]
+        _cexts[name] = None
+        if not int(params.get("native_core", 1)):
+            return None
+        src = os.path.join(_HERE, f"{name}.c")
+        so = os.path.join(_HERE, f"{name}.so")
+        if _stale(so, src):
+            import sysconfig
+            inc = sysconfig.get_paths()["include"]
+            if not _compile(["g++", "-O2", "-fPIC", "-shared",
+                             f"-I{inc}", src], so, name):
+                return None
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(name, so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as exc:   # pragma: no cover - load portability
+            _warn_build(name, f"load failed: {exc}")
+            return None
+        _cexts[name] = mod
+        debug_verbose(5, "%s loaded: %s", name, so)
+        return mod
 
 
 def load_pinsext():
@@ -103,44 +162,10 @@ def load_pinsext():
     budget — so the per-event path is a real extension module; returns
     None when disabled or the toolchain/headers are missing."""
     global _pinsext, _pinsext_tried
-    with _lock:
-        if _pinsext_tried:
-            return _pinsext
-        _pinsext_tried = True
-        if not int(params.get("native_core", 1)):
-            return None
-        src = os.path.join(_HERE, "pinsext.c")
-        if not os.path.exists(_PINS_SO) or \
-                os.path.getmtime(_PINS_SO) < os.path.getmtime(src):
-            import sysconfig
-            inc = sysconfig.get_paths()["include"]
-            tmp = f"{_PINS_SO}.tmp.{os.getpid()}"
-            try:
-                r = subprocess.run(
-                    ["g++", "-O2", "-fPIC", "-shared", f"-I{inc}",
-                     "-o", tmp, src],
-                    capture_output=True, text=True, timeout=120)
-                if r.returncode != 0:
-                    warning("pinsext build failed:\n%s", r.stderr[-2000:])
-                    return None
-                os.replace(tmp, _PINS_SO)
-            except (OSError, subprocess.TimeoutExpired) as exc:
-                warning("pinsext build unavailable: %s", exc)
-                return None
-            finally:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-        try:
-            import importlib.util
-            spec = importlib.util.spec_from_file_location(
-                "pinsext", _PINS_SO)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-        except Exception as exc:   # pragma: no cover - load portability
-            warning("pinsext load failed: %s", exc)
-            return None
+    if _pinsext_tried:
+        return _pinsext
+    mod = _load_cext("pinsext")
+    if mod is not None:
         # the sink stamps with CLOCK_MONOTONIC; only usable if that is
         # the same timeline Python's perf_counter reads (true on Linux).
         # Bracket the C read between two Python reads and retry a few
@@ -158,10 +183,28 @@ def load_pinsext():
         if not same_clock:
             debug_verbose(3, "pinsext clock differs from perf_counter; "
                           "falling back to the Python event path")
-            return None
-        _pinsext = mod
-        debug_verbose(5, "pinsext loaded: %s", _PINS_SO)
-        return _pinsext
+            mod = None
+    _pinsext = mod
+    _pinsext_tried = True
+    return _pinsext
+
+
+def load_schedext():
+    """The native scheduler hot path (schedext.c: ReadyQueue +
+    DepTable); gated by ``sched_native`` at its consumers
+    (sched/native.py, core/engine.py), by ``native_core`` here."""
+    return _load_cext("schedext")
+
+
+def load_commext():
+    """The native comm framing (commext.c: FrameParser + frame_parts);
+    gated by ``comm_frame_native`` at its consumers (comm/frames.py)."""
+    return _load_cext("commext")
+
+
+_pinsext = None
+_pinsext_tried = False
+_PINS_SO = os.path.join(_HERE, "pinsext.so")
 
 
 def _sign(lib: ctypes.CDLL) -> None:

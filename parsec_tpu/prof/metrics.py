@@ -509,6 +509,7 @@ class RuntimeMetrics:
             out.append(counter_sample("parsec_jobs_done_total", c.value,
                                       labels))
         out.extend(self._collect_comm())
+        out.extend(self._collect_sched())
         out.extend(self._collect_devices())
         out.extend(self._collect_service())
         for fn in list(self._collectors):
@@ -532,7 +533,14 @@ class RuntimeMetrics:
                     "bytes_recv", "syscalls_send", "syscalls_recv",
                     "act_eager", "act_rdv", "act_inline",
                     "eager_bytes", "rdv_bytes", "coalesced_msgs",
-                    "eager_downshift", "eager_upshift"):
+                    "eager_downshift", "eager_upshift",
+                    # r11 native/shm data-plane counters (all
+                    # maintained on their existing hot paths; this
+                    # read is scrape-time only): frames through the C
+                    # parser, shm ring backpressure stalls, doorbell
+                    # traffic in each direction
+                    "frames_parsed_native", "shm_ring_full_stalls",
+                    "shm_doorbells_sent", "shm_doorbells_recv"):
             v = st.get(key)
             if isinstance(v, (int, float)):
                 out.append(counter_sample(f"parsec_comm_{key}_total", v))
@@ -557,6 +565,35 @@ class RuntimeMetrics:
                                         {"peer": str(r)}))
         except Exception:
             pass
+        return out
+
+    def _collect_sched(self) -> List[dict]:
+        """Native-scheduler family, read at scrape time from the C
+        queue's own counters (sched/native.py stats()) — zero work on
+        the schedule/select hot path."""
+        ctx = self.context
+        sched = getattr(ctx, "scheduler", None) if ctx is not None \
+            else None
+        out: List[dict] = []
+        try:
+            from parsec_tpu.sched.native import fallbacks
+            out.append(counter_sample(
+                "parsec_sched_native_fallbacks_total", fallbacks()))
+        except Exception:
+            pass
+        st_fn = getattr(sched, "stats", None)
+        if st_fn is None:
+            return out
+        try:
+            st = st_fn()
+        except Exception:
+            return out
+        out.append(counter_sample("parsec_sched_native_pushes_total",
+                                  st.get("pushes", 0)))
+        out.append(counter_sample("parsec_sched_native_pops_total",
+                                  st.get("pops", 0)))
+        out.append(gauge_sample("parsec_sched_native_pending",
+                                st.get("pending", 0)))
         return out
 
     def _collect_devices(self) -> List[dict]:

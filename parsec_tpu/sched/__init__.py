@@ -22,6 +22,13 @@ from parsec_tpu.core.task import Task
 class Scheduler:
     name = "base"
 
+    #: native-batch contract: when True, core/scheduling.schedule hands
+    #: the raw ready ring to ``schedule()`` without the Python
+    #: status/ready_at loop — the scheduler performs the READY
+    #: transition (and stamping) itself, in one native crossing
+    #: (sched/native.py)
+    NATIVE_BATCH = False
+
     def install(self, context) -> None:
         self.context = context
 
@@ -46,7 +53,20 @@ def register(name: str, cls, priority: int = 0) -> None:
 
 
 def create(name: Optional[str] = None) -> Scheduler:
-    selected, cls = components.select("sched", name)
+    from parsec_tpu.utils.mca import params
+    requested = name or (params.get("sched", "") or None)
+    if requested is None and int(params.get("sched_native", 1)):
+        # no explicit component named and the native hot path is on:
+        # prefer the C ready queue, falling back to the Python ladder
+        # when the extension does not build (counted for the metrics
+        # plane — a silent no-op native path is itself a regression)
+        from parsec_tpu.native import load_schedext
+        if load_schedext() is not None:
+            requested = "native"
+        else:
+            from parsec_tpu.sched import native as _native_mod
+            _native_mod.note_fallback()
+    selected, cls = components.select("sched", requested)
     inst = cls()
     inst.name = selected
     return inst
@@ -55,3 +75,4 @@ def create(name: Optional[str] = None) -> Scheduler:
 # Import modules so they self-register.
 from parsec_tpu.sched import simple as _simple          # noqa: E402,F401
 from parsec_tpu.sched import local_queues as _lq        # noqa: E402,F401
+from parsec_tpu.sched import native as _native          # noqa: E402,F401

@@ -1,0 +1,88 @@
+"""Native ready-queue scheduler (MCA component ``native``).
+
+The scheduler inner loop in C (parsec_tpu/native/schedext.c): one
+METH_FASTCALL crossing per scheduling event carries the whole ready
+ring through READY-transition + ``Task.ready_at`` stamping +
+priority-ordered insert, and one crossing pops the next task — no
+Python-level lock (the GIL is the queue lock; the Python schedulers
+pay a ``threading.Lock`` round-trip per queue op ON TOP of the GIL,
+which is exactly the contention the 4-worker tasks probe measured).
+
+Selection: ``--mca sched native`` explicitly, or the default when
+``sched_native`` (env ``PARSEC_MCA_SCHED_NATIVE``, default 1) is on
+and the extension builds — sched/__init__.create.  The A/B knob:
+``PARSEC_MCA_SCHED_NATIVE=0`` restores the Python component ladder
+(lfq by default) for paired measurement; a missing toolchain degrades
+the same way, counted in ``fallbacks()`` for the metrics plane.
+
+Ordering contract: priority-ordered, FIFO among equal priorities (the
+``ap`` discipline); distance-rescheduled tasks go behind EVERYTHING
+(the sched/__init__.py fairness contract — an AGAIN task must not be
+re-selected ahead of the work it waits on).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from parsec_tpu.core.task import Task, TaskStatus
+from parsec_tpu.sched import Scheduler, register
+from parsec_tpu.utils.mca import params
+
+params.register("sched_native", 1,
+                "use the native (C) ready-queue scheduler when no "
+                "explicit sched component is requested and the "
+                "extension builds (0 = the Python component ladder; "
+                "the tasks-probe A/B knob)")
+
+#: times the native path was requested but the extension was not
+#: usable (scrape-time metrics: parsec_sched_native_fallbacks_total)
+_fallbacks = 0
+
+
+def fallbacks() -> int:
+    return _fallbacks
+
+
+def note_fallback() -> None:
+    global _fallbacks
+    _fallbacks += 1
+
+
+class NativeSched(Scheduler):
+    """One global native priority queue shared by every stream."""
+
+    #: core/scheduling.schedule hands the raw ready ring to
+    #: ``schedule()`` untouched — status/ready_at land C-side
+    NATIVE_BATCH = True
+
+    def install(self, context) -> None:
+        super().install(context)
+        from parsec_tpu.native import load_schedext
+        se = load_schedext()
+        if se is None:
+            raise RuntimeError("sched native: schedext did not build")
+        self._q = se.ReadyQueue(TaskStatus.READY)
+
+    def schedule(self, es, tasks: List[Task], distance: int = 0) -> None:
+        # one crossing: READY + ready_at (when a telemetry consumer
+        # wants it) + priority-heap insert for the whole ring;
+        # distance > 0 pins the ring behind everything (fairness)
+        self._q.push_batch(tasks, self.context._ready_stamp, distance > 0)
+
+    def select(self, es) -> Optional[Task]:
+        return self._q.pop()
+
+    def display_stats(self, es) -> str:
+        pushes, pops, max_len, pending = self._q.stats()
+        return (f"native: pushes={pushes} pops={pops} "
+                f"max_depth={max_len} pending={pending}")
+
+    def stats(self) -> dict:
+        """Scrape-time counters (prof/metrics.py sched family)."""
+        pushes, pops, max_len, pending = self._q.stats()
+        return {"pushes": pushes, "pops": pops, "max_depth": max_len,
+                "pending": pending}
+
+
+register("native", NativeSched, priority=0)   # explicit/knob-gated only

@@ -1,0 +1,311 @@
+"""Shared-memory ring transport + native frame parser (r11).
+
+In-process tests drive ShmCE pairs and the parser implementations
+directly; the distributed case spawns a 2-rank pingpong over the shm
+transport through the launch contract."""
+
+import os
+import random
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.frames import PyFrameParser, make_parser
+from parsec_tpu.comm.launch import _probe_port_base
+from parsec_tpu.comm.shm import ShmCE, _ring_path
+from parsec_tpu.utils.mca import params
+
+_LEN = struct.Struct("!IQI")
+_BUFLEN = struct.Struct("!Q")
+
+
+def _stream(frames):
+    """Serialize (tag, body, [oob...]) frames into one wire stream."""
+    out = bytearray()
+    for tag, body, oob in frames:
+        out += _LEN.pack(tag, len(body), len(oob))
+        out += body
+        for b in oob:
+            out += _BUFLEN.pack(len(b)) + b
+    return bytes(out)
+
+
+def _parsers():
+    ps = [PyFrameParser(1 << 24)]
+    nat, is_nat = make_parser(1 << 24)
+    if nat is not None and is_nat:
+        ps.append(nat)
+    return ps
+
+
+def test_parser_parity_random_chunking():
+    """Python and native parsers produce identical frames from the
+    same stream under adversarial chunk boundaries."""
+    rng = random.Random(11)
+    frames = [
+        (1, b"x" * 5, []),
+        (2, b"", []),                      # header-only
+        (3, b"y" * 100, [b"z" * 70000, b""]),   # oob incl. empty
+        (7, b"q" * 3, [b"w" * 9]),
+    ]
+    stream = _stream(frames)
+    for fp in _parsers():
+        got = []
+        off = 0
+        while off < len(stream):
+            n = rng.randrange(1, 37)
+            got.extend(fp.feed(stream[off:off + n]))
+            off += n
+        assert fp.idle()
+        assert len(got) == len(frames)
+        for (tag, body, oob), (gtag, gbody, goob) in zip(frames, got):
+            assert gtag == tag
+            assert bytes(gbody or b"") == body
+            assert [bytes(b) for b in goob] == oob
+
+
+def test_parser_bulk_target_zero_copy_path():
+    big = os.urandom(200_000)
+    stream = _stream([(5, b"hdr", [big])])
+    for fp in _parsers():
+        fp.feed(stream[:64])
+        tgt = fp.bulk_target()
+        assert tgt is not None
+        n = min(len(tgt), len(stream) - 64)
+        tgt[:n] = stream[64:64 + n]
+        frames = fp.bulk_commit(n)
+        if not frames:
+            frames = fp.feed(stream[64 + n:])
+        (tag, body, oob), = frames
+        assert tag == 5 and bytes(oob[0]) == big
+
+
+def test_parser_bound_violation_raises():
+    bad = _LEN.pack(1, 1 << 40, 0)
+    for fp in [PyFrameParser(1 << 20),
+               make_parser(1 << 20, require=True)[0]]:
+        with pytest.raises(ValueError):
+            fp.feed(bad)
+
+
+def test_parser_knob_selects_python_fallback():
+    params.set("comm_frame_native", 0)
+    try:
+        fp, native = make_parser(1 << 20, require=True)
+        assert isinstance(fp, PyFrameParser) and not native
+        fp2, native2 = make_parser(1 << 20)
+        assert fp2 is None and not native2
+    finally:
+        params.unset("comm_frame_native")
+
+
+def _pair(base=None):
+    base = base or _probe_port_base(2)
+    return ShmCE(0, 2, base), ShmCE(1, 2, base)
+
+
+def _drain(ces):
+    for ce in ces:
+        ce._stop = True
+        ce.fini()
+
+
+def test_shm_am_roundtrip_and_counters():
+    ce0, ce1 = _pair()
+    got = []
+    try:
+        ce1.tag_register(20, lambda src, p: got.append((src, p)))
+        ce0.send_am(20, 1, {"k": 1})
+        t0 = time.time()
+        while not got and time.time() - t0 < 5:
+            time.sleep(0.01)
+        assert got == [(0, {"k": 1})]
+        assert ce0.stats.frames_sent == 1
+        assert ce1.stats.frames_recv == 1
+        assert ce1.stats.syscalls_recv == 0    # the point of shm
+        assert ce1.stats.frames_parsed_native == \
+            (1 if ce1._peers[0].fp_native else 0)
+    finally:
+        _drain((ce0, ce1))
+
+
+def test_shm_payload_larger_than_ring_streams_through():
+    """A frame bigger than the ring streams through it in chunks (the
+    producer publishes per chunk, the consumer frees space per parse),
+    with backpressure stalls counted."""
+    params.set("comm_shm_ring_mb", 1)    # ring << payload
+    try:
+        ce0, ce1 = _pair()
+        out = []
+        ce1.tag_register(21, lambda src, p: out.append(ShmCE.unpack(p)))
+        arr = np.arange(1_500_000, dtype=np.float32)   # ~6MB
+        ce0.send_am(21, 1, ShmCE.pack(arr))
+        t0 = time.time()
+        while not out and time.time() - t0 < 20:
+            time.sleep(0.01)
+        assert out and np.array_equal(out[0], arr)
+        assert ce0.ring_full_stalls > 0
+    finally:
+        _drain((ce0, ce1))
+        params.unset("comm_shm_ring_mb")
+
+
+def test_shm_onesided_put_get():
+    ce0, ce1 = _pair()
+    try:
+        target = np.zeros(128, np.float32)
+        rid = ce1.mem_register(target)
+        src = np.arange(128, dtype=np.float32)
+        done = []
+        ce0.put(1, src, rid, on_complete=done.append)
+        t0 = time.time()
+        while not done and time.time() - t0 < 5:
+            time.sleep(0.01)
+        assert done == [None]
+        np.testing.assert_array_equal(target, src)
+        got = []
+        ce0.get(1, rid, got.append)
+        t0 = time.time()
+        while not got and time.time() - t0 < 5:
+            time.sleep(0.01)
+        np.testing.assert_array_equal(got[0], src)
+    finally:
+        _drain((ce0, ce1))
+
+
+def test_shm_barrier_and_clock_probe():
+    import threading
+    ce0, ce1 = _pair()
+    try:
+        errs = []
+
+        def bar(ce):
+            try:
+                ce.barrier(timeout=15)
+            except Exception as exc:   # surfaced below
+                errs.append(exc)
+        t0 = threading.Thread(target=bar, args=(ce0,))
+        t1 = threading.Thread(target=bar, args=(ce1,))
+        t0.start(); t1.start(); t0.join(20); t1.join(20)
+        assert not errs
+        ce0.probe_clocks()
+        t = time.time()
+        while 1 not in ce0.clock and time.time() - t < 5:
+            time.sleep(0.02)
+        assert 1 in ce0.clock and ce0.clock[1]["rtt"] >= 0
+    finally:
+        _drain((ce0, ce1))
+
+
+def test_shm_ring_files_cleaned_up():
+    base = _probe_port_base(2)
+    ce0, ce1 = _pair(base)
+    paths = [_ring_path(base, 0, 1), _ring_path(base, 1, 0)]
+    assert all(os.path.exists(p) for p in paths)
+    _drain((ce0, ce1))
+    assert not any(os.path.exists(p) for p in paths)
+
+
+def test_make_ce_selects_shm_and_host_fallback():
+    from parsec_tpu.comm.engine import EventLoopCE, make_ce
+    params.set("comm_transport", "shm")
+    try:
+        ce = make_ce(0, 1, _probe_port_base(1))
+        try:
+            assert isinstance(ce, ShmCE) and ce.TRANSPORT == "shm"
+        finally:
+            ce._stop = True
+            ce.fini()
+        # multi-host address book: shm is same-host only -> evloop
+        params.set("comm_hosts", "127.0.0.1")
+        ce = make_ce(0, 1, _probe_port_base(1))
+        try:
+            assert isinstance(ce, EventLoopCE)
+        finally:
+            ce._stop = True
+            ce.fini()
+    finally:
+        params.unset("comm_transport")
+        params.unset("comm_hosts")
+
+
+def _shm_pp(ctx, rank, nranks):
+    from parsec_tpu.apps.pingpong import run_pingpong
+    res = run_pingpong(ctx, 1 << 18, 8)
+    return res[0], ctx.comm.stats()
+
+
+def test_shm_distributed_pingpong():
+    """2 spawned ranks over the shm transport: the dataflow path works
+    end to end and the stats record the shm data plane."""
+    from parsec_tpu.comm.launch import run_distributed
+    env = {"PARSEC_MCA_COMM_TRANSPORT": "shm"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        res = run_distributed(_shm_pp, 2, timeout=120)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    for _us, st in res:
+        assert st["transport"] == "shm"
+        assert st["frames_sent"] > 0
+        assert st["syscalls_recv"] == 0
+        assert "shm_doorbells_sent" in st
+
+
+def test_shm_nested_send_during_stall_loses_nothing():
+    """A handler dispatched by the stall path's drain-own-inbound
+    deadlock breaker may SEND to the very peer being written: the
+    nested frame must queue behind the in-progress write (the
+    _writing latch), not interleave into its byte stream — the
+    frame-loss/corruption class the r11 review reproduced."""
+    params.set("comm_shm_ring_mb", 0)     # clamps to the 64KB floor
+    try:
+        ce0, ce1 = _pair()
+        got1 = []
+        ce1.tag_register(30, lambda src, p: got1.append(("big", len(p["b"]))))
+        ce1.tag_register(31, lambda src, p: got1.append(("reply", p)))
+        # ce0's handler replies to ce1 — it will run DURING ce0's
+        # stalled big write (dispatched by the stall drain)
+        ce0.tag_register(32, lambda src, p: ce0.send_am(31, 1, {"r": p}))
+        # stall ce1's loop so ce0's 300KB frame overfills the 64KB ring
+        ce1.post(time.sleep, 0.4)
+        time.sleep(0.05)
+        ce1.send_am(32, 0, 7)             # the trigger, parked inbound
+        time.sleep(0.05)
+        ce0.send_am(30, 1, {"b": b"x" * 300_000})
+        t0 = time.time()
+        while len(got1) < 2 and time.time() - t0 < 10:
+            time.sleep(0.02)
+        assert ("big", 300_000) in got1, got1
+        assert ("reply", {"r": 7}) in got1, got1
+        assert ce0.ring_full_stalls > 0   # the stall actually happened
+        assert not ce0.dead_peers and not ce1.dead_peers
+    finally:
+        _drain((ce0, ce1))
+        params.unset("comm_shm_ring_mb")
+
+
+def test_shm_muted_loop_does_not_busy_spin():
+    """A muted engine (silent-hang injection) with undrained inbound
+    bytes must sleep in poll, not busy-spin on the dirty check."""
+    import resource
+    ce0, ce1 = _pair()
+    try:
+        ce1.send_am(13, 0, None)          # park bytes in ce0's inbound
+        time.sleep(0.2)
+        ce0.fault_kill("hang")            # mute: stops draining
+        ce1.send_am(13, 0, None)          # now-undrainable bytes
+        time.sleep(0.1)
+        cpu0 = resource.getrusage(resource.RUSAGE_SELF).ru_utime
+        time.sleep(1.0)
+        cpu = resource.getrusage(resource.RUSAGE_SELF).ru_utime - cpu0
+        assert cpu < 0.5, f"muted shm loop burned {cpu:.2f}s CPU/s"
+    finally:
+        _drain((ce0, ce1))

@@ -592,11 +592,20 @@ def test_flightrec_kill_rank_yields_merged_bundle(tmp_path):
         # the potrf workload rides the PTG activation path, so the ring
         # holds dep_deliver points (the DTD path's deliveries are lane
         # applies); frame delays stretch the run past the kill instant
+        # (kill at 1.2s with 250ms/frame delays on BOTH activation
+        # tags: the threads transport's progress loop aggregates
+        # same-destination activations into TAG_BATCH frames, which an
+        # ACT-only plan misses — it then outran the old 0.8s/150ms
+        # window and completed before the kill; 0.5s was conversely
+        # too early for evloop's first delayed wave to have recorded
+        # any flow.  This pairing holds the kill mid-run on all three
+        # transports.)
         _run_distributed_with_env(
             chaos.potrf_workload, 2,
             {"PARSEC_MCA_FAULT_PLAN":
-                 "seed=7;kill_rank=1@t+0.8s,mode=close;"
-                 "delay_frame=tag:ACT,p=1,ms=150",
+                 "seed=7;kill_rank=1@t+1.2s,mode=close;"
+                 "delay_frame=tag:ACT,p=1,ms=250;"
+                 "delay_frame=tag:BATCH,p=1,ms=250",
              "PARSEC_MCA_FLIGHTREC_ENABLED": "1",
              "PARSEC_MCA_FLIGHTREC_DIR": bundle,
              "PARSEC_CHAOS_WAIT_S": "30"})
@@ -734,3 +743,116 @@ def test_fused_chain_donation_soak():
         assert chained > chained0, "no fused chains ran — soak is void"
     finally:
         faultinject.disarm()
+
+
+# ---------------------------------------------------------------------------
+# shm transport (r11): the ring transport must produce the SAME
+# structured detectors and containment as TCP
+# ---------------------------------------------------------------------------
+
+def test_shm_hard_close_vs_silent_hang_detection():
+    """Over shm rings: a hard kill surfaces as a closed-ring EOF
+    immediately; a silent hang (rings open, nothing flowing) is caught
+    by the heartbeat timeout within 2x comm_peer_timeout_s — the same
+    detector latencies the TCP transports guarantee."""
+    from parsec_tpu.comm.launch import _probe_port_base
+    from parsec_tpu.comm.shm import ShmCE
+
+    params.set("comm_peer_timeout_s", 1.0)
+    try:
+        # --- silent hang ---------------------------------------------
+        base = _probe_port_base(2)
+        ce0, ce1 = ShmCE(0, 2, base), ShmCE(1, 2, base)
+        errors = []
+        ce0.on_error = errors.append
+        try:
+            for ce in (ce0, ce1):
+                ce.add_periodic(ce.heartbeat_tick, 0.25)
+                ce.add_periodic(ce.check_peer_timeouts, 0.25)
+            # attach both directions (heartbeats only beat attached
+            # rings; a real run attaches at the first activation)
+            ce0.send_am(13, 1, None)
+            ce1.send_am(13, 0, None)
+            time.sleep(0.8)          # a few heartbeat rounds flow
+            assert not ce0.dead_peers
+            t0 = time.monotonic()
+            ce1.fault_kill("hang")   # mute: rings stay OPEN
+            deadline = t0 + 4.0
+            while 1 not in ce0.dead_peers and time.monotonic() < deadline:
+                time.sleep(0.02)
+            dt = time.monotonic() - t0
+            assert 1 in ce0.dead_peers, "hung shm peer never declared"
+            assert dt <= 2.0 * 1.0 + 0.6, f"detection took {dt:.2f}s"
+            assert errors and isinstance(errors[0], PeerFailedError)
+            assert errors[0].rank == 1
+            assert errors[0].detector == "heartbeat"
+        finally:
+            ce0.fini()
+            ce1.fini()
+        # --- hard close ----------------------------------------------
+        base = _probe_port_base(2)
+        ce0, ce1 = ShmCE(0, 2, base), ShmCE(1, 2, base)
+        errors = []
+        ce0.on_error = errors.append
+        try:
+            ce0.send_am(13, 1, None)
+            ce1.send_am(13, 0, None)
+            time.sleep(0.3)
+            t0 = time.monotonic()
+            ce1.fault_kill("close")  # closed flag on every ring
+            deadline = t0 + 3.0
+            while 1 not in ce0.dead_peers and time.monotonic() < deadline:
+                time.sleep(0.02)
+            dt = time.monotonic() - t0
+            assert 1 in ce0.dead_peers, "closed shm peer never declared"
+            assert dt <= 1.0, f"closed-ring detection took {dt:.2f}s"
+            assert errors and isinstance(errors[0], PeerFailedError)
+        finally:
+            ce0.fini()
+            ce1.fini()
+    finally:
+        params.unset("comm_peer_timeout_s")
+
+
+def test_shm_frame_directives_hook_send_path():
+    """drop/delay fault-plan frame directives apply to shm sends: a
+    dropped frame never dispatches, a delayed one arrives late (the
+    directives hook ShmCE.send_am through the shared _fault_frame)."""
+    from parsec_tpu.comm.launch import _probe_port_base
+    from parsec_tpu.comm.shm import ShmCE
+
+    faultinject.arm("seed=5;drop_frame=tag:ACT,n=1;"
+                    "delay_frame=tag:DTD,n=1,ms=300")
+    try:
+        base = _probe_port_base(2)
+        ce0, ce1 = ShmCE(0, 2, base), ShmCE(1, 2, base)
+        got = []
+        dropped = []
+        ce0.on_frame_fault = lambda kind, tag, p: dropped.append(
+            (kind, tag))
+        ce1.tag_register(1, lambda src, p: got.append(("act", p)))
+        ce1.tag_register(6, lambda src, p: got.append(("dtd", p)))
+        try:
+            t0 = time.monotonic()
+            ce0.send_am(1, 1, {"n": 1})     # dropped (n=1 directive)
+            ce0.send_am(6, 1, {"n": 2})     # delayed 300ms
+            while len(got) < 1 and time.monotonic() - t0 < 5:
+                time.sleep(0.02)
+            dt = time.monotonic() - t0
+            assert got and got[0][0] == "dtd"
+            assert dt >= 0.25, f"delayed frame arrived after {dt:.3f}s"
+            assert ("drop", 1) in dropped    # Safra reconcile fired
+            time.sleep(0.2)
+            assert all(k != "act" for k, _ in got), "dropped frame arrived"
+        finally:
+            ce0.fini()
+            ce1.fini()
+    finally:
+        faultinject.disarm()
+
+
+def test_chaos_kill_shm():
+    """2-rank shm kills end-to-end (hard + silent) through the chaos
+    contract: structured PeerFailedError containment, no hang."""
+    proc = _chaos("kill-close-shm,kill-hang-shm", seeds=2)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

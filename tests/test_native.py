@@ -162,3 +162,78 @@ def test_native_trace_merges_with_info_events(tmp_path):
     _meta, df = read_trace(path)
     assert list(df["ts"]) == [1.0, 2.0, 3.0]
     assert df.iloc[1]["info"] == {"k": 2}
+
+
+# ---------------------------------------------------------------------------
+# build hardening (r11): stale-source rebuild + one rate-limited
+# degradation warning per process
+# ---------------------------------------------------------------------------
+
+_MINI_C = r"""
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+static PyObject *answer(PyObject *s, PyObject *a) {
+    (void)s; (void)a; return PyLong_FromLong(%d);
+}
+static PyMethodDef m[] = {{"answer", answer, METH_NOARGS, ""},
+                          {NULL, NULL, 0, NULL}};
+static struct PyModuleDef mod = {PyModuleDef_HEAD_INIT, "miniext",
+                                 NULL, -1, m, NULL, NULL, NULL, NULL};
+PyMODINIT_FUNC PyInit_miniext(void) { return PyModule_Create(&mod); }
+"""
+
+
+def test_stale_so_triggers_rebuild(tmp_path, monkeypatch):
+    """An edited .c next to an older .so must rebuild, not load the
+    stale artifact (the mtime check of native._stale/_load_cext)."""
+    import os
+    import time as _time
+    monkeypatch.setattr(native, "_HERE", str(tmp_path))
+    src = tmp_path / "miniext.c"
+    src.write_text(_MINI_C % 1)
+    mod = native._load_cext("miniext")
+    assert mod is not None and mod.answer() == 1
+    # new source, stale .so: the loader must rebuild the artifact
+    # (CPython caches extension modules by name+path in-process, so
+    # the contract is about the .so a FRESH process would load)
+    so = tmp_path / "miniext.so"
+    built_at = so.stat().st_mtime_ns
+    _time.sleep(0.02)
+    src.write_text(_MINI_C % 2)
+    os.utime(src)
+    native._cexts.pop("miniext")        # fresh-process semantics
+    assert native._stale(str(so), str(src))
+    assert native._load_cext("miniext") is not None
+    assert so.stat().st_mtime_ns > built_at, "stale .so not rebuilt"
+    assert not native._stale(str(so), str(src))
+
+
+def test_missing_compiler_degrades_with_one_warning(tmp_path,
+                                                    monkeypatch):
+    """A failing toolchain falls back to the Python path with ONE
+    rate-limited warning per process — not one per extension, and
+    never one per import (the per-name cache makes repeats silent)."""
+    import subprocess as sp
+
+    calls = []
+
+    def no_compiler(*a, **k):
+        calls.append(a)
+        raise FileNotFoundError("g++: not found")
+
+    warned = []
+    monkeypatch.setattr(native, "_HERE", str(tmp_path))
+    monkeypatch.setattr(native, "_toolchain_warned", False)
+    monkeypatch.setattr(native, "warning",
+                        lambda msg, *a: warned.append(msg % a))
+    monkeypatch.setattr(sp, "run", no_compiler)
+    (tmp_path / "extone.c").write_text(_MINI_C % 1)
+    (tmp_path / "exttwo.c").write_text(_MINI_C % 1)
+    assert native._load_cext("extone") is None
+    assert native._load_cext("exttwo") is None
+    assert len(calls) == 2              # both attempted a build
+    assert len(warned) == 1             # ...but ONE warning total
+    assert "falling back" in warned[0]
+    # cached result: later loads are silent no-ops (no new build)
+    assert native._load_cext("extone") is None
+    assert len(calls) == 2

@@ -1,0 +1,214 @@
+"""Native scheduler hot path (r11): schedext ReadyQueue/DepTable
+semantics, the sched_native A/B gate, and runtime equivalence of the
+native and Python paths."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.native import load_schedext
+from parsec_tpu.utils.mca import params
+
+se = load_schedext()
+
+pytestmark = pytest.mark.skipif(se is None,
+                                reason="schedext did not build")
+
+
+class _T:
+    __slots__ = ("priority", "status", "ready_at")
+
+    def __init__(self, prio=0):
+        self.priority = prio
+        self.status = 0
+        self.ready_at = None
+
+
+def _rq():
+    from parsec_tpu.core.task import TaskStatus
+    return se.ReadyQueue(TaskStatus.READY), TaskStatus.READY
+
+
+def test_ready_queue_priority_and_fifo_order():
+    q, READY = _rq()
+    ts = [_T(1), _T(5), _T(5), _T(0)]
+    q.push_batch(ts, 0)
+    assert len(q) == 4
+    # highest priority first, FIFO among equals, then the rest
+    assert q.pop() is ts[1]
+    assert q.pop() is ts[2]
+    assert q.pop() is ts[0]
+    assert q.pop() is ts[3]
+    assert q.pop() is None
+    for t in ts:
+        assert t.status is READY
+
+
+def test_ready_queue_stamp_gates_ready_at():
+    q, _ = _rq()
+    a, b = _T(), _T()
+    q.push_batch([a], 0)
+    assert a.ready_at is None          # telemetry off: no stamp
+    q.push_batch([b], 1)
+    assert isinstance(b.ready_at, float) and b.ready_at > 0
+
+
+def test_ready_queue_to_back_fairness():
+    """distance-rescheduled tasks go behind EVERYTHING, priority
+    notwithstanding (the sched/__init__.py fairness contract)."""
+    q, _ = _rq()
+    again = _T(100)
+    normal = _T(0)
+    q.push_batch([again], 0, 1)        # to_back
+    q.push_batch([normal], 0)
+    assert q.pop() is normal
+    assert q.pop() is again
+
+
+def test_ready_queue_stats():
+    q, _ = _rq()
+    q.push_batch([_T(), _T(), _T()], 0)
+    q.pop()
+    pushes, pops, max_len, pending = q.stats()
+    assert (pushes, pops, max_len, pending) == (3, 1, 3, 2)
+
+
+def test_dep_table_countdown_and_ready_payload():
+    dt = se.DepTable()
+    key = ("X", 1)
+    assert dt.arrive(key, "a", None, None) is False   # miss
+    dt.create(key, 2, {"i": 1})
+    assert dt.arrive(key, "a", "COPY", ("tc", "k")) is None
+    res = dt.arrive(key, "b", None, None)
+    locals_, inputs, sources = res
+    assert locals_ == {"i": 1}
+    # EVERY arrival records its binding, None included (a CTL edge
+    # must land flow->None in task.data)
+    assert inputs == {"a": "COPY", "b": None}
+    assert sources == {"a": ("tc", "k")}
+    assert len(dt) == 0
+
+
+def test_dep_table_create_keeps_existing_record():
+    """Two workers racing the first arrivals both observe the miss;
+    the second create must not wipe the first's recorded arrival."""
+    dt = se.DepTable()
+    key = ("Y", 0)
+    dt.create(key, 2, {"j": 0})
+    assert dt.arrive(key, "a", None, None) is None    # 1/2
+    dt.create(key, 2, {"j": 0})                       # racing create
+    assert dt.arrive(key, "b", None, None) is not None  # 2/2 ready
+
+
+def test_dep_table_two_copies_on_data_flow_raises():
+    dt = se.DepTable()
+    dt.create(("Z",), 3, {})
+    dt.arrive(("Z",), "d", "COPY1", None)
+    with pytest.raises(RuntimeError, match="two copies"):
+        dt.arrive(("Z",), "d", "COPY2", None)
+
+
+def test_dep_table_none_does_not_clobber_copy():
+    dt = se.DepTable()
+    dt.create(("W",), 2, {})
+    dt.arrive(("W",), "c", "REAL", None)
+    _, inputs, _ = dt.arrive(("W",), "c", None, None)
+    assert inputs == {"c": "REAL"}
+
+
+def test_scheduler_selection_knob():
+    """No explicit component + sched_native on -> the native queue;
+    off -> the Python ladder (lfq by priority).  Pinned via params
+    (override beats env) so the suite can run under
+    PARSEC_MCA_SCHED_NATIVE=0 — the fallback-matrix leg."""
+    from parsec_tpu.sched import create
+    params.set("sched_native", 1)
+    try:
+        assert create().name == "native"
+        assert create("lfq").name == "lfq"   # explicit always wins
+        params.set("sched_native", 0)
+        assert create().name == "lfq"
+    finally:
+        params.unset("sched_native")
+
+
+@pytest.mark.parametrize("native", [1, 0])
+def test_runtime_equivalence_potrf(native):
+    """A/B: the same tiled Cholesky is numerically identical on the
+    native and Python scheduler paths (deps countdown included)."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    n, mb = 64, 16
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    params.set("sched_native", native)
+    try:
+        A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n,
+                              name="A").from_array(spd.copy())
+        with Context(nb_cores=2) as ctx:
+            assert (ctx.scheduler.name == "native") == bool(native)
+            tp = potrf_taskpool(A, device="cpu")
+            assert (tp._native_deps is not None) == bool(native)
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=120)
+        L = np.tril(A.to_array())
+        np.testing.assert_allclose(
+            L, np.linalg.cholesky(spd.astype(np.float64)),
+            rtol=5e-3, atol=5e-3)
+    finally:
+        params.unset("sched_native")
+
+
+def test_again_task_does_not_livelock_native():
+    """An AGAIN-returning body rides the to_back path and the work it
+    waits on still runs (the fairness contract, end to end)."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.task import HookReturn
+    from parsec_tpu.dsl.ptg.api import PTG, Range
+
+    state = {"done": False, "again": 0}
+
+    def waiter():
+        if not state["done"]:
+            state["again"] += 1
+            if state["again"] > 10000:
+                raise RuntimeError("livelock: AGAIN starved the work")
+            return HookReturn.AGAIN
+        return None
+
+    def worker():
+        state["done"] = True
+
+    p = PTG("fair", N=1)
+    p.task("W", i=Range(0, 0)).flow("x", "CTL").body(waiter)
+    p.task("D", i=Range(0, 0)).flow("x", "CTL").body(worker)
+    params.set("sched_native", 1)
+    try:
+        with Context(nb_cores=1) as ctx:
+            assert ctx.scheduler.name == "native"
+            ctx.add_taskpool(p.build())
+            ctx.wait(timeout=60)
+    finally:
+        params.unset("sched_native")
+    assert state["done"]
+
+
+def test_native_sched_metrics_family():
+    """The sched scrape family reads the C queue's counters with zero
+    hot-path hooks (prof/metrics.py _collect_sched)."""
+    from bench import _empty_pool
+    from parsec_tpu.core.context import Context
+
+    params.set("sched_native", 1)
+    try:
+        with Context(nb_cores=1) as ctx:
+            ctx.add_taskpool(_empty_pool(32))
+            ctx.wait(timeout=60)
+            names = {s["n"]: s for s in ctx.metrics.samples()}
+            assert names["parsec_sched_native_pops_total"]["v"] >= 32
+            assert names["parsec_sched_native_pushes_total"]["v"] >= 32
+            assert "parsec_sched_native_fallbacks_total" in names
+    finally:
+        params.unset("sched_native")

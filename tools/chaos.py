@@ -159,16 +159,39 @@ CATALOG = [
     ("fail-task-exhaust",
      "seed={s};fail_task=key~POTRF(k=0),n=3",
      "potrf", "task-failed", {"PARSEC_MCA_TASK_RETRY_MAX": "1"}),
+    # shm-transport legs (r11): the ring transport must produce the
+    # SAME structured detectors and containment as TCP — hard kill
+    # (closed-ring EOF path), silent hang (heartbeat-timeout path),
+    # and recv-side reorder holds hooking the ring's dispatch
+    ("kill-close-shm",
+     "seed={s};kill_rank=1@t+1.2s,mode=close;"
+     "delay_frame=tag:DTD,p=1,ms=60",
+     "dtd", "peer-failed",
+     {"PARSEC_CHAOS_WAIT_S": "30",
+      "PARSEC_MCA_COMM_TRANSPORT": "shm"}),
+    ("kill-hang-shm",
+     "seed={s};kill_rank=1@t+1.2s,mode=hang;"
+     "delay_frame=tag:DTD,p=1,ms=60",
+     "dtd", "peer-failed",
+     {"PARSEC_CHAOS_WAIT_S": "25",
+      "PARSEC_MCA_COMM_PEER_TIMEOUT_S": "2",
+      "PARSEC_MCA_COMM_TRANSPORT": "shm"}),
+    ("delay-recv-shm",
+     "seed={s};delay_recv=tag:DTD,p=0.5,ms=150;"
+     "delay_recv=tag:ACT,p=0.3,ms=80",
+     "dtd", "complete", {"PARSEC_MCA_COMM_TRANSPORT": "shm"}),
 ]
 
-_QUICK = ("delay-v0", "delay-recv", "kill-close", "fail-task-retry")
+_QUICK = ("delay-v0", "delay-recv", "kill-close", "fail-task-retry",
+          "kill-close-shm", "delay-recv-shm")
 
 _CHAOS_ENV = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
               "PARSEC_MCA_COMM_PEER_TIMEOUT_S",
               "PARSEC_MCA_TASK_RETRY_MAX",
               "PARSEC_MCA_COMM_EAGER_LIMIT",
               "PARSEC_MCA_COMM_ADAPTIVE_EAGER",
-              "PARSEC_MCA_COMM_RDV_RETRY_S")
+              "PARSEC_MCA_COMM_RDV_RETRY_S",
+              "PARSEC_MCA_COMM_TRANSPORT")
 
 
 def run_case(name, plan, workload, expect, env, timeout):
@@ -221,6 +244,10 @@ def main(argv=None):
                     help="per-run harness deadline (hang detector)")
     ap.add_argument("--only", default="",
                     help="comma-separated catalog entry names")
+    ap.add_argument("--transport", default="",
+                    help="force every case onto one transport "
+                         "(threads/evloop/shm) — runs the whole "
+                         "catalog against it")
     ap.add_argument("--list", action="store_true")
     args = ap.parse_args(argv)
 
@@ -230,6 +257,10 @@ def main(argv=None):
     if args.only:
         keep = set(args.only.split(","))
         catalog = [c for c in CATALOG if c[0] in keep]
+    if args.transport:
+        catalog = [(n, p, wl, ex,
+                    {**env, "PARSEC_MCA_COMM_TRANSPORT": args.transport})
+                   for n, p, wl, ex, env in catalog]
     if args.list:
         for name, plan, wl, expect, env in catalog:
             print(f"{name:20s} [{wl}] expect={expect}  {plan}")

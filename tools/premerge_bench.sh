@@ -36,10 +36,22 @@
 # telemetry_overhead as lower-is-better should future artifacts
 # record it).
 #
-# Usage:  sh tools/premerge_bench.sh [threshold] [trace_bound] [telemetry_bound]
+# Usage:  sh tools/premerge_bench.sh [threshold] [trace_bound] \
+#             [telemetry_bound] [native_margin]
 #         threshold:   relative regression that fails (default 0.15)
 #         trace_bound: max tracing-on slowdown of tasks/s (default 0.50)
 #         telemetry_bound: max metrics+flightrec slowdown (default 0.05)
+#         native_margin: min native/fallback tasks ratio (default 1.05)
+# r11 adds the NATIVE-vs-PYTHON pairing: the tasks probe (which runs
+# with the native scheduler hot path by default) is re-run with
+# PARSEC_MCA_SCHED_NATIVE=0 — the fallback line goes through
+# bench_guard like every probe (a fallback regression fails), and the
+# native line must (a) actually have the native path active in its
+# JSON (sched_native=1 — a silently-degraded build is a no-op native
+# path) and (b) beat the fallback by >= $native_margin (default 5%).
+# The shm transport gets its own rtt probe through bench_guard (the
+# same-host ring must keep beating the loopback-TCP artifact).
+#
 # r9 prepends the PARSECLINT gate: the project static analyzer
 # (tools/parseclint — lock discipline, event-loop blocking calls,
 # device_put aliasing, MCA knob drift, containment exception hygiene,
@@ -104,7 +116,60 @@ else
     echo "premerge: traced tasks probe FAILED to run"
     rc=1
 fi
+echo "== premerge probe: native-vs-python A/B (tasks) =="
+native_margin="${4:-1.05}"
+fb="/tmp/premerge_tasks_fb_$$.json"
+if [ -n "$tasks_off" ] && JAX_PLATFORMS=cpu PARSEC_BENCH_APP=tasks \
+     PARSEC_MCA_SCHED_NATIVE=0 python "$repo/bench.py" > "$fb" 2>/dev/null; then
+    # the FALLBACK path regressing is as pre-merge-fatal as the native
+    # one: every probe artifact before r11 was measured on it
+    if ! python "$repo/tools/bench_guard.py" "$fb" --repo "$repo" \
+         --threshold "$threshold"; then
+        rc=1
+    fi
+    if ! python - "$tasks_off" "$fb" "$native_margin" <<'EOF'
+import json, sys
+def last_json(path):
+    for line in reversed(open(path).read().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"premerge: no JSON in {path}")
+nat, fb = last_json(sys.argv[1]), last_json(sys.argv[2])
+margin = float(sys.argv[3])
+active = (nat.get("native") or {}).get("sched_native")
+ratio = nat["value"] / fb["value"] if fb["value"] else float("inf")
+print(f"premerge: sched native A/B {fb['value']:.0f} -> "
+      f"{nat['value']:.0f} tasks/s (x{ratio:.2f}, need >= x{margin}; "
+      f"native active: {active})")
+if active != 1:
+    print("premerge: NATIVE PATH INACTIVE in the default tasks probe "
+          "(build degraded?) — a no-op native path fails pre-merge")
+    sys.exit(1)
+sys.exit(0 if ratio >= margin else 1)
+EOF
+    then
+        rc=1
+    fi
+else
+    echo "premerge: fallback tasks probe FAILED to run"
+    rc=1
+fi
+rm -f "$fb"
 rm -f "$tasks_off" "$on"
+echo "== premerge probe: shm transport rtt =="
+shmout="/tmp/premerge_shm_rtt_$$.json"
+if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=rtt PARSEC_MCA_COMM_TRANSPORT=shm \
+     python "$repo/bench.py" > "$shmout" 2>/dev/null; then
+    if ! python "$repo/tools/bench_guard.py" "$shmout" --repo "$repo" \
+         --threshold "$threshold"; then
+        rc=1
+    fi
+else
+    echo "premerge: shm rtt probe FAILED to run"
+    rc=1
+fi
+rm -f "$shmout"
 echo "== premerge probe: telemetry overhead (metrics + flight recorder armed) =="
 tel="/tmp/premerge_telemetry_$$.json"
 if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=telemetry \
@@ -134,7 +199,9 @@ else
 fi
 rm -f "$tel"
 echo "== premerge probe: chaos (seeded fault plans, no-hang invariant) =="
-if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 4 --quick; then
+# 6 seeds = one pass over the quick catalog, which now includes the
+# shm-transport kill and recv-reorder legs
+if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 6 --quick; then
     rc=1
 fi
 exit $rc
