@@ -305,6 +305,12 @@ def potrf_taskpool(A: TiledMatrix, device: str = "tpu",
     tp.task_classes["POTRF"].properties["fuse_chain"] = ("W", "TRSM")
     tp.task_classes["TRSM"].properties["coaffinity"] = \
         lambda loc, A=A: A(loc["k"], loc["k"])
+    # recovery spec (core/recovery.py): the whole dataflow reads and
+    # writes A, so a peer death can re-map A's lost partition onto the
+    # survivors and re-enumerate this pool from the restored tiles —
+    # give A an init_fn (A.set_init) so ADOPTED tiles have a
+    # re-runnable source, and the pool recovers instead of failing
+    tp.recovery_collections = [A]
     return tp
 
 

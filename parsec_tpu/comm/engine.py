@@ -62,14 +62,16 @@ TAG_CLOCK = 12    # clock-offset ping/pong (causal-trace alignment)
 TAG_HB = 13       # heartbeat (active failure detection of HUNG peers)
 TAG_METRICS = 14  # telemetry pull/push (cross-rank /metrics aggregation)
 TAG_FLIGHT = 15   # flight-recorder incident dump request (prof/flightrec)
-TAG_USER = 16     # first tag available to applications
+TAG_REJOIN = 16   # elastic-rejoin handshake of a restarted incarnation
+TAG_USER = 17     # first tag available to applications
 
 # the fault injector names tags without importing this module (it is
 # below us in the layering); a drift between the two maps would
 # silently mistarget every tag-matched fault directive.  An explicit
 # raise, not assert: python -O would compile the guard away
 for _name, _tag in (("ACT", TAG_ACTIVATE), ("DTD", TAG_DTD),
-                    ("GET_REP", TAG_GET_REP), ("HB", TAG_HB)):
+                    ("GET_REP", TAG_GET_REP), ("HB", TAG_HB),
+                    ("REJOIN", TAG_REJOIN)):
     if faultinject.TAG_NAMES[_name] != _tag:
         raise RuntimeError(
             f"faultinject.TAG_NAMES[{_name!r}] drifted from "
@@ -124,6 +126,14 @@ params.register("comm_peer_timeout_s", 15.0,
                 "machinery, so a HUNG peer — open socket, nothing "
                 "flowing — is detected, not just a closed one; "
                 "0 disables active detection)")
+
+params.register("comm_epoch", 0,
+                "incarnation epoch of this process's comm engine: a "
+                "rank RESTARTED after a death rejoins with a bumped "
+                "epoch (TAG_REJOIN handshake) so survivors can fence "
+                "stale frames of the previous incarnation out of the "
+                "protocol (core/recovery.py elastic rejoin); 0 = first "
+                "incarnation")
 
 params.register("comm_transport", "evloop",
                 "socket transport module: 'evloop' (single-threaded "
@@ -327,7 +337,10 @@ class CommEngine:
         self._bar_lock = threading.Lock()
         self._bar_cond = threading.Condition(self._bar_lock)
         self._bar_gen = 0                        # guarded-by: _bar_cond
-        self._bar_arrived: Dict[int, int] = {}   # guarded-by: _bar_cond
+        #: gen -> set of arrived SOURCE ranks (not a bare count: a
+        #: rank that arrived and then died+was-excused must not satisfy
+        #: the shrunk survivor quorum in its place; guarded-by: _bar_cond)
+        self._bar_arrived: Dict[int, set] = {}
         self._bar_released: set = set()          # guarded-by: _bar_cond
         self._bar_aborted: set = set()           # guarded-by: _bar_cond
         # registered HERE, next to the state it serves: a transport
@@ -353,6 +366,26 @@ class CommEngine:
         #: ranks whose connection died mid-run (failure detection);
         #: barrier and quiescence waiters observe this and fail fast
         self.dead_peers: set = set()
+        #: dead ranks the RECOVERY plane routed around (core/recovery):
+        #: barriers, quiescence and checkpoints run over the survivors
+        #: instead of failing — empty unless a recovery engaged, so the
+        #: containment-only behavior is reproduced exactly by default
+        self.excused_peers: set = set()
+        #: this engine's incarnation epoch (comm_epoch): restarted
+        #: ranks rejoin with a bumped value; receivers fence older ones
+        self.epoch = int(params.get("comm_epoch", 0))
+        #: elastic rejoin: gate on reconnections from dead ranks (set by
+        #: the recovery coordinator; default keeps the PR 3 zombie
+        #: rejection) and the survivor-side handshake validator
+        self.rejoin_allowed = False
+        self.on_rejoin: Optional[Callable[[int, dict],
+                                          Optional[dict]]] = None
+        self._rejoin_cond = threading.Condition()
+        self._rejoin_ack: Optional[dict] = None   # guarded-by: _rejoin_cond
+        self.tag_register(TAG_REJOIN, self._rejoin_cb)
+        #: set when an injected kill_rank fired on THIS rank: its own
+        #: containment must not be "recovered" into a split brain
+        self.fault_killed = False
         #: failure detection: monotonic stamp of the last frame each peer
         #: delivered (ANY tag counts as liveness; TAG_HB only guarantees
         #: a floor of traffic on an otherwise-quiet control lane)
@@ -363,8 +396,11 @@ class CommEngine:
         self._fault = faultinject.comm_faults(rank) \
             if faultinject.ARMED else None
         #: Safra reconcile hook: the remote-dep layer adjusts its message
-        #: balance when the injector drops/duplicates an app frame
-        self.on_frame_fault: Optional[Callable[[str, int, Any], None]] = None
+        #: balance (global AND per-destination — the recovery reconcile
+        #: subtracts a dead rank's whole contribution, so the two must
+        #: move together) when the injector drops/duplicates an app frame
+        self.on_frame_fault: Optional[Callable[[str, int, Any, int],
+                                               None]] = None
         #: kill_rank mode=hang: a muted engine neither sends nor
         #: processes frames (sockets stay open — the silent-hang fault)
         self._muted = False
@@ -416,12 +452,29 @@ class CommEngine:
         kind, gen = payload
         with self._bar_cond:
             if kind == "arrive":
-                self._bar_arrived[gen] = self._bar_arrived.get(gen, 0) + 1
+                self._bar_arrived.setdefault(gen, set()).add(src)
             elif kind == "abort":
                 self._bar_aborted.add(gen)
             else:
                 self._bar_released.add(gen)
             self._bar_cond.notify_all()
+
+    def _bar_fatal(self) -> set:
+        """Dead peers a barrier must FAIL on: excused ranks (a recovery
+        routed around them — core/recovery.py) narrowed the collective
+        to the survivors, every other death still aborts the round.
+        Empty excused set == the pre-recovery semantics exactly."""
+        return self.dead_peers - self.excused_peers
+
+    def _bar_live(self) -> List[int]:
+        """Barrier participants: every rank not EXCUSED (self included).
+        A non-excused dead rank stays a participant — its absence fails
+        the round exactly as before recovery existed; only a recovery's
+        excusal narrows the collective.  The root is the lowest
+        participant, so survivor-only barriers keep working when rank 0
+        itself died and was excused."""
+        return [r for r in range(self.nranks)
+                if r == self.rank or r not in self.excused_peers]
 
     def barrier(self, timeout: float = 60.0) -> None:
         with self._bar_cond:
@@ -432,6 +485,17 @@ class CommEngine:
             gen = self._bar_gen
         if self.nranks == 1:
             return
+        live = self._bar_live()
+        root = live[0]
+        if len(live) == 1:
+            # every peer is dead; with all of them excused this is a
+            # survivor-of-one barrier (trivially met), otherwise the
+            # fatal check below raises as before
+            if self._bar_fatal():
+                raise ConnectionError(
+                    f"rank {self.rank}: barrier with dead peer(s) "
+                    f"{sorted(self.dead_peers)}")
+            return
         with self._bar_cond:
             # GC residue of past generations (stragglers landing after a
             # waiter gave up re-add entries nobody will consume — a
@@ -441,19 +505,32 @@ class CommEngine:
                                  if g >= gen}
             self._bar_released = {g for g in self._bar_released if g >= gen}
             self._bar_aborted = {g for g in self._bar_aborted if g >= gen}
-        if self.rank == 0:
+        if self.rank == root:
+            # arrivals needed re-evaluate per wakeup: a participant
+            # dying AND being excused mid-round shrinks the quorum
+            # instead of stranding the root; an unexcused death keeps
+            # the quorum unreachable so the fatal path aborts the round
+            def quorum() -> int:
+                return sum(1 for r in range(self.nranks)
+                           if r != self.rank
+                           and r not in self.excused_peers)
+
+            def arrived() -> int:
+                # live arrivals only: an arrival from a since-excused
+                # rank must not stand in for a survivor still working
+                return len(set(self._bar_arrived.get(gen, ()))
+                           - self.excused_peers)
             with self._bar_cond:
                 ok = self._bar_cond.wait_for(
-                    lambda: self._bar_arrived.get(gen, 0) == self.nranks - 1
-                    or self.dead_peers,
+                    lambda: arrived() >= quorum() or self._bar_fatal(),
                     timeout=timeout)
-                failed = (self.dead_peers and
-                          self._bar_arrived.get(gen, 0) != self.nranks - 1)
+                failed = (self._bar_fatal() and arrived() < quorum())
                 if not failed:
                     if not ok:
                         self._bar_arrived.pop(gen, None)
-                        raise TimeoutError("rank 0: barrier timeout")
-                    del self._bar_arrived[gen]
+                        raise TimeoutError(
+                            f"rank {self.rank}: barrier timeout")
+                    self._bar_arrived.pop(gen, None)
                 else:
                     # failure paths must not leak this generation (a
                     # resident service keeps the engine alive across
@@ -463,43 +540,54 @@ class CommEngine:
                 # a peer died before arriving: fail the SURVIVORS fast
                 # too — an abort releases their wait with the cause
                 # instead of letting them ride out the full timeout
-                for r in range(1, self.nranks):
+                for r in range(self.nranks):
+                    if r == self.rank or r in self.dead_peers:
+                        continue
                     try:
                         self.send_am(TAG_BARRIER, r, ("abort", gen))
                     except OSError:
                         pass
                 raise ConnectionError(
-                    f"rank 0: barrier with dead peer(s) "
+                    f"rank {self.rank}: barrier with dead peer(s) "
                     f"{sorted(self.dead_peers)}")
-            for r in range(1, self.nranks):
+            for r in range(self.nranks):
+                if r == self.rank or r in self.dead_peers:
+                    continue
                 try:
                     self.send_am(TAG_BARRIER, r, ("release", gen))
                 except OSError:
                     # a rank that arrived and then died must not strand
                     # the release of later-ranked survivors
-                    warning("rank 0: barrier release to dead rank %d "
-                            "skipped", r)
+                    warning("rank %d: barrier release to dead rank %d "
+                            "skipped", self.rank, r)
         else:
-            self.send_am(TAG_BARRIER, 0, ("arrive", gen))
+            self.send_am(TAG_BARRIER, root, ("arrive", gen))
             with self._bar_cond:
                 # A SIBLING that passed this barrier and exited before
                 # our release arrived is orderly shutdown (final-barrier
-                # race), so sibling death alone does not fail us — rank
-                # 0 aborts the round if a sibling died mid-barrier, and
-                # only rank 0's own death can strand our release.
+                # race), so sibling death alone does not fail us — the
+                # root aborts the round if a sibling died mid-barrier,
+                # and only the root's own (unexcused) death can strand
+                # our release.
+                # the captured root dying fails this round FAST whether
+                # or not a recovery later excuses it: an excused root
+                # still sends neither release nor abort for a round it
+                # entered dead — only barriers ENTERED after the
+                # excusal re-elect a live root
                 ok = self._bar_cond.wait_for(
                     lambda: gen in self._bar_released
                     or gen in self._bar_aborted
-                    or 0 in self.dead_peers,
+                    or root in self.dead_peers,
                     timeout=timeout)
                 if gen not in self._bar_released and \
-                        (gen in self._bar_aborted or 0 in self.dead_peers):
+                        (gen in self._bar_aborted
+                         or root in self.dead_peers):
                     aborted = gen in self._bar_aborted
                     self._bar_aborted.discard(gen)
                     raise ConnectionError(
                         f"rank {self.rank}: barrier with dead peer(s) "
                         f"{sorted(self.dead_peers)}"
-                        + (" (aborted by rank 0)" if aborted else ""))
+                        + (" (aborted by the root)" if aborted else ""))
                 if not ok:
                     self._bar_released.discard(gen)
                     self._bar_aborted.discard(gen)
@@ -746,6 +834,64 @@ class CommEngine:
         """Per-peer starved-checker rebase counts (metrics export)."""
         return dict(self._hb_rebases)
 
+    # -- recovery plane (core/recovery.py) -------------------------------
+    def excuse_peer(self, r: int) -> None:
+        """Mark a dead rank ROUTED-AROUND: collectives and quiescence
+        proceed over the survivors instead of failing on it."""
+        self.excused_peers.add(r)
+        with self._bar_cond:
+            self._bar_cond.notify_all()
+
+    def peer_rejoined(self, r: int, epoch: int) -> None:
+        """A restarted incarnation of ``r`` completed the TAG_REJOIN
+        handshake: clear the death marks so traffic flows again (its
+        transport connection was re-established at handshake time)."""
+        self.dead_peers.discard(r)
+        self.excused_peers.discard(r)
+        self._note_heard(r)
+        with self._bar_cond:
+            self._bar_cond.notify_all()
+
+    # lint: on-loop (AM callback)
+    def _rejoin_cb(self, src: int, msg: Any) -> None:
+        if not isinstance(msg, dict):
+            return
+        k = msg.get("k")
+        if k == "req":
+            cb = self.on_rejoin
+            reply = None
+            if cb is not None:
+                try:
+                    reply = cb(src, msg)
+                except Exception as exc:
+                    warning("rank %d: rejoin validation failed: %s",
+                            self.rank, exc)
+            if reply is None:
+                reply = {"k": "deny"}
+            try:
+                self.send_am(TAG_REJOIN, src, reply)
+            except OSError:
+                pass   # the rejoiner vanished again; nothing to do
+        elif k == "ack":
+            with self._rejoin_cond:
+                self._rejoin_ack = msg
+                self._rejoin_cond.notify_all()
+        # denies are NOT stashed: one fast deny (a survivor with a
+        # higher fence) must not mask a later ack from a survivor that
+        # already validated us and flipped peer_rejoined — the waiter
+        # keeps waiting for an ack until its timeout
+
+    def wait_rejoin_ack(self, timeout: float) -> Optional[dict]:
+        """Block for a rejoin ACK (restarted-rank side); None when no
+        survivor acknowledged within the timeout (all denied or
+        unreachable)."""
+        with self._rejoin_cond:
+            self._rejoin_cond.wait_for(
+                lambda: self._rejoin_ack is not None, timeout=timeout)
+            ack = self._rejoin_ack
+            self._rejoin_ack = None
+        return ack
+
     def declare_peer_dead(self, r: int, exc: Exception) -> None:
         """Shared death path (EOF, corruption, heartbeat silence): mark,
         drop the transport state, wake barrier waiters, and route the
@@ -808,6 +954,9 @@ class CommEngine:
         the heartbeat timeout can see it)."""
         warning("rank %d: FAULT INJECTION kill_rank fired (mode=%s)",
                 self.rank, mode)
+        #: the recovery plane must never "recover" the killed rank's own
+        #: view of its peers — that would split-brain the gang
+        self.fault_killed = True
         if mode == "hang":
             self._muted = True
             return
@@ -828,7 +977,7 @@ class CommEngine:
                       self.rank, kind, tag, dst)
         if kind == "drop":
             if self.on_frame_fault is not None:
-                self.on_frame_fault("drop", tag, payload)
+                self.on_frame_fault("drop", tag, payload, dst)
             return True
         if kind == "delay":
             def _delayed_send():
@@ -839,14 +988,14 @@ class CommEngine:
                     # like a drop, or the Safra balance leaks the held
                     # frame's count forever
                     if self.on_frame_fault is not None:
-                        self.on_frame_fault("drop", tag, payload)
+                        self.on_frame_fault("drop", tag, payload, dst)
             t = threading.Timer(ms * 1e-3, _delayed_send)
             t.daemon = True
             t.start()
             return True
         if kind == "dup":
             if self.on_frame_fault is not None:
-                self.on_frame_fault("dup", tag, payload)
+                self.on_frame_fault("dup", tag, payload, dst)
             self.send_am(tag, dst, payload, _nofault=True)
             return False
         if kind == "trunc":
@@ -854,7 +1003,7 @@ class CommEngine:
             # (the wire-corruption detector); the frame's message never
             # arrives, so reconcile the balance like a drop
             if self.on_frame_fault is not None:
-                self.on_frame_fault("drop", tag, payload)
+                self.on_frame_fault("drop", tag, payload, dst)
             try:
                 self._send_raw_parts(
                     dst, [_LEN.pack(tag, 8, 0), b"\xde\xad\xbe\xef" * 2])
@@ -1194,6 +1343,18 @@ class SocketCE(CommEngine):
                         "(magic=%r version=%r)", self.rank, magic, ver)
                 conn.close()
                 continue
+            if src in self.dead_peers and not self.rejoin_allowed:
+                # no rejoin protocol armed: a dead rank's reconnection
+                # would be a half-connected zombie (frames dispatched
+                # while every reply is refused by the dead-peer guard)
+                warning("rank %d: rejected reconnection from dead rank "
+                        "%d", self.rank, src)
+                conn.close()
+                continue
+            if src in self.dead_peers:
+                warning("rank %d: reconnection from dead rank %d "
+                        "accepted pending TAG_REJOIN handshake",
+                        self.rank, src)
             with self._plock:
                 self._peers.setdefault(src, conn)
                 self._send_locks.setdefault(src, threading.Lock())
@@ -2320,15 +2481,22 @@ class EventLoopCE(CommEngine):
                 self._close_peer(peer)
                 return False
             peer.rank = src
-            if src in self.dead_peers:
-                # a rank we already declared dead has no rejoin
-                # protocol: accepting it would create a half-connected
-                # zombie (its frames dispatched and Safra-counted while
-                # _send_now drops every reply)
+            if src in self.dead_peers and not self.rejoin_allowed:
+                # no rejoin protocol armed: accepting a dead rank would
+                # create a half-connected zombie (its frames dispatched
+                # and Safra-counted while _send_now drops every reply)
                 warning("rank %d: rejected reconnection from dead rank "
                         "%d", self.rank, src)
                 self._close_peer(peer)
                 return False
+            if src in self.dead_peers:
+                # elastic rejoin (core/recovery.py): adopt the stream —
+                # the rank stays dead (sends still refused, app frames
+                # fenced by incarnation epoch) until its TAG_REJOIN
+                # handshake validates, which flips peer_rejoined
+                warning("rank %d: reconnection from dead rank %d "
+                        "accepted pending TAG_REJOIN handshake",
+                        self.rank, src)
             existing = self._peers.get(src)
             if existing is not None and existing is not peer:
                 if existing.sock is None:

@@ -83,9 +83,15 @@ def _worker(rank: int, nranks: int, port_base: int, nb_cores: int,
 
 def run_distributed(fn: Callable, nranks: int, args: tuple = (),
                     nb_cores: int = 2, timeout: float = 120.0,
-                    port_base: Optional[int] = None) -> List[Any]:
+                    port_base: Optional[int] = None,
+                    tolerate_ranks=()) -> List[Any]:
     """Run ``fn(ctx, rank, nranks, *args)`` on ``nranks`` processes;
-    returns the per-rank results in rank order."""
+    returns the per-rank results in rank order.
+
+    ``tolerate_ranks``: ranks whose failure is EXPECTED (chaos kill
+    victims under recovery — the survivors' completion is the result
+    that matters); their slot in the returned list is None when they
+    errored.  An error on any other rank still fails the run."""
     if port_base is None:
         port_base = _probe_port_base(nranks)
     mpctx = mp.get_context("spawn")
@@ -112,12 +118,15 @@ def run_distributed(fn: Callable, nranks: int, args: tuple = (),
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+    tolerate = set(tolerate_ranks)
     results: dict = {}
     errors: List[str] = []
     try:
         for _ in range(nranks):
             rank, err, res = outq.get(timeout=timeout)
-            if err is not None:
+            if err is not None and rank in tolerate:
+                results[rank] = None   # expected casualty (chaos kill)
+            elif err is not None:
                 errors.append(f"rank {rank}:\n{err}")
             else:
                 results[rank] = res

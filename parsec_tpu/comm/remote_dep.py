@@ -177,6 +177,22 @@ class RemoteDepEngine:
         self._terminated = threading.Event()
         self._app_sent = 0                  # guarded-by: _term_lock
         self._app_recv = 0                  # guarded-by: _term_lock
+        #: per-peer twins of the Safra counters: a RECOVERY subtracts a
+        #: dead rank's whole contribution in one critical section so
+        #: the token balance reflects survivor traffic only
+        #: (core/recovery.py; guarded-by: _term_lock)
+        self._sent_to: Dict[int, int] = {}
+        self._recv_from: Dict[int, int] = {}
+        #: incarnation fencing: frames from ``src`` whose ``_ep`` is
+        #: below the fence are stale traffic of a dead incarnation —
+        #: dropped BEFORE the Safra credit (their sender's counters were
+        #: reconciled away; guarded-by: _term_lock)
+        self._fence_epoch: Dict[int, int] = {}
+        self._peer_epoch: Dict[int, int] = {}   # guarded-by: _term_lock
+        #: this engine's own incarnation (comm_epoch): stamped into app
+        #: frames past epoch 0 so survivors can tell a rejoined rank's
+        #: traffic from its dead predecessor's
+        self._epoch = int(getattr(ce, "epoch", 0))
         self._retry_pending = False         # guarded-by: _dlock
         #: dynamic taskpools holding a runtime action until the
         #: pool-scoped quiescence round proves global drain (the
@@ -195,8 +211,10 @@ class RemoteDepEngine:
         ce.on_frame_fault = self._on_frame_fault
         #: per-message wire id (origin_rank, seq): receivers drop
         #: duplicate deliveries (retransmits, injected dups) after
-        #: crediting them in the Safra balance
-        self._fid_seq = itertools.count(1)
+        #: crediting them in the Safra balance.  The sequence starts at
+        #: epoch << 48 so a rejoined incarnation can never collide with
+        #: ids its predecessor already burned into peers' dedup windows
+        self._fid_seq = itertools.count(1 + (self._epoch << 48))
         self._seen_fids: set = set()
         self._fid_order: "deque" = deque()
         #: causal tracer (prof/causal.py) and flight recorder
@@ -271,6 +289,9 @@ class RemoteDepEngine:
         #: the one-sided GET itself rides uncounted CE messages;
         #: guarded-by: _term_lock)
         self.dtd_refs_pending = 0
+        #: per-pool share of dtd_refs_pending, so a recovery restart can
+        #: forget exactly its pool's parked pulls (guarded-by: _term_lock)
+        self._dtd_refs_tp: Dict[int, int] = {}
         self._recv_handlers = {
             "activate": self._activate_cb,
             "get_req": self._get_req_cb,
@@ -312,6 +333,12 @@ class RemoteDepEngine:
         fr = getattr(context, "_flightrec", None)
         if fr is not None:
             fr.attach_comm(self)
+        rec = getattr(context, "recovery", None)
+        if rec is not None:
+            # recovery plane (core/recovery.py): wires the TAG_REJOIN
+            # validator and lets the transport accept reconnections
+            # from dead ranks pending that handshake
+            rec.attach_comm(self)
         if self.funnelled:
             self._progress = None
             ce.add_periodic(self._purge_stale_handles, 5.0)
@@ -524,19 +551,35 @@ class RemoteDepEngine:
     # ------------------------------------------------------------------
     # robustness: fault reconcile, dedup, rendezvous retry, containment
     # ------------------------------------------------------------------
-    def _on_frame_fault(self, kind: str, tag: int, payload) -> None:
+    def _on_frame_fault(self, kind: str, tag: int, payload,
+                        dst: int = -1) -> None:
         """Safra reconcile for injected frame faults: the counters must
         reflect what actually crossed the wire, or the token never sees
         a zero balance again (a permanent hang the PLAN did not ask
-        for).  Only Safra-counted tags matter."""
+        for).  Only Safra-counted tags matter.  The per-destination
+        twin moves WITH the global counter — recovery_reconcile
+        subtracts a dead rank's contribution wholesale, and a drift
+        between the two would push the post-recovery balance negative
+        forever."""
         if tag == TAG_BATCH:
             n = len(payload) if isinstance(payload, list) else 1
         elif tag in (TAG_ACTIVATE, TAG_GET_REQ, TAG_GET_REP, TAG_DTD):
             n = 1
         else:
             return
+        d = n if kind == "dup" else -n
         with self._term_lock:
-            self._app_sent += n if kind == "dup" else -n
+            if dst >= 0 and dst in self._fence_epoch \
+                    and dst not in self._sent_to:
+                # the injector's delay timer outlived the peer AND its
+                # recovery: recovery_reconcile already erased this
+                # lane's whole count — subtracting the held frame again
+                # would drive the survivor balance permanently negative
+                # and the token would never see zero
+                return
+            self._app_sent += d
+            if dst >= 0:
+                self._sent_to[dst] = self._sent_to.get(dst, 0) + d
 
     def _is_dup(self, msg) -> bool:
         """Receiver-side dedup by wire id.  Called AFTER the Safra recv
@@ -582,7 +625,7 @@ class RemoteDepEngine:
                     detector="rendezvous")
             if exc is not None:
                 if self._pending_gets.pop(key, None) is not None:
-                    self.context.record_pool_error(pend["tp"], exc)
+                    self._contain_pool(pend["tp"], exc)
                 continue
             if now >= pend["next_at"]:
                 pend["attempts"] += 1
@@ -600,12 +643,15 @@ class RemoteDepEngine:
                     pass
 
     def _on_peer_dead(self, rank: int, exc: Exception) -> None:
-        """Containment: a dead peer fails the taskpools that TOUCH it —
-        parked rendezvous pulls rooted there, and pools that exchanged
-        traffic with it (Taskpool.peer_ranks) — through the per-pool
-        error route (Context.record_pool_error -> error_sink for
-        service jobs).  Only when nothing can be attributed does the
-        failure land on the context globally (the pre-r8 behavior)."""
+        """Containment — with a second exit: a dead peer's taskpools
+        (parked rendezvous pulls rooted there, pools that exchanged
+        traffic with it via Taskpool.peer_ranks) are first offered to
+        the RECOVERY plane (core/recovery.py), which re-executes their
+        lost lineage on the survivors; whatever recovery does not take
+        fails through the per-pool error route (Context.record_pool_error
+        -> error_sink for service jobs) exactly as before.  Only when
+        nothing can be attributed AND no recovery excused the death does
+        the failure land on the context globally (the pre-r8 behavior)."""
         pools: Dict[int, Any] = {}
         for key in [k for k in list(self._pending_gets) if k[0] == rank]:
             pend = self._pending_gets.pop(key, None)
@@ -614,15 +660,90 @@ class RemoteDepEngine:
         for tp in list(self.context.taskpools.values()):
             if rank in getattr(tp, "peer_ranks", ()):
                 pools[id(tp)] = tp
+        live = [tp for tp in pools.values()
+                if not getattr(tp, "completed", False)
+                and not getattr(tp, "cancelled", False)]
+        handled = False
+        rec = getattr(self.context, "recovery", None)
+        if rec is not None:
+            handled, live = rec.on_peer_dead(rank, exc, live)
         routed = False
-        for tp in pools.values():
-            if getattr(tp, "completed", False) \
-                    or getattr(tp, "cancelled", False):
-                continue
+        for tp in live:
             routed = True
             self.context.record_pool_error(tp, exc)
-        if not routed:
+        if not routed and not handled:
             self.context.record_error(exc, None)
+
+    def _contain_pool(self, tp, exc: Exception) -> None:
+        """Pool-scoped containment with recovery awareness: secondary
+        failures of a generation that is already being rebuilt (dead-
+        child sends, parked pulls of the torn run) are swallowed — the
+        restart owns that pool's fate — everything else routes through
+        Context.record_pool_error as before."""
+        rec = getattr(self.context, "recovery", None)
+        if rec is not None and isinstance(exc, PeerFailedError) \
+                and rec.recovering(tp) and rec.excused(exc.rank):
+            return
+        self.context.record_pool_error(tp, exc)
+
+    # -- recovery reconcile (core/recovery.py) ---------------------------
+    def peer_fence(self, src: int) -> int:
+        with self._term_lock:
+            return self._fence_epoch.get(src, 0)
+
+    def note_peer_epoch(self, src: int, epoch: int) -> None:
+        """A rejoined incarnation announced ``epoch``: frames at or
+        above it pass the fence, its dead predecessor's stay out."""
+        with self._term_lock:
+            self._peer_epoch[src] = epoch
+            self._fence_epoch.setdefault(src, 0)
+
+    def recovery_reconcile(self, dead: int) -> None:
+        """Subtract a dead rank's whole contribution from the Safra
+        balance and fence its future stragglers, in ONE critical
+        section — after this the token sees exactly the in-flight
+        traffic among survivors, so termination detection converges
+        once the re-inserted sub-DAG drains (the generalization of the
+        on_frame_fault drop reconcile)."""
+        with self._term_lock:
+            self._fence_epoch[dead] = self._peer_epoch.get(dead, 0) + 1
+            self._app_sent -= self._sent_to.pop(dead, 0)
+            self._app_recv -= self._recv_from.pop(dead, 0)
+
+    def forget_pool(self, tp) -> None:
+        """Drop every parked/queued protocol item of a pool's torn
+        generation (recovery restart): delayed activations, outbox and
+        flush-window frames, parked rendezvous pulls, DTD backlog and
+        pending-pull counts.  Safra stays balanced: inbound items were
+        credited at receive, outbound ones were counted only if they
+        reached _send_app (queued-not-sent outbox entries were not)."""
+        tpid = tp.taskpool_id
+        with self._dlock:
+            self._delayed = [(s, m) for (s, m) in self._delayed
+                             if not (isinstance(m, dict)
+                                     and m.get("tp") == tpid)]
+            self._dtd_backlog.pop(tpid, None)
+        with self._outbox_lock:
+            for key in [k for k, edges in list(self._outbox.items())
+                        if edges and edges[0][0].taskpool is tp]:
+                self._outbox.pop(key, None)
+        with self._flush_lock:
+            for dst in list(self._flushbox):
+                kept = [(t, m) for (t, m) in self._flushbox[dst]
+                        if not (isinstance(m, dict)
+                                and m.get("tp") == tpid)]
+                if kept:
+                    self._flushbox[dst] = kept
+                else:
+                    del self._flushbox[dst]
+        for key, pend in list(self._pending_gets.items()):
+            if pend.get("tp") is tp:
+                self._pending_gets.pop(key, None)
+        with self._term_lock:
+            # every generation of this pool: the restart re-registers
+            # what the new generation actually pulls
+            for key in [k for k in self._dtd_refs_tp if k[0] == tpid]:
+                self.dtd_refs_pending -= self._dtd_refs_tp.pop(key)
 
     def debug_state(self) -> Dict[str, Any]:
         """Protocol-state snapshot for the hang autopsy (Context.wait's
@@ -700,6 +821,7 @@ class RemoteDepEngine:
             ranks = sorted(targets)
             msg = {
                 "tp": tp.taskpool_id,
+                "pe": tp.run_epoch,   # recovery generation fence
                 "root": self.rank,
                 "src_task": str(task),
                 "deliveries": {r: targets[r] for r in ranks},
@@ -759,7 +881,7 @@ class RemoteDepEngine:
                     # a dead child must not cut off its live siblings:
                     # route into the owning pool (the window>0 path's
                     # drain does the same per child)
-                    self.context.record_pool_error(tp, exc)
+                    self._contain_pool(tp, exc)
 
     # lint: on-loop (periodic hook + opportunistic worker calls)
     def _drain_flush_window(self, force: bool = False) -> None:
@@ -785,7 +907,7 @@ class RemoteDepEngine:
                              if isinstance(p, dict)}:
                     tp = self.context.taskpools.get(tpid)
                     if tp is not None:
-                        self.context.record_pool_error(tp, exc)
+                        self._contain_pool(tp, exc)
 
     # -- adaptive eager/rendezvous threshold (reference: the eager-limit
     # MCA of remote_dep_mpi.c, made per-peer and feedback-driven) --------
@@ -904,6 +1026,18 @@ class RemoteDepEngine:
         if isinstance(payload, dict) and "_fid" not in payload:
             payload["_fid"] = (self.rank, next(self._fid_seq))
 
+    def _stamp_ep(self, payload) -> None:
+        """Incarnation mark, re-stamped PER HOP (unlike the fid): the
+        receiver's fence is keyed by the rank it physically received
+        the frame from, so ``_ep`` must name the LAST sender's
+        incarnation — a rejoined rank relaying an epoch-0 originator's
+        activation down the bcast tree must not have the relay fenced
+        as its dead predecessor's straggler.  First incarnations
+        (epoch 0) stamp nothing — a fence only ever exists for ranks
+        that died, and a rejoiner is epoch >= 1 by construction."""
+        if self._epoch and isinstance(payload, dict):
+            payload["_ep"] = self._epoch
+
     def _dead_peer_guard(self, dst: int) -> None:
         if dst in self.ce.dead_peers:
             raise PeerFailedError(
@@ -920,9 +1054,11 @@ class RemoteDepEngine:
         queueing — callers route it into the owning taskpool."""
         self._dead_peer_guard(dst)
         self._stamp_fid(payload)
+        self._stamp_ep(payload)
         with self._term_lock:
             self._color_black = True
             self._app_sent += 1
+            self._sent_to[dst] = self._sent_to.get(dst, 0) + 1
         if self._sinks:
             payload = self._traced(tag, dst, payload)
         self._post_send(tag, dst, payload)
@@ -934,9 +1070,11 @@ class RemoteDepEngine:
         self._dead_peer_guard(dst)
         for _tag, p in items:
             self._stamp_fid(p)
+            self._stamp_ep(p)
         with self._term_lock:
             self._color_black = True
             self._app_sent += len(items)
+            self._sent_to[dst] = self._sent_to.get(dst, 0) + len(items)
         if self._sinks:
             # per inner message: each gets its own correlation id; the
             # receiver's _batch_cb re-dispatches them individually, so
@@ -1020,15 +1158,30 @@ class RemoteDepEngine:
     # ------------------------------------------------------------------
     # receiver side
     # ------------------------------------------------------------------
-    def _on_app_recv(self) -> None:
+    def _on_app_recv(self, src: int, msg=None) -> bool:
+        """Safra credit for one received app message; returns False —
+        and credits NOTHING — when the frame is fenced: a straggler of
+        a dead incarnation whose counters recovery_reconcile already
+        subtracted (crediting it would push the survivor balance
+        negative forever).  The fence check and the credit share one
+        critical section so a concurrent reconcile can never see half
+        of either."""
         with self._term_lock:
+            fence = self._fence_epoch.get(src)
+            if fence is not None:
+                ep = msg.get("_ep", 0) if isinstance(msg, dict) else 0
+                if ep < fence:
+                    return False
             self._color_black = True   # Safra: receiving blackens
             self._app_recv += 1
+            self._recv_from[src] = self._recv_from.get(src, 0) + 1
+        return True
 
     # lint: on-loop (AM handler: runs in place on the evloop thread)
     def _activate_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_ACTIVATE, src, msg)
-        self._on_app_recv()   # exactly once per wire message
+        if not self._on_app_recv(src, msg):   # exactly once per message
+            return            # fenced: stale incarnation straggler
         if self._is_dup(msg):
             return            # retransmit/injected dup: already acted on
         self._try_activation(src, msg)
@@ -1036,9 +1189,19 @@ class RemoteDepEngine:
     def _try_activation(self, src: int, msg: dict) -> None:
         from parsec_tpu.core.taskpool import TaskpoolState
         tp = self.context.taskpools.get(msg["tp"])
-        if tp is None or tp.state < TaskpoolState.RUNNING:
-            # unknown taskpool, or known but startup hasn't counted local
-            # tasks yet: releasing now would drive nb_tasks negative
+        if tp is not None and msg.get("pe", 0) < tp.run_epoch:
+            # a torn recovery generation's activation (this pool already
+            # restarted past it): the Safra credit landed, the delivery
+            # is void — the restart re-enumerated every dependence
+            return
+        if tp is None or tp.state < TaskpoolState.RUNNING \
+                or msg.get("pe", 0) > tp.run_epoch:
+            # unknown taskpool, known but startup hasn't counted local
+            # tasks yet, known but mid-recovery-restart (state rewound
+            # below RUNNING parks EVERYTHING until the new generation's
+            # structures exist), or a peer that finished ITS restart
+            # before we even began ours (pe from the future): releasing
+            # now would land in structures about to be torn down
             # (reference: delayed activations, remote_dep_mpi.c:1831).
             # One daemon timer at a time closes the race where the pool
             # became RUNNING and drained the queue between our state
@@ -1071,7 +1234,7 @@ class RemoteDepEngine:
         try:
             self._send_tree(msg)
         except PeerFailedError as exc:
-            self.context.record_pool_error(tp, exc)
+            self._contain_pool(tp, exc)
         data = msg["data"]
         deliveries = msg["deliveries"].get(self.rank) or \
             msg["deliveries"].get(str(self.rank))
@@ -1097,12 +1260,13 @@ class RemoteDepEngine:
                                {"handle": handle, "from": self.rank})
             except PeerFailedError as exc:
                 self._pending_gets.pop(key, None)
-                self.context.record_pool_error(tp, exc)
+                self._contain_pool(tp, exc)
 
     # lint: on-loop (AM handler)
     def _get_req_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_GET_REQ, src, msg)
-        self._on_app_recv()
+        if not self._on_app_recv(src, msg):
+            return
         if self._is_dup(msg):
             return
         h = msg["handle"]
@@ -1157,12 +1321,31 @@ class RemoteDepEngine:
         tp = self.context.taskpools.get(msg.get("tp"))
         if tp is not None:
             tp.peer_ranks.add(dst)
+            # recovery generation: a survivor mid-restart parks frames
+            # of its already-recovered peer instead of losing them
+            msg.setdefault("pe", tp.run_epoch)
         self._send_app(TAG_DTD, dst, msg)
 
-    def dtd_ref_done(self) -> None:
+    def dtd_ref_done(self, ref_key=None) -> None:
         """One rendezvous pull completed (locked: the counter is shared
-        between the progress thread and socket recv threads)."""
+        between the progress thread and socket recv threads).
+        ``ref_key`` is the (taskpool_id, pool-generation) the pull was
+        credited under in _dtd_cb.  A pull whose pool a recovery
+        already forgot (forget_pool subtracted that generation's whole
+        share) must NOT decrement again — the double-count would drive
+        the global below zero, and a truthy negative keeps _local_idle
+        False forever; the generation in the key also stops a
+        pre-restart pull's completion from eating the NEW generation's
+        count."""
         with self._term_lock:
+            if ref_key is not None:
+                n = self._dtd_refs_tp.get(ref_key)
+                if n is None:
+                    return   # forgotten by a recovery restart
+                if n <= 1:
+                    self._dtd_refs_tp.pop(ref_key, None)
+                else:
+                    self._dtd_refs_tp[ref_key] = n - 1
             self.dtd_refs_pending -= 1
 
     # lint: on-loop (AM handler)
@@ -1174,41 +1357,89 @@ class RemoteDepEngine:
         # queues while the pull hasn't been registered yet.  A duplicate
         # is credited (its send was counted too) but must NOT register a
         # second pull — the leaked pending count would hang termination
+        from parsec_tpu.core.taskpool import TaskpoolState
         dup = self._is_dup(msg)
+        tp = self.context.taskpools.get(msg.get("tp")) \
+            if isinstance(msg, dict) else None
+        pe = msg.get("pe", 0) if isinstance(msg, dict) else 0
+        # a torn generation's message is credited (its sender is live
+        # and counted) but takes NO side effects — in particular its
+        # 'ref' must not register a pull nobody will ever complete
+        stale = tp is not None and pe < tp.run_epoch
         with self._term_lock:
+            fence = self._fence_epoch.get(src)
+            if fence is not None and \
+                    (msg.get("_ep", 0) if isinstance(msg, dict)
+                     else 0) < fence:
+                return   # stale incarnation: no credit, no delivery
             self._color_black = True
             self._app_recv += 1
-            if not dup and isinstance(msg, dict) and "ref" in msg:
+            self._recv_from[src] = self._recv_from.get(src, 0) + 1
+            if not dup and not stale and isinstance(msg, dict) \
+                    and "ref" in msg:
+                # keyed by (pool, generation): a restart's forget_pool
+                # subtracts exactly the torn generation's share, and a
+                # pre-restart pull completing late cannot eat the new
+                # generation's count (dtd_ref_done misses its key)
+                key = (msg.get("tp"), pe)
                 self.dtd_refs_pending += 1
-        if dup:
+                self._dtd_refs_tp[key] = \
+                    self._dtd_refs_tp.get(key, 0) + 1
+        if dup or stale:
             return
-        tp = self.context.taskpools.get(msg["tp"])
         if tp is not None:
             tp.peer_ranks.add(src)
         incoming = getattr(tp, "_dtd_incoming", None)
-        if incoming is not None:
+        if incoming is not None and pe <= tp.run_epoch and \
+                (tp.run_epoch == 0
+                 or tp.state >= TaskpoolState.RUNNING):
+            # past a restart (run_epoch > 0) frames additionally wait
+            # for the rebuilt structures (state back at RUNNING) in the
+            # backlog; the pristine-pool fast path is unchanged
             incoming(src, msg)
             return
         with self._dlock:   # pool not registered here yet: backlog
             self._dtd_backlog.setdefault(msg["tp"], []).append((src, msg))
         # re-check: the pool may have registered — and drained an empty
         # backlog — between the lookup above and the append (the drain
-        # pops under _dlock, so a second drain cannot double-deliver)
+        # pops under _dlock, so a second drain cannot double-deliver).
+        # The SAME deliverability gate as above applies: a pool parked
+        # below RUNNING by a recovery restart must keep the frame in
+        # the backlog, or the mid-restart parking would be a no-op
+        # (immediate re-drain into the half-torn structures)
         tp = self.context.taskpools.get(msg["tp"])
-        if getattr(tp, "_dtd_incoming", None) is not None:
+        if tp is not None \
+                and getattr(tp, "_dtd_incoming", None) is not None \
+                and (tp.run_epoch == 0
+                     or tp.state >= TaskpoolState.RUNNING):
             self.dtd_drain_backlog(tp)
 
     def dtd_drain_backlog(self, tp) -> None:
-        """Deliver DTD messages that arrived before ``tp`` registered."""
+        """Deliver DTD messages that arrived before ``tp`` registered.
+        Generation-aware: frames of a torn generation drop (their Safra
+        credit already landed), frames from a generation we have not
+        reached yet re-park for the next drain."""
         with self._dlock:
             backlog = self._dtd_backlog.pop(tp.taskpool_id, [])
+        keep = []
         for src, msg in backlog:
+            pe = msg.get("pe", 0) if isinstance(msg, dict) else 0
+            if pe < tp.run_epoch:
+                continue   # torn generation: credited, void
+            if pe > tp.run_epoch:
+                keep.append((src, msg))
+                continue
             tp._dtd_incoming(src, msg)
+        if keep:
+            with self._dlock:
+                self._dtd_backlog.setdefault(tp.taskpool_id,
+                                             []).extend(keep)
 
     # lint: on-loop (AM handler)
     def _get_rep_cb(self, src: int, msg: dict) -> None:
         self._trace_recv(TAG_GET_REP, src, msg)
-        self._on_app_recv()
+        if not self._on_app_recv(src, msg):
+            return
         if self._is_dup(msg):
             return
         key = (msg["root"], msg["handle"])
@@ -1219,7 +1450,7 @@ class RemoteDepEngine:
             # contained: the pull's OWNING pool fails, not the context
             # (the handle expired server-side — TTL or a grace window
             # the retry backoff outlived)
-            self.context.record_pool_error(pend["tp"], PeerFailedError(
+            self._contain_pool(pend["tp"], PeerFailedError(
                 src, f"rank {self.rank}: rendezvous payload "
                      f"{msg['handle']} from rank {src} expired before "
                      "our GET (comm_handle_timeout)",
@@ -1300,13 +1531,46 @@ class RemoteDepEngine:
         with self._term_lock:
             return self._app_sent - self._app_recv
 
+    def _live_peers(self) -> List[int]:
+        """Peers still in the gang: not declared dead.  After a
+        recovery excused a death, the Safra ring and the quiescence
+        collectives run over exactly these ranks."""
+        dead = self.ce.dead_peers
+        return [r for r in range(self.nranks)
+                if r != self.rank and r not in dead]
+
+    def _next_live(self, r: int) -> Optional[int]:
+        """The ring successor of ``r`` among live ranks (self counts as
+        live); None when this rank is the only survivor."""
+        dead = self.ce.dead_peers
+        for i in range(1, self.nranks):
+            cand = (r + i) % self.nranks
+            if cand == self.rank or cand not in dead:
+                return cand
+        return None
+
+    def _ring_root(self) -> int:
+        """The Safra initiator: the LOWEST live rank (rank 0 unless its
+        death was routed around by a recovery — a survivor ring must
+        still have exactly one token source)."""
+        dead = self.ce.dead_peers
+        for r in range(self.nranks):
+            if r == self.rank or r not in dead:
+                return r
+        return self.rank
+
     # lint: on-loop (AM handler)
     def _termdet_cb(self, src: int, msg: dict) -> None:
+        if src in self.ce.dead_peers:
+            # a stale token/terminate of a dead (possibly recovered-
+            # around) rank must not steer the survivor ring
+            return
         kind = msg.get("kind")
         if kind == "terminate":
-            if self.rank != 0:
-                nxt = (self.rank + 1) % self.nranks
-                if nxt != 0:
+            root = self._ring_root()
+            if self.rank != root:
+                nxt = self._next_live(self.rank)
+                if nxt is not None and nxt != root:
                     try:
                         self.ce.send_am(TAG_TERMDET, nxt,
                                         {"kind": "terminate"})
@@ -1315,9 +1579,10 @@ class RemoteDepEngine:
             self._terminated.set()
             return
         if kind == "dyn_release":
-            if self.rank != 0:
-                nxt = (self.rank + 1) % self.nranks
-                if nxt != 0:
+            root = self._ring_root()
+            if self.rank != root:
+                nxt = self._next_live(self.rank)
+                if nxt is not None and nxt != root:
                     try:
                         self.ce.send_am(TAG_TERMDET, nxt,
                                         {"kind": "dyn_release"})
@@ -1349,16 +1614,18 @@ class RemoteDepEngine:
         with self._term_lock:
             my_black = self._color_black
             self._color_black = False
-        if self.rank == 0:
-            # token returned home: token.balance sums ranks 1..N-1; the
-            # initiator's own balance joins only HERE (adding it at send
-            # time too would double-count it and never reach zero)
+        root = self._ring_root()
+        if self.rank == root:
+            # token returned home: token.balance sums the live ring's
+            # other ranks; the initiator's own balance joins only HERE
+            # (adding it at send time too would double-count it and
+            # never reach zero)
             clean = (not token["black"]) and not my_black and \
                 token["balance"] + self._balance() == 0 and \
                 token["rounds"] >= 1
+            nxt = self._next_live(self.rank)
             if clean:
-                nxt = 1 % self.nranks
-                if nxt != 0:
+                if nxt is not None and nxt != root:
                     self.ce.send_am(
                         TAG_TERMDET, nxt,
                         {"kind": "dyn_release" if dyn else "terminate"})
@@ -1367,11 +1634,20 @@ class RemoteDepEngine:
                 else:
                     self._terminated.set()
             else:
-                self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
+                if nxt is None or nxt == root:
+                    # the ring shrank to this rank mid-round (peers
+                    # died and were excused): the waiter loop's re-kick
+                    # handles it — a sole survivor short-circuits in
+                    # wait_quiescence/resolve_dynamic_holds instead
+                    return
+                self.ce.send_am(TAG_TERMDET, nxt, {
                     "kind": kind, "black": False, "balance": 0,
                     "rounds": token["rounds"] + 1})
         else:
-            self.ce.send_am(TAG_TERMDET, (self.rank + 1) % self.nranks, {
+            nxt = self._next_live(self.rank)
+            if nxt is None:
+                return   # sole survivor mid-round; the waiter re-kicks
+            self.ce.send_am(TAG_TERMDET, nxt, {
                 "kind": kind,
                 "black": token["black"] or my_black,
                 "balance": token["balance"] + self._balance(),
@@ -1417,6 +1693,57 @@ class RemoteDepEngine:
                 tp.termdet.taskpool_addto_runtime_actions(tp, -1)
         self._dyn_released.set()
 
+    def _drive_ring(self, idle_fn, done_evt, kind: str, on_done,
+                    what: str, deadline: Optional[float]) -> None:
+        """ONE Safra-ring driver for both quiescence flavors (the full
+        context drain and the dynamic-hold round differ only in their
+        idle predicate, completion event, token kind, and release
+        action).  The ring root (lowest live rank — rank 0 unless its
+        death was excused) launches the token once locally idle and
+        RELAUNCHES it when an excused death shrinks the ring mid-round
+        (the dead rank may have eaten the token; rounds restart, so a
+        clean decision still needs one full white pass of the new
+        ring); an UNEXCUSED death fails the waiter fast as before."""
+        def kick():
+            while not idle_fn():
+                if done_evt.wait(0.01):
+                    return
+            with self._term_lock:
+                self._color_black = False
+            nxt = self._next_live(self.rank)
+            if nxt is None or nxt == self.rank:
+                on_done()
+                return
+            try:
+                self.ce.send_am(TAG_TERMDET, nxt, {
+                    "kind": kind, "black": False, "balance": 0,
+                    "rounds": 0})
+            except OSError:
+                pass   # dead ring: the waiter below fails fast
+        # kick is defined unconditionally: the mid-wait re-kick may run
+        # on a rank that only became the ring root after rank 0's
+        # excused death
+        if self.rank == self._ring_root():
+            threading.Thread(target=kick, daemon=True).start()
+        seen_dead = set(self.ce.dead_peers)
+        while not done_evt.wait(0.05):
+            fatal = self.ce.dead_peers - self.ce.excused_peers
+            if fatal:
+                dead = sorted(fatal)
+                raise PeerFailedError(
+                    dead[0], f"rank {self.rank}: {what} with dead "
+                             f"peer(s) {dead}")
+            if self.ce.dead_peers != seen_dead:
+                seen_dead = set(self.ce.dead_peers)
+                if not self._live_peers():
+                    on_done()   # sole survivor: local idle = global
+                    return
+                if self.rank == self._ring_root():
+                    threading.Thread(target=kick, daemon=True).start()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rank {self.rank}: {what} not reached")
+
     def resolve_dynamic_holds(self, timeout: Optional[float] = None) -> None:
         """Block until every rank's dynamic pools drained with no
         discovery message in flight, then release their holds everywhere
@@ -1426,68 +1753,29 @@ class RemoteDepEngine:
         with self._term_lock:
             if not self._dyn_holds:
                 return
-        if self.nranks == 1:
+        if self.nranks == 1 or not self._live_peers():
+            # single rank, or the sole survivor of a recovered gang:
+            # local drain IS global drain
             self._release_dyn_holds()
             self._dyn_released.clear()
             return
-        if self.rank == 0:
-            def kick():
-                while not self._dyn_idle():
-                    if self._dyn_released.wait(0.01):
-                        return
-                with self._term_lock:
-                    self._color_black = False
-                try:
-                    self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
-                        "kind": "dyn_token", "black": False, "balance": 0,
-                        "rounds": 0})
-                except OSError:
-                    pass   # dead ring: the waiter below fails fast
-            threading.Thread(target=kick, daemon=True).start()
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while not self._dyn_released.wait(0.05):
-            if self.ce.dead_peers:
-                dead = sorted(self.ce.dead_peers)
-                raise PeerFailedError(
-                    dead[0],
-                    f"rank {self.rank}: dynamic-pool quiescence with "
-                    f"dead peer(s) {dead}")
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"rank {self.rank}: dynamic-pool termination not "
-                    "reached")
+        self._drive_ring(
+            self._dyn_idle, self._dyn_released, "dyn_token",
+            self._release_dyn_holds, "dynamic-pool termination",
+            None if timeout is None else time.monotonic() + timeout)
         self._dyn_released.clear()
 
     def wait_quiescence(self, timeout: float = 120.0) -> None:
         """Block until every rank is idle and no message is in flight
-        (called by Context.wait when distributed)."""
-        if self.nranks == 1:
-            return
-        if self.rank == 0:
-            def kick():
-                while not self._local_idle():
-                    if self._terminated.wait(0.01):
-                        return
-                with self._term_lock:
-                    self._color_black = False
-                try:
-                    self.ce.send_am(TAG_TERMDET, 1 % self.nranks, {
-                        "kind": "token", "black": False, "balance": 0,
-                        "rounds": 0})
-                except OSError:
-                    pass   # dead ring: the waiter below fails fast
-            threading.Thread(target=kick, daemon=True).start()
-        deadline = time.monotonic() + timeout
-        while not self._terminated.wait(0.05):
-            if self.ce.dead_peers:
-                dead = sorted(self.ce.dead_peers)
-                raise PeerFailedError(
-                    dead[0],
-                    f"rank {self.rank}: quiescence with dead peer(s) "
-                    f"{dead}")
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"rank {self.rank}: global termination not reached")
+        (called by Context.wait when distributed).  Runs over the LIVE
+        ring: a recovery-excused death narrows the collective to the
+        survivors; an unexcused one still fails fast."""
+        if self.nranks == 1 or not self._live_peers():
+            return   # sole survivor: local idle is global idle
+        self._drive_ring(
+            self._local_idle, self._terminated, "token",
+            self._terminated.set, "global termination",
+            time.monotonic() + timeout)
         self._terminated.clear()
 
     def fini(self) -> None:

@@ -55,6 +55,14 @@ params.register("runtime_gc_freeze", 1,
                 "process-permanent imports in every supported "
                 "deployment).  0 = leave the collector alone")
 
+params.register("recovery_enable", 0,
+                "peer-death RECOVERY: surviving ranks re-map a dead "
+                "rank's data partition onto themselves and re-execute "
+                "the lost lineage instead of failing the affected "
+                "taskpools (core/recovery.py).  0 (default) keeps the "
+                "containment-only failure lifecycle: a dead peer fails "
+                "the pools that touch it and the service degrades")
+
 _gc_frozen = False
 
 
@@ -82,6 +90,10 @@ class ExecutionStream:
         self.vp_id = vp_id
         self.nb_tasks_done = 0
         self.sched_data: Any = None
+        #: task whose body is currently executing on this stream, or
+        #: None — recovery's in-flight drain polls it so tile restore
+        #: never races a stale-generation body's in-place writes
+        self.running_task = None
         self._pins_cbs = {}
         #: the context's event->callbacks dict, aliased so the per-task
         #: dispatch reads one attribute (pins_register mutates the dict
@@ -230,6 +242,13 @@ class Context:
         if int(params.get("flightrec_enabled", 0)):
             from parsec_tpu.prof.flightrec import FlightRecorder
             FlightRecorder(self).install(self)
+        # recovery plane (core/recovery.py): opt-in — when disabled
+        # (the default) every peer-death path keeps the containment
+        # behavior, byte for byte
+        self.recovery = None
+        if int(params.get("recovery_enable", 0)):
+            from parsec_tpu.core.recovery import RecoveryCoordinator
+            self.recovery = RecoveryCoordinator(self)
         self._recompute_ready_stamp()
 
         debug_verbose(3, "context up: %d streams, scheduler=%s",
@@ -302,6 +321,12 @@ class Context:
             self._pending_start.append(tp)
         from parsec_tpu.utils.properties import install_taskpool_properties
         install_taskpool_properties(self, tp)
+        if self.recovery is not None:
+            # recovery registration: snapshot the pool's collections'
+            # local tiles (the lineage base a restart restores to) and
+            # record its replay spec; pools without one stay on the
+            # containment path
+            self.recovery.register_pool(tp)
         if self.comm is not None:
             # activations may have raced this registration
             self.comm.retry_delayed()
@@ -398,6 +423,14 @@ class Context:
             # peers may still pull our data (reference: ranks keep
             # progressing comm until termdet quiesces the whole run)
             self.comm.wait_quiescence()
+            # past global quiescence every completed pool is GLOBALLY
+            # done: retire them so a later peer death cannot resurrect
+            # them for re-execution (core/recovery.py restarts only
+            # locally-complete, not-yet-retired pools)
+            with self._lock:
+                for tp in self.taskpools.values():
+                    if getattr(tp, "completed", False):
+                        tp.retired = True
 
     def sync_devices(self, timeout: Optional[float] = None) -> None:
         """Quiesce accelerator pipelines (shared by wait() and the job

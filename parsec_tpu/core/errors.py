@@ -40,6 +40,17 @@ class TaskRetryExhausted(RuntimeError):
             self.__cause__ = last
 
 
+class CheckpointDegradedError(RuntimeError):
+    """A checkpoint/restore was attempted while a peer rank is dead and
+    NOT routed-around by recovery: the collective barrier delimiting
+    the snapshot would wedge until its timeout, so the operation fails
+    fast instead.  ``ranks`` names the dead peers."""
+
+    def __init__(self, msg: str, ranks=()):
+        super().__init__(msg)
+        self.ranks = sorted(ranks)
+
+
 class FaultInjected(RuntimeError):
     """A fault-plan ``fail_task`` directive fired (utils/faultinject.py).
     Deliberately transient-shaped: the retry machinery treats it like

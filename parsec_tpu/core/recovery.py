@@ -1,0 +1,826 @@
+"""Recovery plane: lineage re-execution, partition re-mapping, rejoin.
+
+PR 5 finished the failure lifecycle at CONTAINMENT: a dead rank is
+detected (EOF / corruption / heartbeat silence), the taskpools touching
+it fail with structured errors, and the service degrades permanently.
+This module adds the second exit from every containment path — RECOVER:
+
+  1. **Lineage re-execution.**  When ``declare_peer_dead`` fires, the
+     surviving ranks reconstruct the dead rank's lost tiles instead of
+     failing the pool.  Each survivor deterministically computes the
+     same recovery decision (coordinator = lowest surviving rank, but
+     the per-rank work needs no election round: translation targets and
+     partitions are pure functions of the dead set), rewinds the
+     affected pool's termdet counters (``taskpool_reset``), restores the
+     pool's collections to their last surviving version — the
+     registration-time snapshot, or the collection's re-runnable source
+     (``DataCollection.set_init``) for tiles whose only copy died with
+     their rank — and re-inserts the re-execution sub-DAG on the
+     survivors (``ParameterizedTaskpool.startup`` re-enumeration with
+     translated owner-computes, or the pool's ``recovery_replay`` for
+     insert-driven DTD pools).  ``lineage_plan`` below is the exact
+     minimal-set walk over a recorded lineage; the end-to-end restart
+     is deliberately CONSERVATIVE — it replays the pool's whole local
+     partition from the restore point, because in-place tile mutation
+     means a partial replay is only sound from a globally consistent
+     cut (which the registration snapshot / checkpoint shard is, and
+     arbitrary mid-run tile states are not).  The ≤2x-makespan
+     acceptance bound is the bound of exactly this policy.
+
+  2. **Partition re-mapping.**  The dead rank's key range re-balances
+     onto survivors through a rank-translation table installed PER
+     COLLECTION (``DataCollection.set_rank_translation``): ``rank_of``
+     stays the pure distribution function while ``owner_of`` — which
+     task placement, activation routing, and local-tile materialization
+     consult — routes around the hole.  Pools over untouched
+     collections never observe a re-mapped owner, so silent
+     misdirection of unaffected jobs is structurally impossible.
+
+  3. **Elastic rejoin.**  A restarted rank comes back with a bumped
+     incarnation epoch (``--mca comm_epoch`` / ``PARSEC_COMM_EPOCH``),
+     re-dials the transports, and performs a TAG_REJOIN handshake: the
+     survivors validate the epoch against the fence recorded at death
+     (stale frames of the previous incarnation are dropped before they
+     can touch the Safra balance — see RemoteDepEngine), clear the dead
+     mark, hand back the current translation table, and the rank takes
+     its partition back for every subsequently attached pool.  Clock
+     sync re-establishes through the ordinary TAG_CLOCK probe rounds on
+     the re-dialed connection.
+
+Safra/termdet reconciliation: the remote-dep engine keeps per-peer send
+and receive counters next to the global balance; a recovery subtracts
+the dead rank's whole contribution in one critical section (the same
+contract ``faultinject.on_frame_fault`` established for injected drops)
+and fences later frames from the dead incarnation, so the token sees
+exactly the in-flight traffic among survivors and termination converges
+after re-insertion.
+
+Everything here is OPT-IN (``recovery_enable``, default 0): disabled,
+every path reproduces PR 5's containment behavior exactly.
+
+Known limits (documented, structured-failure fallbacks): DynamicTaskpool
+(PTG ``%option dynamic``) pools, pools whose collections lack both a
+snapshot and an ``init_fn`` for the adopted tiles, cancelled pools, and
+a rank's own injected death are not recovered; rejoin is supported on
+the socket transports (threads/evloop) — an shm receiver unlinks its
+rings at death, so a restarted shm rank needs a fresh gang instead.
+Under NEAR-SIMULTANEOUS multi-rank deaths, survivors whose detectors
+fire in different orders transiently compute divergent translation
+tables (each is a pure function of that survivor's dead SET, which
+converges as detections land); a restart run against the stale view
+can address a just-dead adopter, fail contained, and burn one
+``recovery_max_attempts`` slot before the next event re-normalizes —
+bounded, never silent, but a true agreement round is future work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from parsec_tpu.core.taskpool import (ParameterizedTaskpool, Taskpool,
+                                      TaskpoolState)
+from parsec_tpu.utils.mca import params
+from parsec_tpu.utils.output import debug_verbose, warning
+
+params.register("recovery_max_attempts", 2,
+                "per-taskpool budget of peer-death recoveries: one more "
+                "rank dying after this many restarts fails the pool "
+                "with the contained structured error instead of "
+                "recovering again (survivor exhaustion stays a CLEAN "
+                "failure, never a loop)")
+params.register("recovery_snapshot", 1,
+                "snapshot each registered pool's local collection tiles "
+                "at attach — the lineage restore point for the pool's "
+                "own partition (a dead rank's ADOPTED tiles restore "
+                "from the collection's init_fn re-runnable source).  "
+                "0 relies on init_fn alone")
+params.register("recovery_drain_s", 10.0,
+                "bound on waiting for in-flight stale-generation task "
+                "bodies to leave the workers before tiles are restored "
+                "(the run_epoch fence discards them at completion; this "
+                "wait keeps their in-place writes off restored data)")
+params.register("recovery_rejoin", 1,
+                "accept TAG_REJOIN handshakes from restarted "
+                "incarnations of dead ranks (needs recovery_enable; "
+                "0 keeps the PR 3 zombie-reconnect rejection)")
+params.register("recovery_completed_grace_s", 30.0,
+                "how long a LOCALLY-completed pool stays restartable "
+                "after its termination: within the window a peer death "
+                "still restarts it (another survivor may need its "
+                "re-executed partition — local completion is not "
+                "global), past it the pool's recovery spec and tile "
+                "snapshots are evicted, so a resident service's job "
+                "history is never resurrected or leaked")
+
+
+class RecoveryUnsupported(RuntimeError):
+    """A pool or collection cannot be recovered (no snapshot, no
+    re-runnable source, unsupported pool type); the peer death then
+    takes the containment path with this as context."""
+
+
+# ---------------------------------------------------------------------------
+# lineage planning (pure; unit-tested on hand-built DAGs)
+# ---------------------------------------------------------------------------
+
+class LineageRecord:
+    """One completed task in a lineage log: the tile versions it read
+    and the tile versions it produced (versions are per-tile monotone,
+    the datum version-clock discipline)."""
+
+    __slots__ = ("key", "reads", "writes")
+
+    def __init__(self, key: Any,
+                 reads: List[Tuple[Any, int]] = (),
+                 writes: List[Tuple[Any, int]] = ()):
+        self.key = key
+        self.reads = list(reads)
+        self.writes = list(writes)
+
+
+def lineage_plan(log: List[LineageRecord],
+                 surviving: Dict[Any, int],
+                 needed: Dict[Any, int]):
+    """The minimal re-execution set: walk backward from the ``needed``
+    (tile -> version) outputs to the last surviving version of every
+    input.
+
+    ``surviving`` maps tile -> highest version still materialized on a
+    live rank (registration snapshots are version 0 of every tile).  A
+    needed (tile, version) with ``surviving[tile] >= version`` costs
+    nothing; otherwise its producer joins the plan and that producer's
+    reads become needed.  Returns ``(tasks, base)``: the re-execution
+    set in log (= valid topological) order, and the {tile: version}
+    frontier the restore must materialize before replay starts.
+    """
+    producer: Dict[Tuple[Any, int], int] = {}
+    for i, rec in enumerate(log):
+        for tile, ver in rec.writes:
+            producer[(tile, ver)] = i
+    chosen: set = set()
+    base: Dict[Any, int] = {}
+    work = deque((t, v) for t, v in needed.items())
+    seen: set = set()
+    while work:
+        tile, ver = work.popleft()
+        if (tile, ver) in seen:
+            continue
+        seen.add((tile, ver))
+        if surviving.get(tile, -1) >= ver:
+            base[tile] = max(base.get(tile, -1), min(ver,
+                                                     surviving[tile]))
+            continue
+        idx = producer.get((tile, ver))
+        if idx is None:
+            raise RecoveryUnsupported(
+                f"lineage broken: no producer and no surviving copy of "
+                f"{tile!r} v{ver}")
+        if idx in chosen:
+            continue
+        chosen.add(idx)
+        for r in log[idx].reads:
+            work.append(r)
+    return [log[i].key for i in sorted(chosen)], base
+
+
+# ---------------------------------------------------------------------------
+# the coordinator
+# ---------------------------------------------------------------------------
+
+class RecoveryCoordinator:
+    """Per-context recovery driver (``Context.recovery``).
+
+    Containment hands it peer deaths on the comm thread
+    (``on_peer_dead``); the actual restart work runs on a dedicated
+    recovery thread so the transport loop keeps beating hearts while
+    tiles restore.  All mutable state is guarded by ``_lock``; the
+    restart pipeline itself is serialized by the single worker thread.
+    """
+
+    def __init__(self, context):
+        self.context = context
+        self.enabled = True
+        self.max_attempts = int(params.get("recovery_max_attempts", 2))
+        self.snapshot_on = bool(int(params.get("recovery_snapshot", 1)))
+        self.drain_s = float(params.get("recovery_drain_s", 10.0))
+        self.completed_grace = float(
+            params.get("recovery_completed_grace_s", 30.0))
+        self._lock = threading.Lock()
+        self._rde = None               # RemoteDepEngine (attach_comm)
+        #: taskpool_id -> {"tp", "collections", "replay"}
+        #: (guarded-by: _lock)
+        self._specs: Dict[int, Dict[str, Any]] = {}
+        #: collection snapshots: id(dc) -> {idx: ndarray}
+        #: (guarded-by: _lock)
+        self._snaps: Dict[int, Dict[Tuple, np.ndarray]] = {}
+        self._snap_dcs: Dict[int, Any] = {}      # keep dc alive w/ snap
+        self._attempts: Dict[int, int] = {}      # guarded-by: _lock
+        self._active: set = set()                # guarded-by: _lock
+        self._events: deque = deque()            # guarded-by: _lock
+        self._worker: Optional[threading.Thread] = None  # guarded-by: _lock
+        #: dead rank -> adopting survivor, cluster-wide view
+        #: (guarded-by: _lock)
+        self._dead_map: Dict[int, int] = {}
+        #: deaths accepted but not yet processed by the recovery thread
+        #: — excused() must cover them, or the window between
+        #: on_peer_dead and _process_event routes secondary send
+        #: failures into containment and fails the very pool being
+        #: rebuilt (guarded-by: _lock)
+        self._pending_dead: set = set()
+        self._translated: List[Any] = []         # guarded-by: _lock
+        #: rejoined incarnation epochs (guarded-by: _lock)
+        self._peer_epochs: Dict[int, int] = {}
+        #: rejoins that landed while a restart was active: their
+        #: translation entries clear once the restart pipeline drains
+        #: (guarded-by: _lock)
+        self._pending_untranslate: set = set()
+        self._services: List[Any] = []           # guarded-by: _lock
+        # observability (metrics plane reads these at scrape; the
+        # counters move only on the recovery/comm threads)
+        self.counts = {"started": 0, "completed": 0, "failed": 0}
+        self.tasks_reexecuted = 0
+        self.rejoins = 0
+        from parsec_tpu.prof.metrics import Histogram
+        self.duration_hist = Histogram()
+        m = getattr(context, "metrics", None)
+        if m is not None:
+            m.register_collector(self._collect)
+
+    # -- wiring ----------------------------------------------------------
+    def attach_comm(self, rde) -> None:
+        """Called by RemoteDepEngine at construction: wire the rejoin
+        handshake and let the transport accept reconnections from dead
+        ranks (the recovery knob gates it)."""
+        self._rde = rde
+        if int(params.get("recovery_rejoin", 1)):
+            rde.ce.rejoin_allowed = True
+            rde.ce.on_rejoin = self.on_rejoin_request
+
+    def attach_service(self, service) -> None:
+        with self._lock:
+            self._services.append(service)
+
+    def detach_service(self, service) -> None:
+        with self._lock:
+            if service in self._services:
+                self._services.remove(service)
+
+    def _notify_services(self, event: str, rank: int) -> None:
+        with self._lock:
+            services = list(self._services)
+        for svc in services:
+            try:
+                svc.note_recovery(event, rank)
+            except Exception as exc:
+                debug_verbose(2, "recovery service notify: %s", exc)
+
+    # -- registration ----------------------------------------------------
+    def register_pool(self, tp: Taskpool) -> None:
+        """Record a pool's recovery spec at attach and snapshot its
+        collections' local tiles — the lineage restore point.  A pool
+        without collections stays on the containment path."""
+        collections = list(getattr(tp, "recovery_collections", ()) or ())
+        spec = {"tp": tp, "collections": collections,
+                "replay": getattr(tp, "recovery_replay", None),
+                "completed_at": None}
+        if collections:
+            tp.on_complete(self._pool_done)
+        snaps = []
+        if collections and self.snapshot_on:
+            for dc in collections:
+                if not hasattr(dc, "local_tiles"):
+                    continue
+                snap: Dict[Tuple, np.ndarray] = {}
+                try:
+                    for idx in dc.local_tiles():
+                        idx = tuple(idx) if isinstance(idx, (tuple, list)) \
+                            else (idx,)
+                        copy = dc.data_of(*idx).pull_to_host()
+                        if copy is not None and copy.payload is not None:
+                            snap[idx] = np.array(copy.payload, copy=True)
+                except Exception as exc:
+                    warning("recovery: snapshot of %s failed (%s); "
+                            "relying on init_fn", dc.name, exc)
+                    snap = {}
+                snaps.append((dc, snap))
+        with self._lock:
+            self._specs[tp.taskpool_id] = spec
+            for dc, snap in snaps:
+                # latest registration wins: for sequential pools over
+                # one collection the snapshot must reflect the state at
+                # THIS pool's attach (its replay base), not the first's
+                self._snaps[id(dc)] = snap
+                self._snap_dcs[id(dc)] = dc
+            self._sweep_locked()
+
+    def _pool_done(self, tp) -> None:
+        """Completion callback: stamp the grace-window clock (a restart
+        re-stamps it on re-termination)."""
+        with self._lock:
+            spec = self._specs.get(tp.taskpool_id)
+            if spec is not None:
+                spec["completed_at"] = time.monotonic()
+
+    def _sweep_locked(self) -> None:   # holds-lock: _lock
+        """Evict specs (and the tile snapshots only they referenced) of
+        pools that retired, were cancelled, or completed past the grace
+        window — a resident service must not accumulate O(jobs served)
+        pool objects and snapshot bytes, nor resurrect ancient jobs on
+        a peer death.  Caller holds _lock."""
+        now = time.monotonic()
+        for tpid in list(self._specs):
+            spec = self._specs[tpid]
+            tp = spec["tp"]
+            done_at = spec["completed_at"]
+            stale = (getattr(tp, "retired", False) or tp.cancelled
+                     or (done_at is not None
+                         and now - done_at > self.completed_grace))
+            if stale and tpid not in self._active:
+                del self._specs[tpid]
+                self._attempts.pop(tpid, None)
+        live_dcs = {id(dc) for spec in self._specs.values()
+                    for dc in spec["collections"]}
+        for key in [k for k in self._snaps if k not in live_dcs]:
+            self._snaps.pop(key, None)
+            self._snap_dcs.pop(key, None)
+
+    # -- containment hand-off (comm thread; must not block) --------------
+    def on_peer_dead(self, rank: int, exc: Exception,
+                     pools: List[Taskpool]):
+        """Decide, per pool, recovery vs containment.  Returns
+        ``(handled, leftover)``: ``handled`` True when this death is
+        excused (the service degrades-but-survives even with zero
+        affected pools); ``leftover`` are pools recovery will NOT take
+        — the caller contains them as before."""
+        ce = self._rde.ce if self._rde is not None else None
+        if not self.enabled or ce is None \
+                or getattr(ce, "fault_killed", False) \
+                or rank == self.context.rank:
+            return False, pools
+        take: List[Taskpool] = []
+        leave: List[Taskpool] = []
+        touching = {tp.taskpool_id for tp in pools}
+        with self._lock:
+            # the restart set is GANG-WIDE per pool, not per-traffic:
+            # the re-executed DAG is global, so every survivor must
+            # restart a pool whose collections span the dead rank even
+            # if ITS partition never exchanged a frame with it — a
+            # survivor left on the old generation would park the new
+            # generation's activations forever.  Registered pools whose
+            # collections cannot contain the dead rank are genuinely
+            # unaffected and stay untouched.
+            candidates = list(pools)
+            for spec in self._specs.values():
+                tp = spec["tp"]
+                # completed-but-not-RETIRED pools are candidates too:
+                # local completion is not global completion, and a
+                # survivor whose partition drained early must still
+                # restart so the adopter's re-executed activations have
+                # somewhere to land (retired = a quiescence round
+                # proved the whole gang done; never resurrected)
+                if tp.taskpool_id in touching \
+                        or getattr(tp, "retired", False) \
+                        or tp.cancelled or not spec["collections"]:
+                    continue
+                if tp.completed:
+                    # locally complete: restartable only within the
+                    # grace window — past it the gang has long since
+                    # quiesced and a resident service's history must
+                    # never be resurrected
+                    done_at = spec["completed_at"]
+                    if done_at is None or \
+                            time.monotonic() - done_at \
+                            > self.completed_grace:
+                        continue
+                if any(getattr(dc, "nodes", 1) > rank
+                       for dc in spec["collections"]):
+                    candidates.append(tp)
+            for tp in candidates:
+                spec = self._specs.get(tp.taskpool_id)
+                # insert-driven pools (anything that is not a
+                # parameterized enumeration) NEED a replay callable: a
+                # base startup() re-enumerates nothing, and a restart
+                # would restore the tiles, re-execute zero tasks, and
+                # "complete" with silently reverted data
+                replayable = spec is not None and (
+                    spec["replay"] is not None
+                    or isinstance(tp, ParameterizedTaskpool))
+                ok = (spec is not None and spec["collections"]
+                      and replayable
+                      and not tp.cancelled
+                      and not getattr(tp, "retired", False)
+                      and not getattr(tp, "_compound_member", False)
+                      and not getattr(tp, "_dyn_hold", False)
+                      and hasattr(tp.termdet, "taskpool_reset")
+                      and self._attempts.get(tp.taskpool_id, 0)
+                      < self.max_attempts)
+                if ok:
+                    self._attempts[tp.taskpool_id] = \
+                        self._attempts.get(tp.taskpool_id, 0) + 1
+                    self._active.add(tp.taskpool_id)
+                    take.append(tp)
+                elif tp.taskpool_id in touching:
+                    leave.append(tp)   # containment, exactly as before
+            self._events.append((rank, exc, take))
+            self._pending_dead.add(rank)
+            worker = self._worker
+            if worker is None or not worker.is_alive():
+                worker = threading.Thread(target=self._run,
+                                          name="parsec-recovery",
+                                          daemon=True)
+                self._worker = worker
+                worker.start()
+        # excuse SYNCHRONOUSLY, on the declaring thread: a survivor
+        # polling wait_quiescence every 50 ms must never observe
+        # dead-but-not-yet-excused in the window before the recovery
+        # worker gets scheduled (the fatal check would fail a run the
+        # recovery is about to save); _process_event's excusal is then
+        # a harmless repeat
+        ce.excuse_peer(rank)
+        self.counts["started"] += 1
+        self.context.telemetry_incident(
+            f"recovery-start rank={rank} pools="
+            f"{[tp.taskpool_id for tp in take]}")
+        warning("rank %d: RECOVERY engaged for dead rank %d (%d pool(s) "
+                "re-executing, %d contained)", self.context.rank, rank,
+                len(take), len(leave))
+        self._notify_services("start", rank)
+        return True, leave
+
+    def recovering(self, tp) -> bool:
+        """Is a recovery restart pending/active for this pool?  The
+        containment paths consult it to swallow secondary errors of the
+        torn generation (dead-child sends, parked pulls) instead of
+        failing a pool that is already being rebuilt."""
+        with self._lock:
+            return tp is not None and tp.taskpool_id in self._active
+
+    def excused(self, rank: int) -> bool:
+        with self._lock:
+            return rank in self._dead_map or rank in self._pending_dead
+
+    # -- the recovery thread ---------------------------------------------
+    def _apply_untranslate(self) -> None:
+        """Clear translation entries of ranks that rejoined while a
+        restart was active, once the restart pipeline drained — a
+        deferred clear nobody applies would leave the rejoined rank's
+        partition re-mapped forever."""
+        with self._lock:
+            if self._active or self._events \
+                    or not self._pending_untranslate:
+                return
+            pend = set(self._pending_untranslate)
+            self._pending_untranslate.clear()
+            translated = list(self._translated)
+        for dc in translated:
+            table = dict(dc._recovery_translate or {})
+            for r in pend:
+                table.pop(r, None)
+            dc.set_rank_translation(table)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if not self._events:
+                    # retire INSIDE the lock: on_peer_dead appends and
+                    # checks worker liveness under the same lock, so an
+                    # event can never strand between our empty-check
+                    # and exit
+                    self._worker = None
+                    break
+                rank, exc, pools = self._events.popleft()
+            try:
+                self._process_event(rank, exc, pools)
+            except Exception as run_exc:   # the thread must drain events
+                warning("rank %d: recovery event for rank %d failed: %s",
+                        self.context.rank, rank, run_exc)
+                self.counts["failed"] += 1
+                with self._lock:
+                    self._pending_dead.discard(rank)
+                for tp in pools:
+                    with self._lock:
+                        self._active.discard(tp.taskpool_id)
+                    self.context.record_pool_error(tp, exc)
+                self._notify_services("failed", rank)
+        self._apply_untranslate()
+
+    def _process_event(self, rank: int, exc: Exception,
+                       pools: List[Taskpool]) -> None:
+        ctx, rde = self.context, self._rde
+        ce = rde.ce
+        t0 = time.monotonic()
+        # 1. excuse + fence + Safra reconcile: from here, barriers and
+        # quiescence run over the survivors, stale frames of the dead
+        # incarnation are dropped before crediting, and the message
+        # balance reflects live traffic only
+        ce.excuse_peer(rank)
+        rde.recovery_reconcile(rank)
+        # the translation recomputes WHOLESALE from the dead SET (not
+        # incrementally from event order): two survivors detecting two
+        # near-simultaneous deaths in opposite order must still land on
+        # the same table, and a chained adopter death (1->2, then 2
+        # dies) must collapse onto a live rank
+        dead_set = (set(ce.dead_peers) | {rank}) - {ce.rank}
+        survivors = sorted(r for r in range(ce.nranks)
+                           if r not in dead_set)
+        if not survivors:
+            raise RecoveryUnsupported("no survivors")
+        with self._lock:
+            self._dead_map = {d: survivors[d % len(survivors)]
+                              for d in dead_set}
+            target = self._dead_map[rank]
+            self._pending_dead.discard(rank)
+        debug_verbose(1, "rank %d: recovery re-maps rank %d -> %d "
+                      "(survivors %s)", ctx.rank, rank, target, survivors)
+        ok = True
+        for tp in pools:
+            try:
+                n = self._restart_pool(tp, rank, target)
+                self.tasks_reexecuted += n
+                debug_verbose(1, "rank %d: pool %d re-executes %d "
+                              "task(s)", ctx.rank, tp.taskpool_id, n)
+            except Exception as restart_exc:
+                ok = False
+                warning("rank %d: recovery of pool %d failed (%s); "
+                        "containing", ctx.rank, tp.taskpool_id,
+                        restart_exc)
+                with self._lock:
+                    self._active.discard(tp.taskpool_id)
+                ctx.record_pool_error(tp, exc)
+        dt = time.monotonic() - t0
+        self.duration_hist.observe(dt)
+        self.counts["completed" if ok else "failed"] += 1
+        self._notify_services("done" if ok else "failed", rank)
+        warning("rank %d: recovery for dead rank %d %s in %.2fs",
+                ctx.rank, rank, "completed" if ok else "FAILED", dt)
+
+    def _restart_pool(self, tp: Taskpool, dead: int, target: int) -> int:
+        """Rewind + restore + re-execute one pool.  Returns the local
+        re-execution task count."""
+        from parsec_tpu.core import scheduling
+        ctx, rde = self.context, self._rde
+        with self._lock:
+            spec = self._specs[tp.taskpool_id]
+        if getattr(tp, "retired", False):
+            # globally done (a quiescence round proved the whole gang
+            # finished): nothing left to re-execute anywhere
+            with self._lock:
+                self._active.discard(tp.taskpool_id)
+            return 0
+        # partition re-mapping on THIS pool's collections (plus the
+        # pool-level table DTD integer affinities consult).  The
+        # pre-restore window is TRANSACTIONAL: a failed pre-flight must
+        # roll the tables back, or owner_of would keep routing the dead
+        # partition here with no restored payloads — a later pool over
+        # the same collection would then materialize zero-filled
+        # adopted tiles and silently compute garbage
+        with self._lock:
+            dead_map = dict(self._dead_map)
+        prev_tables = [(dc, dict(dc._recovery_translate)
+                        if dc._recovery_translate else None)
+                       for dc in spec["collections"]]
+        for dc in spec["collections"]:
+            # the FULL normalized map, not just this event's entry: a
+            # chained adopter death re-targets earlier entries too
+            table = dict(dc._recovery_translate or {})
+            table.update(dead_map)
+            dc.set_rank_translation(table)
+            with self._lock:
+                if dc not in self._translated:
+                    self._translated.append(dc)
+        tp.rank_translation = dead_map
+        try:
+            # pre-flight: every tile this rank now owns must have a
+            # restore source — check BEFORE tearing runtime state down
+            plan = self._restore_plan(spec)
+            # park inbound activations (state < RUNNING), then fence
+            # stale generations (run_epoch) and wait their bodies out
+            tp.state = TaskpoolState.ATTACHED
+            tp.run_epoch += 1
+            # belt only: correctness rides on claim-before-fence-check
+            # in task_progress (the drain observes every claimed body);
+            # this just skips one drain poll for tasks popped right at
+            # the bump
+            time.sleep(0.02)
+            self._drain_inflight(tp)
+            try:
+                ctx.sync_devices(timeout=5.0)
+            except Exception as exc:
+                debug_verbose(2, "recovery device sync: %s", exc)
+            # comm: drop the torn generation's parked/queued state
+            rde.forget_pool(tp)
+            # termdet rewind.  force_terminated: a pool that completed
+            # LOCALLY (its partition drained before the kill) must
+            # still restart — the adopter's re-executed activations
+            # land here — and the returned TERMINATED tells us to
+            # re-arm the completion bookkeeping its termination already
+            # released
+            was = tp.termdet.taskpool_reset(tp, force_terminated=True)
+            if was is None:
+                tp.state = TaskpoolState.DONE
+                with self._lock:
+                    self._active.discard(tp.taskpool_id)
+                return 0
+            from parsec_tpu.core.termdet import TermdetState
+            if was == TermdetState.TERMINATED:
+                with ctx._lock:
+                    ctx._active_taskpools += 1
+                tp._done_event.clear()
+            tp.termdet.taskpool_addto_runtime_actions(tp, 1)  # startup
+            tp.recovery_reset()
+            # restore the last surviving version of every owned tile
+            for dc, idx, arr in plan:
+                dc.data_of(*idx).overwrite_host(np.asarray(arr))
+        except Exception:
+            # anything failing BEFORE the restore finished leaves the
+            # adopted partition unrestored: roll the translation back
+            # so no later pool sees zero-filled adopted tiles as local
+            # (the pool itself is contained by the caller)
+            for dc, prev in prev_tables:
+                dc.set_rank_translation(prev)
+            raise
+        # re-insert the re-execution sub-DAG
+        if spec["replay"] is not None:
+            spec["replay"](tp)
+            n = max(int(tp.nb_tasks), 0)
+        else:
+            ready = tp.startup()
+            n = max(int(tp.nb_tasks), 0)
+            if ready:
+                scheduling.schedule(ctx.streams[0], ready)
+        tp.ready()
+        with self._lock:
+            self._active.discard(tp.taskpool_id)
+        # frames parked while the pool was down deliver into the new
+        # generation now
+        rde.retry_delayed()
+        drain = getattr(ctx.comm, "dtd_drain_backlog", None)
+        if drain is not None and hasattr(tp, "_dtd_incoming"):
+            drain(tp)
+        return n
+
+    def _restore_plan(self, spec) -> List[Tuple[Any, Tuple, Any]]:
+        """(dc, idx, payload) for every tile this rank serves after the
+        re-mapping; raises RecoveryUnsupported when a tile has neither a
+        snapshot nor a re-runnable source."""
+        plan: List[Tuple[Any, Tuple, Any]] = []
+        for dc in spec["collections"]:
+            if not hasattr(dc, "local_tiles"):
+                raise RecoveryUnsupported(
+                    f"collection {dc.name!r} has no local_tiles "
+                    "enumeration")
+            with self._lock:
+                snap = dict(self._snaps.get(id(dc), ()))
+            for idx in dc.local_tiles():
+                idx = tuple(idx) if isinstance(idx, (tuple, list)) \
+                    else (idx,)
+                if idx in snap:
+                    plan.append((dc, idx, snap[idx]))
+                elif dc.init_fn is not None:
+                    plan.append((dc, idx, dc.init_fn(*idx)))
+                else:
+                    raise RecoveryUnsupported(
+                        f"{dc.name}{idx}: no surviving snapshot and no "
+                        "init_fn re-runnable source (set one with "
+                        "collection.set_init)")
+        return plan
+
+    def _drain_inflight(self, tp: Taskpool) -> None:
+        """Wait (bounded) until no worker stream is still executing a
+        stale-generation body of this pool: their in-place tile writes
+        must land BEFORE the restore overwrites them, never after.  A
+        drain that cannot complete ABORTS the recovery (the caller
+        contains the pool): restoring under a still-running stale body
+        would be silent corruption, strictly worse than the contained
+        failure recovery replaces."""
+        deadline = time.monotonic() + self.drain_s
+        while time.monotonic() < deadline:
+            busy = False
+            for es in self.context.streams:
+                t = es.running_task
+                if t is not None and t.taskpool is tp \
+                        and t.pool_epoch != tp.run_epoch:
+                    busy = True
+                    break
+            if not busy:
+                return
+            time.sleep(0.005)
+        raise RecoveryUnsupported(
+            f"rank {self.context.rank}: stale-generation bodies of "
+            f"pool {tp.taskpool_id} still running after "
+            f"{self.drain_s:g}s drain — restoring under them would "
+            "corrupt the lineage base")
+
+    # -- rejoin ----------------------------------------------------------
+    def on_rejoin_request(self, src: int, msg: dict) -> Optional[dict]:
+        """Survivor side of the rejoin handshake (comm thread): validate
+        the incarnation epoch against the fence, clear the dead mark,
+        hand back the translation table.  Returns the ack payload, or
+        None to deny."""
+        rde = self._rde
+        if rde is None:
+            return None
+        epoch = int(msg.get("epoch", 0))
+        fence = rde.peer_fence(src)
+        if epoch < fence:
+            warning("rank %d: rejected rejoin of rank %d with stale "
+                    "epoch %d (fence %d)", self.context.rank, src,
+                    epoch, fence)
+            return None
+        rde.note_peer_epoch(src, epoch)
+        rde.ce.peer_rejoined(src, epoch)
+        busy = False
+        with self._lock:
+            self._peer_epochs[src] = epoch
+            self._dead_map.pop(src, None)
+            dead_map = dict(self._dead_map)
+            busy = bool(self._active) or bool(self._events)
+            translated = list(self._translated)
+            if busy:
+                # a restart mid-flight keeps its table until done (the
+                # re-executing tasks must keep resolving to their
+                # adopter); the recovery thread applies the clear once
+                # the pipeline drains (_apply_untranslate)
+                self._pending_untranslate.add(src)
+        if not busy:
+            # the rank takes its partition back for FUTURE lookups
+            for dc in translated:
+                table = dict(dc._recovery_translate or {})
+                table.pop(src, None)
+                dc.set_rank_translation(table)
+        self.rejoins += 1
+        self._notify_services("rejoin", src)
+        warning("rank %d: rank %d REJOINED (incarnation epoch %d)",
+                self.context.rank, src, epoch)
+        ce = rde.ce
+        with ce._bar_cond:
+            bar_gen = ce._bar_gen
+        return {"k": "ack", "epoch": epoch, "rank": self.context.rank,
+                "translation": dead_map, "bar_gen": bar_gen}
+
+    def rejoin(self, timeout: float = 30.0) -> Dict[int, int]:
+        """Restarted-rank side: announce the new incarnation to every
+        live peer and wait for the first ack; returns the received
+        translation table (other still-dead ranks' re-mappings)."""
+        rde = self._rde
+        if rde is None:
+            raise RuntimeError("rejoin needs an attached comm engine")
+        ce = rde.ce
+        peers = [r for r in range(ce.nranks)
+                 if r != ce.rank and r not in ce.dead_peers]
+        if not peers:
+            raise RuntimeError("rejoin: no live peers to rejoin")
+        req = {"k": "req", "rank": ce.rank, "epoch": ce.epoch}
+        for r in peers:
+            from parsec_tpu.comm.engine import TAG_REJOIN
+            ce.send_am(TAG_REJOIN, r, dict(req))
+        ack = ce.wait_rejoin_ack(timeout)
+        if ack is None:
+            raise TimeoutError(
+                f"rank {ce.rank}: rejoin not acknowledged within "
+                f"{timeout:g}s (every survivor denied the epoch or was "
+                "unreachable)")
+        table = {int(k): int(v)
+                 for k, v in (ack.get("translation") or {}).items()}
+        with self._lock:
+            self._dead_map.update(table)
+        # generation-numbered state transfer: the fresh engine's barrier
+        # counter syncs to the survivors' so the next collective round
+        # numbers match across the rebuilt gang
+        with ce._bar_cond:
+            ce._bar_gen = max(ce._bar_gen,
+                              int(ack.get("bar_gen", 0)))
+        return table
+
+    # -- observability ----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                **self.counts,
+                "tasks_reexecuted": self.tasks_reexecuted,
+                "rejoins": self.rejoins,
+                "dead_map": dict(self._dead_map),
+                "active_pools": sorted(self._active),
+            }
+
+    def _collect(self) -> List[dict]:
+        """Scrape-time metrics families (prof/metrics.py collector —
+        zero hot-path hooks; every value accumulates on the recovery/
+        comm threads and is read here)."""
+        from parsec_tpu.prof.metrics import (counter_sample,
+                                             histogram_sample)
+        out = [counter_sample("parsec_recoveries_total", v,
+                              {"stage": stage})
+               for stage, v in self.counts.items()]
+        out.append(counter_sample("parsec_tasks_reexecuted_total",
+                                  self.tasks_reexecuted))
+        out.append(counter_sample("parsec_rank_rejoins_total",
+                                  self.rejoins))
+        out.append(histogram_sample("parsec_recovery_duration_seconds",
+                                    self.duration_hist))
+        return out

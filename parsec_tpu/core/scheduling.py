@@ -102,63 +102,85 @@ def task_progress(es, task: Task, distance: int = 0) -> None:
     """Run one task through its lifecycle
     (reference: __parsec_task_progress)."""
     tp = task.taskpool
-    if tp.cancelled:
-        # cancelled pool (job-service cancellation/deadline): drop the
-        # task without executing or releasing successors; the termdet
-        # was force-quiesced, so this decrement clamps at zero.  The
-        # ready task holds predecessor repo entries (input_sources,
-        # filled at dep delivery) — release them or the warm context
-        # leaks the cancelled frontier's arena tiles
-        task.status = _COMPLETE
-        es.pins("task_discard", task)
-        try:
-            engine.consume_inputs(task)
-        except Exception as exc:
-            debug_verbose(2, "discard %s: consume_inputs: %s", task, exc)
-        tp.termdet.taskpool_addto_nb_tasks(tp, -1)
-        return
-    cbs = es._pins_map.get("exec_begin")   # inlined es.pins (hot path)
-    if cbs:
-        for cb in cbs:
-            cb(es, "exec_begin", task)
+    # claim BEFORE the fence check: the recovery drain polls
+    # running_task, and a worker descheduled between reading run_epoch
+    # and publishing its claim would execute a stale body over
+    # already-restored tiles — claimed-then-checked, the drain either
+    # sees the claim and waits, or the check runs after the bump and
+    # discards (the restore happens strictly after the bump)
+    es.running_task = task
     try:
-        if task.status < _PREPARED:
-            engine.prepare_input(es, task)
-            task.status = _PREPARED
-        if es.context._retry_max > 0 and task.retries == 0:
-            _snapshot_write_flows(task)
-        if _fi.ARMED and _fi.task_fault(task):
-            # fault plan fail_task directive: a transient, retryable
-            # body failure (utils/faultinject.py)
-            raise FaultInjected(f"{task}: injected transient fault")
-        task.status = _RUNNING
-        ret = execute(es, task)
-    except Exception as exc:  # body/binding error: retry or fail the pool
-        if _maybe_retry(es, task, exc, distance):
+        if task.pool_epoch != tp.run_epoch:
+            # recovery fence: the pool restarted (core/recovery.py)
+            # after this task was scheduled.  Discard WITHOUT executing
+            # and WITHOUT decrementing — the restart re-counted
+            # nb_tasks from scratch and this instance belongs to the
+            # torn generation (its repo/input holds died with the old
+            # structures too)
+            task.status = _COMPLETE
+            es.pins("task_discard", task)
             return
-        if task.retries:
-            exc = TaskRetryExhausted(
-                f"{task}: still failing after {task.retries + 1} "
-                "attempts", attempts=task.retries + 1, last=exc)
-        es.context.record_error(exc, task)
-        complete_execution(es, task, failed=True)
-        return
-    if ret == _DONE:
-        cbs = es._pins_map.get("exec_end")   # inlined es.pins
+        if tp.cancelled:
+            # cancelled pool (job-service cancellation/deadline): drop
+            # the task without executing or releasing successors; the
+            # termdet was force-quiesced, so this decrement clamps at
+            # zero.  The ready task holds predecessor repo entries
+            # (input_sources, filled at dep delivery) — release them or
+            # the warm context leaks the cancelled frontier's arena
+            # tiles
+            task.status = _COMPLETE
+            es.pins("task_discard", task)
+            try:
+                engine.consume_inputs(task)
+            except Exception as exc:
+                debug_verbose(2, "discard %s: consume_inputs: %s",
+                              task, exc)
+            tp.termdet.taskpool_addto_nb_tasks(tp, -1)
+            return
+        cbs = es._pins_map.get("exec_begin")   # inlined es.pins (hot path)
         if cbs:
             for cb in cbs:
-                cb(es, "exec_end", task)
-        complete_execution(es, task)
-    elif ret == _ASYNC:
-        # a device module owns the task now; it will call complete_execution
-        es.pins("exec_async", task)
-    elif ret == _AGAIN:
-        task.status = _READY
-        schedule(es, [task], distance + 1)
-    else:
-        es.context.record_error(
-            RuntimeError(f"{task} failed with {ret!r}"), task)
-        complete_execution(es, task, failed=True)
+                cb(es, "exec_begin", task)
+        try:
+            if task.status < _PREPARED:
+                engine.prepare_input(es, task)
+                task.status = _PREPARED
+            if es.context._retry_max > 0 and task.retries == 0:
+                _snapshot_write_flows(task)
+            if _fi.ARMED and _fi.task_fault(task):
+                # fault plan fail_task directive: a transient, retryable
+                # body failure (utils/faultinject.py)
+                raise FaultInjected(f"{task}: injected transient fault")
+            task.status = _RUNNING
+            ret = execute(es, task)
+        except Exception as exc:  # body/binding error: retry or fail pool
+            if _maybe_retry(es, task, exc, distance):
+                return
+            if task.retries:
+                exc = TaskRetryExhausted(
+                    f"{task}: still failing after {task.retries + 1} "
+                    "attempts", attempts=task.retries + 1, last=exc)
+            es.context.record_error(exc, task)
+            complete_execution(es, task, failed=True)
+            return
+        if ret == _DONE:
+            cbs = es._pins_map.get("exec_end")   # inlined es.pins
+            if cbs:
+                for cb in cbs:
+                    cb(es, "exec_end", task)
+            complete_execution(es, task)
+        elif ret == _ASYNC:
+            # device module owns the task; it calls complete_execution
+            es.pins("exec_async", task)
+        elif ret == _AGAIN:
+            task.status = _READY
+            schedule(es, [task], distance + 1)
+        else:
+            es.context.record_error(
+                RuntimeError(f"{task} failed with {ret!r}"), task)
+            complete_execution(es, task, failed=True)
+    finally:
+        es.running_task = None
 
 
 def _snapshot_write_flows(task: Task) -> None:
@@ -208,6 +230,15 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
     """Completion: version bumps, release deps, repo holds, termdet
     (reference: __parsec_complete_execution:441)."""
     tc = task.task_class
+    tp = task.taskpool
+    if task.pool_epoch != tp.run_epoch:
+        # recovery fence (async arm): a device completer or retry path
+        # finishing a pre-restart task must neither release successors
+        # into the rebuilt dep structures nor decrement the re-counted
+        # termdet — the restart owns every count of the new generation
+        task.status = _COMPLETE
+        es.pins("task_discard", task)
+        return
     if not failed:
         try:
             for flow in tc._write_flows:
@@ -232,7 +263,6 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
         for cb in cbs:
             cb(es, "complete_exec", task)
     es.nb_tasks_done += 1
-    tp = task.taskpool
     tp.termdet.taskpool_addto_nb_tasks(tp, -1)
 
 

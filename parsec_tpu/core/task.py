@@ -345,7 +345,11 @@ class TaskClass:
     def rank_of(self, locals_: Dict[str, int]) -> int:
         if self.affinity is None:
             return 0
-        return self.affinity(locals_).rank
+        # owner_of, not rank_of: a dead rank's tasks place on the
+        # survivor that adopted its partition of the affinity
+        # collection (identity outside a recovery; collection.py)
+        ref = self.affinity(locals_)
+        return ref.dc.owner_of(*ref.indices)
 
     def __repr__(self):
         return f"<TaskClass {self.name}>"
@@ -372,7 +376,8 @@ class Task:
     __slots__ = ("task_class", "taskpool", "locals", "key", "priority",
                  "status", "data", "input_sources", "pinned_flows",
                  "chore_mask", "seq", "device", "prof", "dtd",
-                 "ready_at", "mtr_t0", "retries", "retry_snap")
+                 "ready_at", "mtr_t0", "retries", "retry_snap",
+                 "pool_epoch")
 
     def __init__(self, task_class: TaskClass, taskpool, locals_: Dict[str, int]):
         self.task_class = task_class
@@ -413,6 +418,11 @@ class Task:
         #: _maybe_retry; active only when task_retry_max > 0)
         self.retries = 0
         self.retry_snap = None
+        #: the pool's recovery generation at construction: a restart
+        #: bumps Taskpool.run_epoch, and every stale-generation task is
+        #: discarded WITHOUT touching the re-counted termdet (the
+        #: recovery fence; core/scheduling.py)
+        self.pool_epoch = getattr(taskpool, "run_epoch", 0)
 
     def __repr__(self):
         args = ",".join(f"{k}={v}" for k, v in self.locals.items())

@@ -119,6 +119,27 @@ class Taskpool:
         #: layer) — peer-death containment fails exactly the pools whose
         #: dataflow touches the dead rank (RemoteDepEngine._on_peer_dead)
         self.peer_ranks: set = set()
+        #: recovery generation (core/recovery.py): bumped when a peer
+        #: death restarts this pool on the survivors.  Tasks stamp it at
+        #: construction (Task.pool_epoch); stale-generation tasks and
+        #: counter decrements are fenced at task_progress /
+        #: complete_execution, and cross-rank activations carry it so a
+        #: survivor mid-restart parks frames from an already-recovered
+        #: peer instead of losing them
+        self.run_epoch = 0
+        #: recovery spec: the collections this pool's dataflow reads and
+        #: writes (builders set it; core/recovery.py snapshots/restores
+        #: them) and, for insert-driven pools, a replay callable that
+        #: re-inserts the lost work.  Empty/None = not recoverable —
+        #: peer death keeps PR 5's containment behavior
+        self.recovery_collections: list = []
+        self.recovery_replay: Optional[Callable] = None
+        #: GLOBALLY done: set once a distributed run passes global
+        #: quiescence after this pool completed (Context.wait).  A pool
+        #: that completed only LOCALLY stays restartable — another
+        #: survivor may still need its re-executed partition; a retired
+        #: one is never resurrected by recovery
+        self.retired = False
 
     # -- construction ------------------------------------------------------
     def add_task_class(self, tc: TaskClass) -> TaskClass:
@@ -190,6 +211,20 @@ class Taskpool:
             self.state = TaskpoolState.DONE
             self._done_event.set()
 
+    def recovery_reset(self) -> None:
+        """Drop every in-flight dependency/repo structure so the pool
+        can re-enumerate from restored collection state (called by the
+        RecoveryCoordinator AFTER the run_epoch bump fenced stale tasks
+        and the termdet counters were rewound).  Subclasses with extra
+        runtime state (DTD lanes/windows) extend this."""
+        self.deps_table = ConcurrentHashTable()
+        self._native_deps = _native_dep_table()
+        for tc in self.task_classes.values():
+            tc.repo = DataRepo(nb_flows=len(tc.flows), name=tc.name)
+        self.reshape.clear()
+        self.dirty_data.clear()
+        self.peer_ranks = set()
+
     def wait_local(self, timeout: Optional[float] = None) -> bool:
         return self._done_event.wait(timeout)
 
@@ -219,7 +254,11 @@ class ParameterizedTaskpool(Taskpool):
             # countdown probe entirely (class-level partition, task.py)
             all_ready = not tc._ft_inputs
             for locals_ in tc.iter_space(self.globals):
-                if aff is not None and aff(locals_).rank != myrank:
+                # owner-computes through the recovery translation: a
+                # dead rank's partition enumerates on its adopting
+                # survivor at re-execution (TaskClass.rank_of applies
+                # the same table on the activation-routing side)
+                if aff is not None and tc.rank_of(locals_) != myrank:
                     continue
                 nb_local += 1
                 if all_ready or tc.nb_task_inputs(locals_) == 0:
@@ -314,6 +353,10 @@ class Compound(Taskpool):
                 launched = self._idx
                 pool = self.pools[launched]
             pool.on_complete(self._sub_done)
+            # recovery must never restart a compound member once it
+            # completed: a re-fired completion would double-advance the
+            # composition's cursor
+            pool._compound_member = True
             self.context.add_taskpool(pool, start=True)
             # cancel() racing this launch saw the sub-pool CREATED and
             # skipped it; it set our flag BEFORE reading the state, so

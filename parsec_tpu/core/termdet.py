@@ -58,6 +58,22 @@ class Termdet:
         raise NotImplementedError(
             f"termdet {self.name!r} does not support cancellation")
 
+    def taskpool_reset(self, taskpool, force_terminated: bool = False):
+        """Recovery support (core/recovery.py): zero the counters and
+        rewind the state machine to NOT_READY WITHOUT firing
+        termination, so the pool can be re-enumerated and re-run after
+        a peer death.  Returns the PRE-reset TermdetState, or None when
+        the rewind was refused.  A TERMINATED pool is refused by
+        default; ``force_terminated`` rewinds it anyway — the recovery
+        plane needs that for pools that completed LOCALLY while the
+        gang still needs their re-executed partition (local completion
+        is not global completion), and uses the returned TERMINATED to
+        re-take the context's active count.  Stale decrements from
+        pre-recovery tasks are fenced by the pool's run_epoch, not by
+        the termdet."""
+        raise NotImplementedError(
+            f"termdet {self.name!r} does not support recovery reset")
+
     # message-counting hooks for distributed modules (no-ops locally;
     # reference: termdet.h:171-243)
     def outgoing_message_start(self, taskpool, dst: int) -> None:
@@ -148,6 +164,25 @@ class LocalTermdet(Termdet):
                 fire = True
         if fire:
             st["cb"]()
+
+    def taskpool_reset(self, taskpool, force_terminated: bool = False):
+        """Zero the counters and rewind to NOT_READY without firing
+        (recovery re-execution; see Termdet.taskpool_reset).  Returns
+        the pre-reset state, or None when refused: a TERMINATED pool is
+        only rewound under ``force_terminated`` (the caller then owns
+        re-arming the completion bookkeeping its termination already
+        released)."""
+        with self._lock:
+            st = self._state.get(id(taskpool))
+            if st is None:
+                return None
+            prev = st["state"]
+            if prev == TermdetState.TERMINATED and not force_terminated:
+                return None
+            taskpool.nb_tasks = 0
+            taskpool.nb_pending_actions = 0
+            st["state"] = TermdetState.NOT_READY
+            return prev
 
 
 class UserTriggerTermdet(LocalTermdet):

@@ -62,7 +62,7 @@ class TiledMatrix(DataCollection):
 
     def is_local(self, *indices) -> bool:
         return self.tile_exists(*indices) and \
-            self.rank_of(*indices) == self.myrank
+            self.owner_of(*indices) == self.myrank
 
     def from_array(self, a: np.ndarray) -> "TiledMatrix":
         """Back local tiles with views into an existing LM x LN array
@@ -138,10 +138,14 @@ class TiledMatrix(DataCollection):
         with self._lock:
             t = self._tiles.get((m, n))
             if t is None:
-                if self.rank_of(m, n) != self.myrank:
+                # owner_of, not rank_of: after a recovery re-mapping
+                # this rank legitimately serves adopted tiles of a dead
+                # rank's partition (their payloads are restored by the
+                # RecoveryCoordinator before any task reads them)
+                if self.owner_of(m, n) != self.myrank:
                     raise KeyError(
                         f"{self.name}({m},{n}) lives on rank "
-                        f"{self.rank_of(m, n)}, not {self.myrank}")
+                        f"{self.owner_of(m, n)}, not {self.myrank}")
                 t = self._make_tile(m, n)
                 self._tiles[(m, n)] = t
             return t
@@ -149,7 +153,7 @@ class TiledMatrix(DataCollection):
     def local_tiles(self) -> List[Tuple[int, int]]:
         return [(m, n) for m in range(self.mt) for n in range(self.nt)
                 if self.tile_exists(m, n)
-                and self.rank_of(m, n) == self.myrank]
+                and self.owner_of(m, n) == self.myrank]
 
     def distribute_devices(self, context_or_spaces) -> "TiledMatrix":
         """Pin local tiles block-cyclically over the process's accelerator

@@ -317,6 +317,29 @@ class DTDTaskpool(Taskpool):
         if self.nranks > 1 and context.comm is not None:
             context.comm.dtd_drain_backlog(self)
 
+    def recovery_reset(self) -> None:
+        """Recovery restart (core/recovery.py): drop every lane/window/
+        surrogate structure of the torn generation on top of the base
+        dep/repo reset.  The pool's ``recovery_replay`` then re-inserts
+        the lost task stream against restored tiles — re-created
+        ``tile_of`` wrappers resolve their home through the translated
+        owner, so a single survivor replays the whole chain locally."""
+        super().recovery_reset()
+        if not self._finished:
+            # the attach-time wait() hold was zeroed with the counters;
+            # re-take it so a wait() that has not happened yet finds
+            # its decrement balanced
+            self.termdet.taskpool_addto_runtime_actions(self, 1)
+        with self._dep_lock:
+            self._tiles.clear()
+            self._tiles_by_wire.clear()
+            self._expected.clear()
+            self._received.clear()
+            self._flush_queue.clear()
+            self._inflight = 0
+            self._drained = False
+            self._window.notify_all()
+
     def wait(self, timeout: Optional[float] = None) -> None:
         """Drain: all inserted tasks complete
         (reference: parsec_dtd_taskpool_wait, insert_function.c:691).
@@ -484,7 +507,9 @@ class DTDTaskpool(Taskpool):
             raise RuntimeError(
                 "attach the DTD pool to a context before tile_of")
         key = (id(dc), dc.data_key(*indices))
-        home = dc.rank_of(*indices)
+        # owner_of, not rank_of: after a recovery re-mapping the dead
+        # rank's tiles are home on their adopting survivor
+        home = dc.owner_of(*indices)
         with self._dep_lock:
             t = self._tiles.get(key)
             if t is None:
@@ -762,19 +787,28 @@ class DTDTaskpool(Taskpool):
     def _task_rank(self, args) -> int:
         """Execution rank of a task: AFFINITY wins (int rank or tile
         owner), else the owner of the first written tile, else the first
-        read tile, else 0 — identical on every rank by construction."""
+        read tile, else 0 — identical on every rank by construction.
+        Routed through the pool's recovery translation so re-inserted
+        work lands on the dead rank's adopter (tile home_ranks already
+        resolve through the collection's owner_of at tile_of time)."""
         first = None
+        rank = None
         for value, mode in args:
             if mode is AFFINITY:
-                if isinstance(value, (int, np.integer)):
-                    return int(value)
-                return self._as_tile(value).home_rank
-        for value, mode in args:
-            if mode in (OUTPUT, INOUT):
-                return self._as_tile(value).home_rank
-            if first is None and mode is INPUT:
-                first = self._as_tile(value)
-        return first.home_rank if first is not None else 0
+                rank = int(value) if isinstance(value, (int, np.integer)) \
+                    else self._as_tile(value).home_rank
+                break
+        if rank is None:
+            for value, mode in args:
+                if mode in (OUTPUT, INOUT):
+                    rank = self._as_tile(value).home_rank
+                    break
+                if first is None and mode is INPUT:
+                    first = self._as_tile(value)
+        if rank is None:
+            rank = first.home_rank if first is not None else 0
+        t = getattr(self, "rank_translation", None)
+        return t.get(rank, rank) if t else rank
 
     def _conflict_lanes(self, tile: DTDTile,
                         rid: Any) -> List[Tuple[Any, _Lane]]:
@@ -1069,7 +1103,8 @@ class DTDTaskpool(Taskpool):
                         return
                     self._dtd_payload(msg, arr)
                 finally:
-                    comm.dtd_ref_done()
+                    comm.dtd_ref_done((msg.get("tp"),
+                                       msg.get("pe", 0)))
 
             comm.ce.get(msg["from"], msg["ref"], on_data)
             return

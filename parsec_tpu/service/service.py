@@ -109,8 +109,18 @@ class JobService:
         #: ranks); the set is surfaced through stats()/degraded for
         #: operators and load balancers
         self._degraded_ranks: set = set()
+        #: ranks a recovery is currently re-executing around (the
+        #: degraded -> recovering -> healthy transition the stats
+        #: surface and tools/job_client.py expose); guarded-by: _lock
+        self._recovering_ranks: set = set()
         self.gauges = JobGauges(self)
         self.gauges.install(context)
+        if getattr(context, "recovery", None) is not None:
+            # the recovery plane reports start/done/rejoin transitions
+            # so degraded-mode bookkeeping UN-degrades (pre-recovery
+            # these sets were set-only — a healed service looked sick
+            # forever)
+            context.recovery.attach_service(self)
         # the always-on metrics registry (prof/metrics.py) folds the
         # service view into its scrape: job queue depths, degraded
         # flag, per-job task counters over the JobGauges window, and
@@ -368,13 +378,39 @@ class JobService:
 
     @property
     def degraded(self) -> bool:
-        """True once any peer rank died under the service (containment
-        kept unaffected jobs running; capacity is reduced)."""
+        """True while any peer rank is dead under the service
+        (containment kept unaffected jobs running; capacity is
+        reduced).  CLEARED when recovery completes or the rank
+        rejoins — degraded is a state, not a scar."""
         return bool(self._degraded_ranks)
 
     def degraded_ranks(self) -> List[int]:
         with self._lock:
             return sorted(self._degraded_ranks)
+
+    def note_recovery(self, event: str, rank: int) -> None:
+        """Recovery-plane transitions (core/recovery.py notifier):
+        ``start`` marks the rank degraded+recovering, ``done`` heals it
+        (re-mapped partition serving again), ``failed`` leaves it
+        degraded, ``rejoin`` heals it fully (the rank itself is back).
+        Running jobs that were stamped with the failed rank but
+        survived through recovery get the stamp cleared; terminally
+        FAILED jobs keep theirs — it is their diagnosis."""
+        with self._lock:
+            if event == "start":
+                self._degraded_ranks.add(rank)
+                self._recovering_ranks.add(rank)
+                jobs = []
+            elif event in ("done", "rejoin"):
+                self._recovering_ranks.discard(rank)
+                self._degraded_ranks.discard(rank)
+                jobs = [j for j in self._jobs.values()
+                        if j.failed_rank == rank and not j.done]
+            else:   # failed: recovery gave up; the degradation stands
+                self._recovering_ranks.discard(rank)
+                jobs = []
+        for job in jobs:
+            job.failed_rank = None
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -386,6 +422,8 @@ class JobService:
                 "max_pending": self._max_pending,
                 "degraded": bool(self._degraded_ranks),
                 "degraded_ranks": sorted(self._degraded_ranks),
+                "recovering": bool(self._recovering_ranks),
+                "recovering_ranks": sorted(self._recovering_ranks),
             }
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -428,6 +466,8 @@ class JobService:
         self.gauges.uninstall(self.context)
         if getattr(self.context, "metrics", None) is not None:
             self.context.metrics.detach_service(self)
+        if getattr(self.context, "recovery", None) is not None:
+            self.context.recovery.detach_service(self)
         if self._own_context:
             self.context.fini()
 

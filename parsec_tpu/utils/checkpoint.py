@@ -37,11 +37,35 @@ def _rank_path(context, path: str) -> str:
     return f"{path}.r{rank}.npz"
 
 
+def _check_degraded(context, op: str) -> list:
+    """Fail FAST under a degraded comm topology: the quiesce/barrier
+    discipline below assumes every rank alive, and a checkpoint
+    attempted with a dead peer used to wedge in the collective barrier
+    until its timeout.  Dead peers a recovery EXCUSED (their partition
+    re-mapped onto survivors) are fine — the barrier itself narrowed to
+    the survivor set — but their absence is recorded in the shard
+    metadata as an explicit marker.  Returns the excused ranks."""
+    comm = getattr(context, "comm", None)
+    if comm is None:
+        return []
+    ce = comm.ce
+    excused = set(getattr(ce, "excused_peers", ()) or ())
+    fatal = set(ce.dead_peers) - excused
+    if fatal:
+        from parsec_tpu.core.errors import CheckpointDegradedError
+        raise CheckpointDegradedError(
+            f"rank {context.rank}: {op} with dead peer(s) "
+            f"{sorted(fatal)} — the collective barrier cannot complete "
+            "(recover or rebuild the gang first)", ranks=fatal)
+    return sorted(excused & set(ce.dead_peers))
+
+
 def checkpoint(context, collections: Iterable, path: str) -> str:
     """Snapshot every local tile of ``collections`` (host-authoritative:
     device copies are flushed home first).  Returns the rank-local file.
     Call after ``context.wait()`` — a checkpoint of a running DAG is a
     torn checkpoint."""
+    excused = _check_degraded(context, "checkpoint")
     # drain device pipelines and push authoritative copies home
     for d in context.device_registry.accelerators:
         dsync = getattr(d, "sync", None)
@@ -59,8 +83,12 @@ def checkpoint(context, collections: Iterable, path: str) -> str:
             arrays[key] = np.asarray(copy.payload)
     out = _rank_path(context, path)
     os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    # excused dead ranks write no shard of their own; their adopted
+    # tiles land in THIS shard (local_tiles routes through owner_of)
+    # and the marker makes the absence explicit at restore time
     np.savez(out, __meta__=np.array([meta["format"], meta["rank"],
-                                     meta["nranks"]]), **arrays)
+                                     meta["nranks"]]),
+             __excused__=np.array(excused, dtype=np.int64), **arrays)
     if context.comm is not None:
         context.comm.ce.barrier()    # the snapshot is collective
     debug_verbose(3, "checkpoint: %d tiles -> %s", len(arrays), out)
@@ -71,27 +99,60 @@ def restore(context, collections: Iterable, path: str) -> int:
     """Load a snapshot back into ``collections`` (same shapes and
     distribution as at checkpoint time).  Host copies become the newest
     authoritative version; stale device copies invalidate.  Returns the
-    number of tiles restored."""
+    number of tiles restored.
+
+    Degraded topologies: under a recovery re-mapping, ``local_tiles``
+    includes tiles ADOPTED from an excused dead rank — those were
+    written to the DEAD rank's shard of a pre-death checkpoint, so
+    missing keys fall back to the shard of the tile's original owner
+    (``rank_of``, the pure distribution) before failing.  This is the
+    checkpoint-as-lineage-base story: a survivor restores the whole
+    re-mapped partition from the collective snapshot."""
+    _check_degraded(context, "restore")
     src = _rank_path(context, path)
-    with np.load(src, allow_pickle=False) as zf:
-        meta = zf["__meta__"]
-        if int(meta[0]) != FORMAT_VERSION:
-            raise ValueError(f"{src}: unsupported checkpoint format "
-                             f"{int(meta[0])}")
-        if int(meta[2]) != context.nranks:
-            raise ValueError(
-                f"{src}: checkpoint was taken on {int(meta[2])} ranks, "
-                f"restoring on {context.nranks} (elastic restore is not "
-                "supported — match the layout)")
-        n = 0
-        for dc in collections:
-            for idx in dc.local_tiles():
-                key = ":".join([dc.name] + [str(i) for i in idx])
-                if key not in zf:
-                    raise KeyError(f"{src}: missing tile {key}")
-                datum = dc.data_of(*idx)
-                datum.overwrite_host(zf[key])
-                n += 1
+    sibling: dict = {}   # original-owner shards opened on demand
+
+    def _sibling(rank: int):
+        zf = sibling.get(rank)
+        if zf is None:
+            zf = sibling[rank] = np.load(
+                f"{path}.r{rank}.npz", allow_pickle=False)
+        return zf
+
+    try:
+        with np.load(src, allow_pickle=False) as zf:
+            meta = zf["__meta__"]
+            if int(meta[0]) != FORMAT_VERSION:
+                raise ValueError(f"{src}: unsupported checkpoint format "
+                                 f"{int(meta[0])}")
+            if int(meta[2]) != context.nranks:
+                raise ValueError(
+                    f"{src}: checkpoint was taken on {int(meta[2])} "
+                    f"ranks, restoring on {context.nranks} (elastic "
+                    "restore is not supported — match the layout)")
+            n = 0
+            for dc in collections:
+                for idx in dc.local_tiles():
+                    key = ":".join([dc.name] + [str(i) for i in idx])
+                    source = zf
+                    if key not in zf:
+                        owner = dc.rank_of(*idx)
+                        if owner != context.rank:
+                            try:
+                                source = _sibling(owner)
+                            except OSError:
+                                raise KeyError(
+                                    f"{src}: missing tile {key} (and "
+                                    f"no shard of original owner rank "
+                                    f"{owner})")
+                        if key not in source:
+                            raise KeyError(f"{src}: missing tile {key}")
+                    datum = dc.data_of(*idx)
+                    datum.overwrite_host(source[key])
+                    n += 1
+    finally:
+        for zf in sibling.values():
+            zf.close()
     if context.comm is not None:
         context.comm.ce.barrier()
     debug_verbose(3, "restore: %d tiles <- %s", n, src)

@@ -828,7 +828,7 @@ def test_shm_frame_directives_hook_send_path():
         ce0, ce1 = ShmCE(0, 2, base), ShmCE(1, 2, base)
         got = []
         dropped = []
-        ce0.on_frame_fault = lambda kind, tag, p: dropped.append(
+        ce0.on_frame_fault = lambda kind, tag, p, dst=-1: dropped.append(
             (kind, tag))
         ce1.tag_register(1, lambda src, p: got.append(("act", p)))
         ce1.tag_register(6, lambda src, p: got.append(("dtd", p)))

@@ -1,0 +1,629 @@
+"""Recovery-plane tests (ISSUE 10: contain -> RECOVER -> rejoin).
+
+Unit layers: the lineage planner's minimal re-execution set on
+hand-built DAGs, the per-collection rank translation, the termdet
+rewind, the run_epoch task fence, incarnation-epoch frame fencing, the
+degraded-checkpoint fail-fast, and the service's degraded -> recovering
+-> healthy bookkeeping.
+
+End to end: 2-rank kill_rank plans (PTG potrf and DTD chain) that END
+IN COMPLETED, NUMERICALLY VALIDATED jobs on the survivor; recovery
+disabled reproducing PR 5's containment; a killed-then-restarted rank
+rejoining over TAG_REJOIN and serving its partition again; and the
+slow 3-rank mid-run-kill acceptance run with the makespan bound.
+"""
+
+import multiprocessing as mp
+import os
+import sys
+import time
+import traceback
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.errors import (CheckpointDegradedError,
+                                    PeerFailedError)
+from parsec_tpu.core.recovery import (LineageRecord, RecoveryUnsupported,
+                                      lineage_plan)
+from parsec_tpu.utils.mca import params
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _run_distributed_with_env(fn, nranks, env, timeout=120,
+                              tolerate_ranks=()):
+    from parsec_tpu.comm.launch import run_distributed
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        return run_distributed(fn, nranks, timeout=timeout,
+                               tolerate_ranks=tolerate_ranks)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# lineage planner: minimal re-execution set on hand-built DAGs
+# ---------------------------------------------------------------------------
+
+def test_lineage_plan_minimal_set():
+    """Diamond DAG over tiles a/b/c/d; only d's final version is lost
+    and b's intermediate survives -> re-execute exactly the producers
+    on the lost path, not the whole log."""
+    log = [
+        LineageRecord("T1", reads=[("a", 0)], writes=[("b", 1)]),
+        LineageRecord("T2", reads=[("a", 0)], writes=[("c", 1)]),
+        LineageRecord("T3", reads=[("b", 1), ("c", 1)],
+                      writes=[("d", 1)]),
+        LineageRecord("T4", reads=[("d", 1)], writes=[("d", 2)]),
+    ]
+    surviving = {"a": 0, "b": 1, "c": 1}       # d died with its rank
+    tasks, base = lineage_plan(log, surviving, {"d": 2})
+    assert tasks == ["T3", "T4"]               # T1/T2 outputs survive
+    assert base == {"b": 1, "c": 1}
+
+
+def test_lineage_plan_walks_back_to_source():
+    """Nothing of the lost chain survives: the walk reaches the version-0
+    source (the registration snapshot / init_fn base)."""
+    log = [
+        LineageRecord("P0", reads=[("x", 0)], writes=[("x", 1)]),
+        LineageRecord("P1", reads=[("x", 1)], writes=[("x", 2)]),
+    ]
+    tasks, base = lineage_plan(log, {"x": 0}, {"x": 2})
+    assert tasks == ["P0", "P1"]
+    assert base == {"x": 0}
+
+
+def test_lineage_plan_broken_lineage_raises():
+    with pytest.raises(RecoveryUnsupported):
+        lineage_plan([], {}, {"ghost": 3})
+
+
+# ---------------------------------------------------------------------------
+# partition re-mapping: per-collection rank translation
+# ---------------------------------------------------------------------------
+
+def test_rank_translation_adopts_partition():
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=16, ln=16, nodes=2, myrank=0,
+                          name="A")
+    mine = set(A.local_tiles())
+    assert all(A.rank_of(m, n) == 0 for m, n in mine)
+    A.set_rank_translation({1: 0})
+    try:
+        # rank_of stays the pure distribution; owner_of routes around
+        assert any(A.rank_of(m, n) == 1
+                   for m in range(A.mt) for n in range(A.nt))
+        assert all(A.owner_of(m, n) == 0
+                   for m in range(A.mt) for n in range(A.nt))
+        adopted = set(A.local_tiles()) - mine
+        assert adopted, "dead rank's tiles must appear local"
+        m, n = sorted(adopted)[0]
+        assert A.data_of(m, n) is not None    # materializes, no raise
+    finally:
+        A.set_rank_translation(None)
+    assert set(A.local_tiles()) == mine
+
+
+def test_rank_translation_is_per_collection():
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=8, ln=8, nodes=2, myrank=0,
+                          name="A")
+    B = TwoDimBlockCyclic(mb=4, nb=4, lm=8, ln=8, nodes=2, myrank=0,
+                          name="B")
+    A.set_rank_translation({1: 0})
+    try:
+        assert len(A.local_tiles()) == 4
+        assert len(B.local_tiles()) == 2      # B untouched
+    finally:
+        A.set_rank_translation(None)
+
+
+def test_taskclass_rank_of_translates():
+    from parsec_tpu.core.task import TaskClass
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    A = TwoDimBlockCyclic(mb=4, nb=4, lm=16, ln=16, nodes=2, myrank=0,
+                          name="A")
+    tc = TaskClass("T", params=[("m", lambda g, l: range(4))],
+                   affinity=lambda loc, A=A: A(0, loc["m"]))
+    ranks = {m: tc.rank_of({"m": m}) for m in range(4)}
+    assert 1 in ranks.values()
+    A.set_rank_translation({1: 0})
+    try:
+        assert all(tc.rank_of({"m": m}) == 0 for m in range(4))
+    finally:
+        A.set_rank_translation(None)
+
+
+# ---------------------------------------------------------------------------
+# termdet rewind + run_epoch fence
+# ---------------------------------------------------------------------------
+
+def test_termdet_reset_rewinds_without_firing():
+    from parsec_tpu.core.taskpool import Taskpool
+    from parsec_tpu.core.termdet import LocalTermdet
+    td = LocalTermdet()
+    tp = Taskpool("t")
+    fired = []
+    td.monitor(tp, lambda: fired.append(1))
+    td.taskpool_addto_runtime_actions(tp, 1)
+    td.taskpool_ready(tp)
+    td.taskpool_addto_nb_tasks(tp, 5)
+    from parsec_tpu.core.termdet import TermdetState
+    assert td.taskpool_reset(tp) == TermdetState.BUSY
+    assert tp.nb_tasks == 0 and tp.nb_pending_actions == 0
+    assert not fired
+    # the rewound pool re-runs the attach->ready lifecycle and
+    # terminates on the NEW generation's counts only
+    td.taskpool_addto_runtime_actions(tp, 1)
+    td.taskpool_addto_nb_tasks(tp, 2)
+    td.taskpool_ready(tp)
+    td.taskpool_addto_runtime_actions(tp, -1)
+    td.taskpool_addto_nb_tasks(tp, -2)
+    assert fired == [1]
+    # a TERMINATED pool refuses the plain rewind (completed
+    # concurrently)...
+    assert td.taskpool_reset(tp) is None
+    # ...but force_terminated rewinds it — local completion is not
+    # global completion, and the caller re-arms the released
+    # bookkeeping on the returned TERMINATED
+    assert td.taskpool_reset(tp, force_terminated=True) \
+        == TermdetState.TERMINATED
+
+
+def test_run_epoch_fence_discards_stale_tasks():
+    """A task scheduled before a restart must neither execute nor touch
+    the re-counted termdet when it surfaces after the epoch bump."""
+    from parsec_tpu.core import scheduling
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.task import Task, TaskClass
+    from parsec_tpu.core.taskpool import Taskpool
+    ctx = Context(nb_cores=1)
+    try:
+        tp = Taskpool("fence")
+        ran = []
+        tc = TaskClass("X", body=lambda: ran.append(1))
+        tp.add_task_class(tc)
+        ctx.add_taskpool(tp)
+        stale = Task(tc, tp, {})
+        tp.run_epoch += 1                  # restart happened
+        before = tp.nb_tasks
+        scheduling.task_progress(ctx.streams[0], stale)
+        assert not ran
+        assert tp.nb_tasks == before       # no decrement
+        scheduling.complete_execution(ctx.streams[0], stale)
+        assert tp.nb_tasks == before
+        tp.cancel()
+        ctx.wait(timeout=10)
+    finally:
+        ctx.fini()
+
+
+# ---------------------------------------------------------------------------
+# incarnation-epoch frame fencing + Safra reconcile
+# ---------------------------------------------------------------------------
+
+def test_epoch_fence_drops_stale_incarnation_frames():
+    from parsec_tpu.comm.engine import SocketCE
+    from parsec_tpu.comm.launch import _probe_port_base
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    ce = SocketCE(0, 2, _probe_port_base(2))
+    ctx = Context(nb_cores=1, rank=0, nranks=2)
+    rde = RemoteDepEngine(ce, ctx)
+    try:
+        with rde._term_lock:
+            rde._sent_to[1] = 3
+            rde._recv_from[1] = 2
+            rde._app_sent += 3
+            rde._app_recv += 2
+        rde.recovery_reconcile(1)
+        assert rde._balance() == 0         # dead contribution removed
+        # a pre-death straggler (no _ep) is fenced WITHOUT a credit
+        rde._activate_cb(1, {"tp": 999, "_fid": (1, 7)})
+        assert rde._balance() == 0
+        with rde._dlock:
+            assert not rde._delayed        # not even parked
+        # the rejoined incarnation (epoch 1) passes the fence
+        rde.note_peer_epoch(1, 1)
+        rde._activate_cb(1, {"tp": 999, "_ep": 1, "_fid": (1, 1 << 48)})
+        with rde._term_lock:
+            assert rde._app_recv == 1      # credited
+        with rde._dlock:
+            assert rde._delayed            # parked for the unknown pool
+            rde._delayed.clear()           # stop the retry timer chain
+    finally:
+        ce._stop = True
+        rde.fini()
+        ctx.fini()
+
+
+def test_pool_epoch_gate_drops_and_parks_activations():
+    from parsec_tpu.comm.engine import SocketCE
+    from parsec_tpu.comm.launch import _probe_port_base
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.taskpool import Taskpool
+    ce = SocketCE(0, 2, _probe_port_base(2))
+    ctx = Context(nb_cores=1, rank=0, nranks=2)
+    rde = RemoteDepEngine(ce, ctx)
+    try:
+        tp = Taskpool("gate")
+        ctx.add_taskpool(tp, start=True)
+        tp.run_epoch = 2
+        base = {"tp": tp.taskpool_id, "root": 1, "ranks": [0],
+                "deliveries": {}, "data": None}
+        rde._try_activation(1, {**base, "pe": 1})   # torn generation
+        with rde._dlock:
+            assert not rde._delayed                 # dropped outright
+        rde._try_activation(1, {**base, "pe": 3})   # future generation
+        with rde._dlock:
+            assert len(rde._delayed) == 1           # parked, not lost
+        # once the local restart catches up, the parked frame delivers
+        tp.run_epoch = 3
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            rde.retry_delayed()
+            with rde._dlock:
+                if not rde._delayed:
+                    break
+            time.sleep(0.02)
+        with rde._dlock:
+            assert not rde._delayed
+        tp.cancel()
+    finally:
+        ce._stop = True
+        rde.fini()
+        ctx.fini()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint under a degraded context (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_degraded_fails_fast(tmp_path):
+    import types
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.utils.checkpoint import checkpoint, restore
+    ctx = Context(nb_cores=1)
+    try:
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=8, ln=8, name="A")
+        A.data_of(0, 0)
+        path = str(tmp_path / "ck")
+        # healthy single-rank checkpoint still works
+        checkpoint(ctx, [A], path)
+        # a dead, UNEXCUSED peer fails fast with the structured error
+        # instead of wedging in the collective barrier
+        ctx.comm = types.SimpleNamespace(
+            ce=types.SimpleNamespace(dead_peers={1}, excused_peers=set()))
+        with pytest.raises(CheckpointDegradedError) as ei:
+            checkpoint(ctx, [A], str(tmp_path / "ck2"))
+        assert ei.value.ranks == [1]
+        with pytest.raises(CheckpointDegradedError):
+            restore(ctx, [A], path)
+        # an EXCUSED death proceeds (the barrier narrowed to survivors;
+        # nranks=1 here so no wire traffic) and records the marker
+        ctx.comm = None
+        restore(ctx, [A], path)
+    finally:
+        ctx.comm = None
+        ctx.fini()
+
+
+def test_checkpoint_records_excused_marker(tmp_path):
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.utils.checkpoint import checkpoint
+    import types
+    ctx = Context(nb_cores=1)
+    try:
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=8, ln=8, name="A")
+        A.data_of(0, 0)
+
+        class _BarrierCE:
+            dead_peers = {1}
+            excused_peers = {1}
+
+            def barrier(self, timeout=60.0):
+                pass
+        ctx.comm = types.SimpleNamespace(ce=_BarrierCE())
+        out = checkpoint(ctx, [A], str(tmp_path / "ck"))
+        with np.load(out) as zf:
+            assert list(zf["__excused__"]) == [1]
+    finally:
+        ctx.comm = None
+        ctx.fini()
+
+
+# ---------------------------------------------------------------------------
+# service bookkeeping: degraded -> recovering -> healthy (satellite)
+# ---------------------------------------------------------------------------
+
+def test_service_recovery_state_transitions():
+    from parsec_tpu.service.service import JobService
+    svc = JobService(max_active=1, nb_cores=1)
+    try:
+        assert svc.stats()["recovering"] is False
+        svc.note_recovery("start", 1)
+        st = svc.stats()
+        assert st["degraded"] and st["degraded_ranks"] == [1]
+        assert st["recovering"] and st["recovering_ranks"] == [1]
+        svc.note_recovery("done", 1)
+        st = svc.stats()
+        assert not st["degraded"] and not st["recovering"]
+        # a failed recovery leaves the degradation standing
+        svc.note_recovery("start", 2)
+        svc.note_recovery("failed", 2)
+        st = svc.stats()
+        assert st["degraded_ranks"] == [2] and not st["recovering"]
+        # ...until the rank rejoins
+        svc.note_recovery("rejoin", 2)
+        assert svc.stats()["degraded"] is False
+    finally:
+        svc.shutdown(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# end to end: kill -> recover -> COMPLETED with correct numerics
+# ---------------------------------------------------------------------------
+
+def test_kill_close_recovers_potrf():
+    """The acceptance shape: a 2-rank potrf whose peer hard-dies
+    mid-run COMPLETES on the survivor with validated numbers (adopted
+    tiles included — local_tiles routes through the translation)."""
+    import chaos
+    res = _run_distributed_with_env(
+        chaos.potrf_recover_workload, 2,
+        {"PARSEC_MCA_FAULT_PLAN":
+         "seed=11;kill_rank=1@t+1.0s,mode=close;"
+         "delay_frame=tag:ACT,p=1,ms=150",
+         "PARSEC_MCA_RECOVERY_ENABLE": "1",
+         "PARSEC_CHAOS_WAIT_S": "45"},
+        timeout=90, tolerate_ranks=(1,))
+    assert res[0] == "ok" and res[1] is None   # victim actually died
+
+
+def test_kill_close_recovers_dtd_chain():
+    """DTD lineage replay: the insert stream re-runs on the survivor
+    against the snapshot-restored tile — EXACT final value."""
+    import chaos
+    res = _run_distributed_with_env(
+        chaos.dtd_chain_recover_workload, 2,
+        {"PARSEC_MCA_FAULT_PLAN":
+         "seed=7;kill_rank=1@t+1.2s,mode=close;"
+         "delay_frame=tag:DTD,p=1,ms=60",
+         "PARSEC_MCA_RECOVERY_ENABLE": "1",
+         "PARSEC_CHAOS_WAIT_S": "30"},
+        timeout=90, tolerate_ranks=(1,))
+    assert res[0] == "ok" and res[1] is None
+
+
+def test_kill_rank_zero_recovers_on_new_root():
+    """Killing rank 0 exercises the generalized ring/barrier root: the
+    surviving rank 1 becomes coordinator, initiator, AND barrier root,
+    adopts rank 0's partition, and completes with validated numbers."""
+    import chaos
+    res = _run_distributed_with_env(
+        chaos.potrf_recover_workload, 2,
+        {"PARSEC_MCA_FAULT_PLAN":
+         "seed=13;kill_rank=0@t+1.0s,mode=close;"
+         "delay_frame=tag:ACT,p=1,ms=150",
+         "PARSEC_MCA_RECOVERY_ENABLE": "1",
+         "PARSEC_CHAOS_WAIT_S": "45"},
+        timeout=90, tolerate_ranks=(0,))
+    assert res[1] == "ok" and res[0] is None
+
+
+def test_recovery_disabled_reproduces_containment():
+    """PARSEC_MCA_RECOVERY_ENABLE=0 (the default): the same kill plan
+    fails the pool with the PR 5 structured PeerFailedError — recovery
+    never engages implicitly."""
+    import chaos
+    with pytest.raises(RuntimeError) as ei:
+        _run_distributed_with_env(
+            chaos.potrf_recover_workload, 2,
+            {"PARSEC_MCA_FAULT_PLAN":
+             "seed=11;kill_rank=1@t+1.0s,mode=close;"
+             "delay_frame=tag:ACT,p=1,ms=150",
+             "PARSEC_MCA_RECOVERY_ENABLE": "0",
+             "PARSEC_CHAOS_WAIT_S": "30"},
+            timeout=90)
+    assert "PeerFailedError" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# elastic rejoin: killed -> restarted -> serving its partition again
+# ---------------------------------------------------------------------------
+
+def _rejoin_potrf_phase(ctx, rank, nranks, name):
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    n, mb = 64, 16
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, nodes=nranks,
+                          myrank=rank, name=name)
+    for m, nn in A.local_tiles():
+        np.asarray(A.data_of(m, nn).copy_on(0).payload)[:] = \
+            spd[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
+    ctx.add_taskpool(potrf_taskpool(A, device="cpu"))
+    ctx.wait(timeout=60)
+    Lref = np.linalg.cholesky(spd.astype(np.float64))
+    for m, nn in A.local_tiles():
+        if nn > m:
+            continue
+        got = np.asarray(A.data_of(m, nn).pull_to_host().payload,
+                         dtype=np.float64)
+        ref = Lref[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
+        if m == nn:
+            got, ref = np.tril(got), np.tril(ref)
+        np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+
+
+def _rejoin_worker(rank, nranks, port_base, outq):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        from parsec_tpu.comm.engine import make_ce
+        from parsec_tpu.comm.remote_dep import RemoteDepEngine
+        from parsec_tpu.core.context import Context
+
+        ce = make_ce(rank, nranks, port_base)
+        ctx = Context(nb_cores=2, rank=rank, nranks=nranks)
+        rde = RemoteDepEngine(ce, ctx)
+        ce.barrier()
+        # phase 1: the gang works; rank 1 then dies and restarts
+        _rejoin_potrf_phase(ctx, rank, nranks, "A")
+        ce.barrier()
+        if rank == 1:
+            rde.fini()                    # the rank goes down
+            time.sleep(1.0)
+            params.set("comm_epoch", 1)   # restarted incarnation
+            ce = make_ce(rank, nranks, port_base)
+            rde = RemoteDepEngine(ce, ctx)
+            table = ctx.recovery.rejoin(timeout=30.0)
+            assert isinstance(table, dict)
+        else:
+            deadline = time.monotonic() + 25
+            while 1 not in ce.dead_peers:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("rank 1 death never detected")
+                time.sleep(0.02)
+            while 1 in ce.dead_peers:     # cleared by peer_rejoined
+                if time.monotonic() > deadline + 35:
+                    raise RuntimeError("rank 1 never rejoined")
+                time.sleep(0.02)
+            assert 1 not in ce.excused_peers
+            assert ctx.recovery.rejoins == 1
+        ce.barrier(timeout=30)
+        # phase 2: the REJOINED rank serves its partition again
+        _rejoin_potrf_phase(ctx, rank, nranks, "B")
+        ce.barrier(timeout=30)
+        ce._stop = True
+        outq.put((rank, None, "ok"))
+        ctx.fini()
+        rde.fini()
+    except Exception:
+        outq.put((rank, traceback.format_exc(), None))
+
+
+def test_killed_rank_rejoins_and_serves():
+    from parsec_tpu.comm.launch import _probe_port_base
+    saved = os.environ.get("PARSEC_MCA_RECOVERY_ENABLE")
+    os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
+    try:
+        base = _probe_port_base(2)
+        mpctx = mp.get_context("spawn")
+        outq = mpctx.Queue()
+        procs = [mpctx.Process(target=_rejoin_worker,
+                               args=(r, 2, base, outq), daemon=True)
+                 for r in range(2)]
+        for p in procs:
+            p.start()
+        results = {}
+        try:
+            for _ in range(2):
+                rank, err, res = outq.get(timeout=150)
+                assert err is None, f"rank {rank} failed:\n{err}"
+                results[rank] = res
+        finally:
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+        assert results == {0: "ok", 1: "ok"}
+    finally:
+        if saved is None:
+            os.environ.pop("PARSEC_MCA_RECOVERY_ENABLE", None)
+        else:
+            os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = saved
+
+
+# ---------------------------------------------------------------------------
+# observability: metrics families + flight-recorder hook
+# ---------------------------------------------------------------------------
+
+def test_recovery_metrics_families_scrape():
+    from parsec_tpu.core.context import Context
+    params.set("recovery_enable", 1)
+    try:
+        ctx = Context(nb_cores=1)
+        try:
+            assert ctx.recovery is not None
+            names = {s["n"] for s in ctx.metrics.samples()}
+            assert "parsec_recoveries_total" in names
+            assert "parsec_tasks_reexecuted_total" in names
+            assert "parsec_rank_rejoins_total" in names
+            assert "parsec_recovery_duration_seconds" in names
+            stages = {s["l"].get("stage")
+                      for s in ctx.metrics.samples()
+                      if s["n"] == "parsec_recoveries_total"}
+            assert {"started", "completed", "failed"} <= stages
+        finally:
+            ctx.fini()
+    finally:
+        params.set("recovery_enable", 0)
+
+
+# ---------------------------------------------------------------------------
+# acceptance (slow): 3-rank mid-run kill, multi-survivor re-execution
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_three_rank_potrf_survives_midrun_kill():
+    """Two survivors recover a third's mid-run death TOGETHER: the dead
+    partition re-maps onto one adopter, both re-enumerate, cross-rank
+    activations of the new generation flow, numerics validate, and the
+    killed run stays within ~2x the no-fault makespan (the ISSUE
+    bound; the loose assert guards the invariant under host noise —
+    the measured ratio is recorded in BENCH.md)."""
+    import chaos
+    env = {"PARSEC_MCA_RECOVERY_ENABLE": "1",
+           "PARSEC_CHAOS_WAIT_S": "60"}
+    t0 = time.monotonic()
+    res = _run_distributed_with_env(
+        chaos.potrf_recover_workload, 3,
+        {**env, "PARSEC_MCA_FAULT_PLAN":
+         "seed=4;delay_frame=tag:ACT,p=1,ms=120"},
+        timeout=120)
+    base_s = time.monotonic() - t0
+    assert res == ["ok", "ok", "ok"]
+    t0 = time.monotonic()
+    res = _run_distributed_with_env(
+        chaos.potrf_recover_workload, 3,
+        {**env, "PARSEC_MCA_FAULT_PLAN":
+         "seed=4;kill_rank=2@t+1.0s,mode=close;"
+         "delay_frame=tag:ACT,p=1,ms=120"},
+        timeout=180, tolerate_ranks=(2,))
+    kill_s = time.monotonic() - t0
+    assert res[0] == "ok" and res[1] == "ok"
+    ratio = kill_s / max(base_s, 1e-9)
+    print(f"3-rank mid-run kill: baseline {base_s:.1f}s, "
+          f"killed {kill_s:.1f}s, ratio {ratio:.2f}x")
+    assert ratio < 3.0, (base_s, kill_s)
+
+
+@pytest.mark.slow
+def test_chaos_recover_catalog():
+    """The full recovery catalog (close/hang x evloop/shm/threads +
+    DTD + survivor exhaustion) through the chaos harness."""
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--recover", "--seeds", "8", "--timeout", "120"],
+        capture_output=True, text=True, timeout=1200,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -48,9 +48,14 @@ def _wait_s() -> float:
     return float(os.environ.get("PARSEC_CHAOS_WAIT_S", "60"))
 
 
-def potrf_workload(ctx, rank, nranks):
-    """2-rank tiled Cholesky with an internal numerical check — the
-    PTG/remote-dep path (activations, rendezvous, writebacks)."""
+def potrf_workload(ctx, rank, nranks, recover=False):
+    """Tiled Cholesky with an internal numerical check — the
+    PTG/remote-dep path (activations, rendezvous, writebacks).  With
+    ``recover`` the collection carries an init_fn re-runnable source,
+    so a kill_rank plan ends in lineage re-execution on the survivors
+    instead of a structured failure — and the survivors validate the
+    ADOPTED tiles too (local_tiles routes through the translated
+    owner)."""
     from parsec_tpu.apps.potrf import potrf_taskpool
     from parsec_tpu.data.matrix import TwoDimBlockCyclic
 
@@ -60,6 +65,9 @@ def potrf_workload(ctx, rank, nranks):
     spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
     A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, nodes=nranks,
                           myrank=rank, name="A")
+    if recover:
+        A.set_init(lambda m, nn: spd[m * mb:(m + 1) * mb,
+                                     nn * mb:(nn + 1) * mb])
     for m, nn in A.local_tiles():
         np.asarray(A.data_of(m, nn).copy_on(0).payload)[:] = \
             spd[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
@@ -78,6 +86,10 @@ def potrf_workload(ctx, rank, nranks):
             got, ref = np.tril(got), np.tril(ref)
         np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
     return "ok"
+
+
+def potrf_recover_workload(ctx, rank, nranks):
+    return potrf_workload(ctx, rank, nranks, recover=True)
 
 
 def dtd_chain_workload(ctx, rank, nranks):
@@ -105,7 +117,42 @@ def dtd_chain_workload(ctx, rank, nranks):
     return "ok"
 
 
-WORKLOADS = {"potrf": potrf_workload, "dtd": dtd_chain_workload}
+def dtd_chain_recover_workload(ctx, rank, nranks):
+    """The DTD increment chain with a recovery spec: the insertion
+    stream doubles as the ``recovery_replay`` lineage, so a killed rank
+    mid-chain re-executes the whole chain on the survivor against the
+    snapshot-restored tile — EXACT final value required."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, DTDTaskpool
+
+    steps = 40
+    V = VectorTwoDimCyclic(mb=4, lm=4, nodes=nranks, myrank=rank)
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 0.0
+    tp = DTDTaskpool("chaos-chain-r")
+
+    def insert_stream(pool, V=V, steps=steps, nranks=nranks):
+        t = pool.tile_of(V, 0)
+        for i in range(steps):
+            pool.insert_task(lambda T: T + 1.0, (t, INOUT),
+                             (i % nranks, AFFINITY))
+
+    tp.recovery_collections = [V]
+    tp.recovery_replay = insert_stream
+    ctx.add_taskpool(tp)
+    ctx.start()
+    insert_stream(tp)
+    tp.wait(timeout=_wait_s())
+    ctx.wait(timeout=_wait_s())
+    if rank == 0:
+        val = np.asarray(V.data_of(0).pull_to_host().payload)
+        np.testing.assert_allclose(val, float(steps))
+    return "ok"
+
+
+WORKLOADS = {"potrf": potrf_workload, "dtd": dtd_chain_workload,
+             "potrf-recover": potrf_recover_workload,
+             "dtd-recover": dtd_chain_recover_workload}
 
 #: (name, plan template, workload, expected outcome, extra env).
 #: {s} is the seed.  Expected outcomes:
@@ -180,10 +227,81 @@ CATALOG = [
      "seed={s};delay_recv=tag:DTD,p=0.5,ms=150;"
      "delay_recv=tag:ACT,p=0.3,ms=80",
      "dtd", "complete", {"PARSEC_MCA_COMM_TRANSPORT": "shm"}),
+    # RECOVERY legs (r12): kill_rank plans that END IN COMPLETED JOBS
+    # with correct numerics — the surviving rank re-maps the dead
+    # rank's partition onto itself, restores the lineage base, and
+    # re-executes; the killed rank's own (expected) failure is
+    # tolerated by the harness (_TOLERATE).  recovery off reproduces
+    # the kill-close/kill-hang containment entries above exactly.
+    ("kill-close-recover",
+     "seed={s};kill_rank=1@t+1.0s,mode=close;"
+     "delay_frame=tag:ACT,p=1,ms=150;delay_frame=tag:BATCH,p=1,ms=150",
+     "potrf-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "45",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    ("kill-hang-recover",
+     "seed={s};kill_rank=1@t+1.0s,mode=hang;"
+     "delay_frame=tag:ACT,p=1,ms=150;delay_frame=tag:BATCH,p=1,ms=150",
+     "potrf-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "25",
+      "PARSEC_MCA_COMM_PEER_TIMEOUT_S": "2",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    ("kill-dtd-recover",
+     "seed={s};kill_rank=1@t+1.2s,mode=close;"
+     "delay_frame=tag:DTD,p=1,ms=60",
+     "dtd-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "30",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    ("kill-close-recover-shm",
+     "seed={s};kill_rank=1@t+1.0s,mode=close;"
+     "delay_frame=tag:ACT,p=1,ms=150;delay_frame=tag:BATCH,p=1,ms=150",
+     "potrf-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "45",
+      "PARSEC_MCA_COMM_TRANSPORT": "shm",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    ("kill-close-recover-threads",
+     "seed={s};kill_rank=1@t+1.0s,mode=close;"
+     "delay_frame=tag:ACT,p=1,ms=150;delay_frame=tag:BATCH,p=1,ms=150",
+     "potrf-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "45",
+      "PARSEC_MCA_COMM_TRANSPORT": "threads",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    ("kill-hang-recover-shm",
+     "seed={s};kill_rank=1@t+1.0s,mode=hang;"
+     "delay_frame=tag:ACT,p=1,ms=150;delay_frame=tag:BATCH,p=1,ms=150",
+     "potrf-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "25",
+      "PARSEC_MCA_COMM_PEER_TIMEOUT_S": "2",
+      "PARSEC_MCA_COMM_TRANSPORT": "shm",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    ("kill-hang-recover-threads",
+     "seed={s};kill_rank=1@t+1.0s,mode=hang;"
+     "delay_frame=tag:ACT,p=1,ms=150;delay_frame=tag:BATCH,p=1,ms=150",
+     "potrf-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "25",
+      "PARSEC_MCA_COMM_PEER_TIMEOUT_S": "2",
+      "PARSEC_MCA_COMM_TRANSPORT": "threads",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    # survivor exhaustion: a second kill past the recovery budget must
+    # end in a CLEAN structured failure, never a loop or a hang
+    ("double-kill",
+     "seed={s};kill_rank=1@t+1.0s,mode=close;kill_rank=2@t+2.0s,"
+     "mode=close;delay_frame=tag:ACT,p=1,ms=150;"
+     "delay_frame=tag:BATCH,p=1,ms=150",
+     "potrf-recover", "peer-failed",
+     {"PARSEC_CHAOS_WAIT_S": "30", "_NRANKS": "3",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1",
+      "PARSEC_MCA_RECOVERY_MAX_ATTEMPTS": "1"}),
 ]
 
 _QUICK = ("delay-v0", "delay-recv", "kill-close", "fail-task-retry",
-          "kill-close-shm", "delay-recv-shm")
+          "kill-close-shm", "delay-recv-shm", "kill-close-recover",
+          "kill-dtd-recover")
+
+_RECOVER = ("kill-close-recover", "kill-hang-recover",
+            "kill-dtd-recover", "kill-close-recover-shm",
+            "kill-close-recover-threads", "kill-hang-recover-shm",
+            "kill-hang-recover-threads", "double-kill")
 
 _CHAOS_ENV = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
               "PARSEC_MCA_COMM_PEER_TIMEOUT_S",
@@ -191,21 +309,44 @@ _CHAOS_ENV = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
               "PARSEC_MCA_COMM_EAGER_LIMIT",
               "PARSEC_MCA_COMM_ADAPTIVE_EAGER",
               "PARSEC_MCA_COMM_RDV_RETRY_S",
-              "PARSEC_MCA_COMM_TRANSPORT")
+              "PARSEC_MCA_COMM_TRANSPORT",
+              "PARSEC_MCA_RECOVERY_ENABLE",
+              "PARSEC_MCA_RECOVERY_MAX_ATTEMPTS")
 
 
 def run_case(name, plan, workload, expect, env, timeout):
     """One seeded plan against one workload; returns (ok, outcome,
-    detail)."""
+    detail).  Harness-private env keys: ``_NRANKS`` (gang size,
+    default 2) and ``_TOLERATE`` (comma-separated ranks whose failure
+    is the EXPECTED kill — recovery cases require the survivors to
+    complete with validated numbers while the victim's own error is
+    ignored)."""
     from parsec_tpu.comm.launch import run_distributed
 
+    env = dict(env)
+    nranks = int(env.pop("_NRANKS", 2))
+    tolerate = [int(r) for r in env.pop("_TOLERATE", "").split(",")
+                if r != ""]
     saved = {k: os.environ.get(k) for k in _CHAOS_ENV}
     os.environ["PARSEC_MCA_FAULT_PLAN"] = plan
     os.environ.update(env)
     try:
         try:
-            res = run_distributed(WORKLOADS[workload], 2, timeout=timeout)
-            outcome, detail = "complete", repr(res)
+            res = run_distributed(WORKLOADS[workload], nranks,
+                                  timeout=timeout,
+                                  tolerate_ranks=tolerate)
+            if expect == "recovered":
+                # 'recovered' is OBSERVED, not assumed: the kill victim
+                # must actually have died (its tolerated slot is None).
+                # A run that outpaced its kill_rank trigger completed
+                # WITHOUT exercising recovery and must not pass as if
+                # it had
+                killed = bool(tolerate) and \
+                    all(res[r] is None for r in tolerate)
+                outcome = "recovered" if killed else "complete"
+            else:
+                outcome = "complete"
+            detail = repr(res)
         except TimeoutError as exc:
             # the harness deadline fired with ranks unreported: a HANG —
             # the invariant violation this tool exists to catch
@@ -240,6 +381,10 @@ def main(argv=None):
                     help="seeded plan runs (rotating over the catalog)")
     ap.add_argument("--quick", action="store_true",
                     help="premerge smoke: only the quick catalog subset")
+    ap.add_argument("--recover", action="store_true",
+                    help="only the RECOVERY catalog subset: kill plans "
+                         "that must end in COMPLETED jobs with correct "
+                         "numerics (plus survivor exhaustion)")
     ap.add_argument("--timeout", type=float, default=90.0,
                     help="per-run harness deadline (hang detector)")
     ap.add_argument("--only", default="",
@@ -254,6 +399,8 @@ def main(argv=None):
     catalog = CATALOG
     if args.quick:
         catalog = [c for c in CATALOG if c[0] in _QUICK]
+    if args.recover:
+        catalog = [c for c in CATALOG if c[0] in _RECOVER]
     if args.only:
         keep = set(args.only.split(","))
         catalog = [c for c in CATALOG if c[0] in keep]

@@ -199,9 +199,11 @@ else
 fi
 rm -f "$tel"
 echo "== premerge probe: chaos (seeded fault plans, no-hang invariant) =="
-# 6 seeds = one pass over the quick catalog, which now includes the
-# shm-transport kill and recv-reorder legs
-if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 6 --quick; then
+# 8 seeds = one pass over the quick catalog, which now includes the
+# shm-transport kill, the recv-reorder legs, AND the r12 recovery
+# cases (kill-close-recover / kill-dtd-recover: kill_rank plans that
+# must end in COMPLETED jobs with validated numbers on the survivor)
+if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 8 --quick; then
     rc=1
 fi
 exit $rc
