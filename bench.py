@@ -494,6 +494,10 @@ def _pp_worker(ctx, rank, nranks, nbytes, hops):
         prof = Profile(f"bench-pp-r{rank}")
         mod = install_task_profiler(ctx, prof)
         tr = install_causal_tracer(ctx, prof)
+        la = getattr(ctx.metrics, "liveattr", None) \
+            if ctx.metrics is not None else None
+        if la is not None:
+            la.reset()   # the online window = the measured run
     before = ctx.comm.stats()
     res = run_pingpong(ctx, nbytes, hops)
     after = ctx.comm.stats()
@@ -509,6 +513,14 @@ def _pp_worker(ctx, rank, nranks, nbytes, hops):
         mod.uninstall(ctx)
         tr.uninstall(ctx)
         prof.dump(os.path.join(trace_dir, f"rank{rank}.ptt"))
+        la = getattr(ctx.metrics, "liveattr", None) \
+            if ctx.metrics is not None else None
+        if la is not None:
+            # the ONLINE attribution section rides home next to the
+            # trace so run_rtt_bench can embed online-vs-offline
+            # agreement in the JSON line (numeric-filtered out of the
+            # protocol aggregation)
+            delta["liveattr_section"] = la.section()
     return res[0], res[1], delta
 
 
@@ -566,14 +578,28 @@ def run_rtt_bench(hops: int = 400):
     from parsec_tpu.comm.launch import run_distributed
     extras = {}
     trace_dir = None
+    traced_env = {}
     if os.environ.get("PARSEC_BENCH_TRACE", "0") == "1":
         import tempfile
         trace_dir = tempfile.mkdtemp(prefix="bench-rtt-trace-")
         os.environ["PARSEC_BENCH_TRACE_DIR"] = trace_dir
+        # the traced leg also arms the full online split (stride 1 +
+        # the queue-wait/exec hooks) so the embedded liveattr section
+        # is comparable bucket-for-bucket with the offline dict — an
+        # opt-in diagnostic leg, like the tracer itself
+        for k in ("PARSEC_MCA_METRICS_SAMPLE",
+                  "PARSEC_MCA_METRICS_QUEUE_WAIT"):
+            traced_env[k] = os.environ.get(k)
+            os.environ[k] = "1"
     try:
         res = run_distributed(_pp_worker, 2, args=(8, hops), timeout=300)
     finally:
         os.environ.pop("PARSEC_BENCH_TRACE_DIR", None)
+        for k, v in traced_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     value = float(np.mean([r[0] for r in res])) * 1e6
     if trace_dir:
         import shutil
@@ -583,8 +609,39 @@ def run_rtt_bench(hops: int = 400):
             log(f"rtt trace attribution FAILED: {exc!r}")
         finally:
             shutil.rmtree(trace_dir, ignore_errors=True)
+        try:
+            extras.update(_online_attribution(
+                res, extras.get("attribution")))
+        except Exception as exc:
+            log(f"rtt online attribution FAILED: {exc!r}")
     return value, {"protocol": _protocol_breakdown(res),
                    "host": _host_info(), **extras}
+
+
+def _online_attribution(res, offline) -> dict:
+    """Fold the per-rank liveattr sections into the ONLINE split and —
+    when the offline dict landed — the per-bucket agreement in
+    percentage points (informational: bench_guard skips both; the
+    ISSUE acceptance bound of 10pp/bucket is enforced by
+    tests/test_liveattr.py on the same leg)."""
+    from parsec_tpu.prof import liveattr as la_mod
+    sections = {i: r[2].get("liveattr_section")
+                for i, r in enumerate(res)
+                if r[2].get("liveattr_section")}
+    if not sections:
+        return {}
+    merged = la_mod.merge_sections(sections)
+    ex, qu = la_mod._bucket_sums(list(merged["recs"].values()))
+    online = la_mod.telescope(merged["window_s"], ex, qu,
+                              merged["comm_s"])
+    out = {"attribution_online": online}
+    ms = (offline or {}).get("makespan_s") or 0.0
+    if ms and online["elapsed"]:
+        out["attribution_agreement_pp"] = {
+            b: round(abs(offline.get(b, 0.0) / ms
+                         - online[b] / online["elapsed"]) * 100, 1)
+            for b in ("exec", "queue", "comm", "idle")}
+    return out
 
 
 def run_bw_bench(nbytes: int = 8 << 20, hops: int = 32):
@@ -695,6 +752,11 @@ def run_telemetry_bench(n: int = 20000):
     def rate(armed: int) -> float:
         _params.set("metrics_enabled", armed)
         _params.set("flightrec_enabled", armed)
+        # the armed leg carries the WHOLE plane: registry + flight
+        # recorder + the live attribution engine with straggler
+        # detection (liveattr rides the metrics sampling stride, so
+        # arming it is the production configuration this gate bounds)
+        _params.set("liveattr_enable", armed)
         try:
             with Context(nb_cores=int(os.environ.get(
                     "PARSEC_BENCH_CORES", 4))) as ctx:
@@ -707,6 +769,7 @@ def run_telemetry_bench(n: int = 20000):
         finally:
             _params.unset("metrics_enabled")
             _params.unset("flightrec_enabled")
+            _params.unset("liveattr_enable")
 
     # minimum over back-to-back pair ratios — the clock estimator's
     # min-RTT principle applied to an overhead gate: host-load noise

@@ -980,6 +980,25 @@ class RemoteDepEngine:
             # transport-specific counters (the shm ring exports
             # ring_full stalls + doorbell traffic through here)
             out.update(extra())
+        # per-peer comm-delay estimate for the live attribution plane
+        # (prof/liveattr.py), folded at SCRAPE time from state the
+        # transport already maintains: the clock-probe min-RTT table
+        # (one-way wire+dispatch ~ rtt/2) plus the queue->wire drain
+        # EWMA of the adaptive protocol's feedback, where the
+        # transport keeps one — zero new hot-path work
+        delays: Dict[int, float] = {}
+        try:
+            for r, st in self.ce.clock_table().items():
+                delays[r] = float(st.get("rtt", 0.0)) / 2.0
+            fb_fn = getattr(self.ce, "peer_feedback", None)
+            if fb_fn is not None:
+                for r in list(delays):
+                    fb = fb_fn(r)
+                    if fb and fb.get("delay_ewma"):
+                        delays[r] += float(fb["delay_ewma"])
+        except Exception:   # a torn-down transport must not kill stats
+            pass
+        out["peer_comm_delay_s"] = delays
         return out
 
     # -- bcast topologies (reference: remote_dep.c:334-357, virtual

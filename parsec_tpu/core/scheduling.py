@@ -147,10 +147,15 @@ def task_progress(es, task: Task, distance: int = 0) -> None:
                 task.status = _PREPARED
             if es.context._retry_max > 0 and task.retries == 0:
                 _snapshot_write_flows(task)
-            if _fi.ARMED and _fi.task_fault(task):
-                # fault plan fail_task directive: a transient, retryable
-                # body failure (utils/faultinject.py)
-                raise FaultInjected(f"{task}: injected transient fault")
+            if _fi.ARMED:
+                # fault plan hooks (utils/faultinject.py): keyed
+                # delay_dispatch stalls a matching body (deterministic
+                # straggler injection); fail_task raises a transient,
+                # retryable failure
+                _fi.task_delay(task)
+                if _fi.task_fault(task):
+                    raise FaultInjected(f"{task}: injected transient "
+                                        "fault")
             task.status = _RUNNING
             ret = execute(es, task)
         except Exception as exc:  # body/binding error: retry or fail pool

@@ -51,15 +51,17 @@ params.register("metrics_sample", 16,
                 "thins the histogram population to keep the always-on "
                 "cost inside the premerge <=5% telemetry gate)")
 params.register("metrics_queue_wait", 0,
-                "split the task-latency telemetry: hook the select "
-                "PINS event too, so queue-wait (ready->select) and "
-                "execution latency (select->complete) are separate "
-                "histograms.  Default off — the second hooked event "
-                "costs ~4-5% of the tasks probe by itself, half the "
-                "whole telemetry budget; the default single-hook path "
-                "folds both into the sojourn-time latency histogram "
-                "(ready->complete), which is what a serving SLO reads "
-                "anyway")
+                "split the task-latency telemetry: hook the select + "
+                "exec_begin/exec_end PINS events too, so queue-wait "
+                "(ready->select) and body execution latency "
+                "(exec_begin->exec_end, the same interval the task "
+                "profiler records) are separate histograms — also "
+                "what the live attribution plane needs for a true "
+                "exec/queue split.  Default off — each additional "
+                "hooked event costs tasks-probe budget; the default "
+                "single-hook path folds everything into the "
+                "sojourn-time latency histogram (ready->complete), "
+                "which is what a serving SLO reads anyway")
 params.register("metrics_ring", 256,
                 "per-histogram quantile reservoir size: the most recent "
                 "N observations kept in a ring for q50/q99 estimates "
@@ -240,6 +242,10 @@ def merge_samples(per_rank: Dict[int, List[dict]]) -> List[dict]:
     merged: Dict[Tuple, dict] = {}
     for rank in sorted(per_rank):
         for s in per_rank[rank]:
+            if s.get("t") == "section":
+                # non-metric side-channel records (the liveattr status
+                # section) ride the same pull but never merge or render
+                continue
             labels = dict(s.get("l") or {})
             if s["t"] == "gauge":
                 labels["rank"] = str(rank)
@@ -280,6 +286,8 @@ def render_text(samples: List[dict]) -> str:
     histogram buckets CUMULATIVE with le labels + _sum/_count."""
     by_name: Dict[str, List[dict]] = {}
     for s in samples:
+        if s.get("t") == "section":   # side-channel records don't render
+            continue
         by_name.setdefault(s["n"], []).append(s)
     out: List[str] = []
     for name in sorted(by_name):
@@ -326,9 +334,10 @@ class RuntimeMetrics:
         self._lock = threading.Lock()
         self._sample = max(1, int(params.get("metrics_sample", 16)))
         self._split_queue = bool(int(params.get("metrics_queue_wait", 0)))
-        #: opt-in select-hook sampling stride (racy int: approximate
-        #: stride is fine, the samples are a reservoir anyway)
+        #: opt-in select/exec-hook sampling strides (racy ints:
+        #: approximate stride is fine, the samples are a reservoir)
         self._sn = 0
+        self._en = 0
         #: discards are rare (pool cancellation) — a locked counter
         #: costs nothing at steady state
         self._discarded = Counter()
@@ -341,18 +350,31 @@ class RuntimeMetrics:
         self._slo = float(params.get("metrics_slo_job_s", 0.0))
         self._slo_breached = Counter()
         self._collectors: List[Callable[[], List[dict]]] = []
+        #: online attribution engine (prof/liveattr.py) riding THESE
+        #: hooks — it registers no PINS callbacks of its own
+        self._la = None
 
     # -- lifecycle -------------------------------------------------------
+    @property
+    def liveattr(self):
+        """The online attribution engine, or None when disarmed."""
+        return self._la
+
     def install(self, context) -> "RuntimeMetrics":
         self.rank = context.rank
         self.context = context
         context.metrics = self
         context._recompute_ready_stamp()
+        if int(params.get("liveattr_enable", 1)):
+            from parsec_tpu.prof.liveattr import LiveAttr
+            self._la = LiveAttr(self)
         # ONE hooked hot-path event by default: every additional PINS
         # dispatch with a live callback costs ~0.5us/task on the tasks
         # probe — two hooks alone would eat the whole <=5% budget
         if self._split_queue:
             context.pins_register("select", self._select)
+            context.pins_register("exec_begin", self._exec_begin)
+            context.pins_register("exec_end", self._exec_end)
         context.pins_register("complete_exec", self._complete)
         context.pins_register("task_discard", self._discard)
         context.pins_register("job_done", self._job_done)
@@ -370,6 +392,8 @@ class RuntimeMetrics:
     def uninstall(self, context) -> None:
         if self._split_queue:
             context.pins_unregister("select", self._select)
+            context.pins_unregister("exec_begin", self._exec_begin)
+            context.pins_unregister("exec_end", self._exec_end)
         context.pins_unregister("complete_exec", self._complete)
         context.pins_unregister("task_discard", self._discard)
         context.pins_unregister("job_done", self._job_done)
@@ -382,6 +406,8 @@ class RuntimeMetrics:
             context.metrics = None
             context._recompute_ready_stamp()
         self.context = None
+        self._la = None   # cached per-TaskClass recs detect the
+        #                   staleness through their rec.la identity
 
     def attach_service(self, service) -> None:
         """Job-service gauges (pending/running/degraded + the bounded
@@ -406,26 +432,67 @@ class RuntimeMetrics:
     def _select(self, es, event, task) -> None:
         # opt-in (metrics_queue_wait=1): split queue-wait from exec
         n = self._sn = self._sn + 1
-        if n % self._sample:
+        qw = None
+        if not n % self._sample:
+            now = time.perf_counter()
+            t0 = task.ready_at
+            if t0 is not None and t0 <= now:
+                qw = now - t0
+                self.task_queue_wait.observe(qw)
+        la = self._la
+        if la is not None:
+            # liveattr rides this hook: exact per-class selection
+            # counts, the sampled queue-wait profile, and the armed
+            # queue-side straggler check
+            la.task_selected(task, qw)
+
+    def _exec_begin(self, es, event, task,
+                    _perf=time.perf_counter) -> None:
+        # split mode only: stamp the body interval's start — the SAME
+        # interval the task profiler records, so the online exec
+        # bucket means what the offline critpath exec bucket means
+        task.mtr_t0 = _perf()
+
+    def _exec_end(self, es, event, task,
+                  _perf=time.perf_counter) -> None:
+        t0 = task.mtr_t0
+        if t0 is None:
             return
-        now = time.perf_counter()
-        task.mtr_t0 = now
-        t0 = task.ready_at
-        if t0 is not None and t0 <= now:
-            self.task_queue_wait.observe(now - t0)
+        task.mtr_t0 = None
+        dt = _perf() - t0
+        n = self._en = self._en + 1
+        sampled = not n % self._sample
+        if sampled:
+            self.task_latency.observe(dt)
+        la = self._la
+        if la is not None:
+            # exec profile + the exec-side straggler check live here
+            # (complete_exec fires after release_deps, so a
+            # select->complete clock would fold dep-release and
+            # activation-pack time into 'exec')
+            la.observe_exec(task, dt, sampled)
 
     def _complete(self, es, event, task,
                   _perf=time.perf_counter) -> None:
         # default-bound locals: this runs once per task on every
         # stream — each saved attribute lookup is premerge-gate budget
+        la = self._la
+        sampled = not es.nb_tasks_done % self._sample   # stream-local
         if self._split_queue:
-            # select-hook mode: the latency clock was stamped there
-            t0 = task.mtr_t0
-            if t0 is not None:
-                task.mtr_t0 = None
-                self.task_latency.observe(_perf() - t0)
+            if task.mtr_t0 is not None:
+                # ASYNC (device) task: exec_end never ran on a worker
+                # stream — close the interval here
+                self._exec_end(es, event, task)
+            if la is not None:
+                # split mode opted into per-task cost: exact done
+                # counts; the straggler check already ran at exec_end
+                la.task_done(la.rec_of(task), es, task, sampled,
+                             check=False)
             return
-        if es.nb_tasks_done % self._sample:   # stream-local stride
+        if not sampled:
+            # the common case pays liveattr NOTHING: counts, profiles
+            # and the straggler check all ride the sampling stride,
+            # exactly like the latency histogram below this line
             return
         # single-hook mode: the sampled observation is the SOJOURN time
         # (ready->complete, what an SLO reads); Task.ready_at is the
@@ -436,6 +503,8 @@ class RuntimeMetrics:
             now = _perf()
             if t0 <= now:
                 self.task_latency.observe(now - t0)
+        if la is not None:
+            la.task_done(la.rec_of(task), es, task, True)
 
     def _discard(self, es, event, task) -> None:
         self._discarded.inc()
@@ -508,6 +577,18 @@ class RuntimeMetrics:
         for labels, c in self._jobs_done.items():
             out.append(counter_sample("parsec_jobs_done_total", c.value,
                                       labels))
+        la = self._la
+        if la is not None:
+            # straggler counters + the liveattr status section (a
+            # side-channel record the render/merge paths skip): the
+            # cross-rank status document rides the SAME TAG_METRICS
+            # pull as the /metrics scrape — zero new wire tags
+            out.extend(la.samples())
+            try:
+                out.append({"n": "__liveattr__", "t": "section",
+                            "l": {}, "doc": la.section()})
+            except Exception:   # the side channel must not kill scrape
+                pass
         out.extend(self._collect_comm())
         out.extend(self._collect_sched())
         out.extend(self._collect_devices())

@@ -15,15 +15,20 @@ Requests are one JSON object; every request gets one JSON reply with an
     {"op": "submit", "app": "gemm", "params": {...}, "priority": 5,
      "deadline": 30.0, "client": "cli"}      -> {"ok": true, "job": 7}
     {"op": "status", "job": 7}               -> {"ok": true, "info": {...}}
+    {"op": "status"}                         -> {"ok": true, "status": {...}}
+                      (the LIVE surface: per-job progress, online
+                       exec/queue/comm/idle split, stragglers, dagsim
+                       ETA — prof/liveattr.py, cross-rank aggregated)
     {"op": "result", "job": 7, "timeout": 60}-> {"ok": true, "result": {...}}
     {"op": "cancel", "job": 7}               -> {"ok": true, "cancelled": b}
     {"op": "jobs"} / {"op": "stats"} / {"op": "gauges"} / {"op": "apps"}
     {"op": "metrics"}  -> {"ok": true, "text": <Prometheus exposition>,
                            "ranks": [...]}   (cross-rank via TAG_METRICS)
 
-The same port also answers a plain HTTP ``GET /metrics`` (the first
-four bytes disambiguate: framed requests lead with the PTJS magic), so
-a stock Prometheus scraper or curl needs no client library.
+The same port also answers plain HTTP ``GET /metrics`` (Prometheus
+text) and ``GET /status`` (the live job-status JSON) — the first four
+bytes disambiguate: framed requests lead with the PTJS magic — so a
+stock Prometheus scraper or curl needs no client library.
 
 Named apps (the multi-tenant demo catalog) build small self-contained
 problems from JSON params and return JSON-able result summaries — the
@@ -302,6 +307,7 @@ class JobServer:
         line = data.split(b"\r\n", 1)[0].decode("latin-1", "replace")
         parts = line.split()
         path = (parts[1] if len(parts) > 1 else "/").split("?", 1)[0]
+        ctype = "text/plain; version=0.0.4; charset=utf-8"
         if path.rstrip("/") == "/metrics" or path == "/":
             from parsec_tpu.prof.metrics import cluster_exposition
             try:
@@ -309,12 +315,24 @@ class JobServer:
             except Exception as exc:   # scrape must answer, not hang up
                 text = f"# scrape failed: {exc}\n"
             status, body = "200 OK", text.encode()
+        elif path.rstrip("/") == "/status":
+            # the live job-status document (same payload as the framed
+            # job-less {"op": "status"}), as JSON for curl/dashboards
+            from parsec_tpu.prof.liveattr import cluster_status
+            try:
+                doc = cluster_status(self.service.context, self.service)
+                status, body = "200 OK", json.dumps(doc).encode()
+            except Exception as exc:
+                status = "500 Internal Server Error"
+                body = json.dumps(
+                    {"error": f"{type(exc).__name__}: {exc}"}).encode()
+            ctype = "application/json"
         else:
             status = "404 Not Found"
-            body = b"parsec_tpu job server: scrape GET /metrics\n"
+            body = (b"parsec_tpu job server: scrape GET /metrics or "
+                    b"GET /status\n")
         hdrs = (f"HTTP/1.0 {status}\r\n"
-                "Content-Type: text/plain; version=0.0.4; "
-                "charset=utf-8\r\n"
+                f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n"
                 "Connection: close\r\n\r\n")
         try:
@@ -335,8 +353,20 @@ class JobServer:
         if op == "submit":
             return self._op_submit(req)
         if op == "status":
-            job = self._job_of(req)
-            return {"ok": True, "info": job.info()}
+            if req.get("job") is not None:
+                # per-job record (the original op shape)
+                job = self._job_of(req)
+                return {"ok": True, "info": job.info()}
+            # job-less status: the LIVE streaming surface — per-job DAG
+            # progress, the online exec/queue/comm/idle split, straggler
+            # list and the dagsim ETA, aggregated cross-rank over the
+            # same TAG_METRICS pull as /metrics (prof/liveattr.py)
+            from parsec_tpu.prof.liveattr import cluster_status
+            doc = cluster_status(
+                self.service.context, self.service,
+                aggregate=bool(req.get("aggregate", True)),
+                timeout=float(req.get("timeout", 2.0)))
+            return {"ok": True, "status": doc}
         if op == "result":
             job = self._job_of(req)
             try:

@@ -41,7 +41,11 @@ Directives (``;``-separated; fields ``,``-separated):
                   (``key~substr`` matches ``str(task)``); exercises the
                   ``task_retry_max`` transient-retry path
 ``delay_dispatch``  sleep ``ms`` in the device manager before a launch
-                  (perturbs manager/completer interleavings)
+                  (perturbs manager/completer interleavings); with a
+                  ``key~substr`` matcher the delay moves to the WORKER
+                  right before a matching task's body runs instead —
+                  the deterministic straggler injector the liveattr
+                  anomaly tests replay (prof/liveattr.py)
 
 Field forms: ``tag:NAME`` (frame tag; default = any app tag),
 ``pm=<substr>`` (substring of ``repr(payload)``), ``p=<prob>``,
@@ -268,6 +272,18 @@ class RuntimeFaults:
 
     def device_delay(self) -> None:
         for d in self.disp_dirs:
+            if d.key is not None:
+                continue   # keyed directives fire per task (task_delay)
+            if d.take(self.rng) and d.ms > 0:
+                time.sleep(d.ms * 1e-3)
+
+    def task_delay(self, task) -> None:
+        """Keyed ``delay_dispatch`` directives: stall a MATCHING task's
+        body on the worker — a deterministic straggler whose class
+        peers establish the baseline profile the detector arms from."""
+        for d in self.disp_dirs:
+            if d.key is None or d.key not in str(task):
+                continue
             if d.take(self.rng) and d.ms > 0:
                 time.sleep(d.ms * 1e-3)
 
@@ -335,6 +351,14 @@ def device_delay() -> None:
     rt = runtime()
     if rt is not None:
         rt.device_delay()
+
+
+def task_delay(task) -> None:
+    """Hook: keyed pre-body delay (straggler injection).  Call only
+    behind an ``ARMED`` check."""
+    rt = runtime()
+    if rt is not None:
+        rt.task_delay(task)
 
 
 # spawned ranks inherit PARSEC_MCA_FAULT_PLAN through the environment:

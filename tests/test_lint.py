@@ -19,7 +19,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from tools.parseclint import FileCtx, Finding  # noqa: E402
 from tools.parseclint.passes import (assert_hazard, device_put,  # noqa: E402
                                      evloop_blocking, except_hygiene,
-                                     lock_discipline, mca_knobs)
+                                     lock_discipline, mca_knobs,
+                                     prom_metrics)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -393,6 +394,103 @@ def test_mca_partial_scan_is_silent():
     fs = mca_knobs.tree_check([mca_knobs.facts(ctx)], REPO,
                               {ctx.rel: ctx,
                                "parsec_tpu/utils/mca.py": ctx})
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# PCL-PROM: metric-family doc drift
+# ---------------------------------------------------------------------------
+
+def _prom_run(sources, docs, tmp_path):
+    """sources: {rel: code} (exporter rel paths get written to disk so
+    the existence gate sees them); docs: {name: text}."""
+    ctxs = {}
+    for rel, src in sources.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        ctxs[rel] = _ctx(src, rel=rel)
+    for name, text in docs.items():
+        (tmp_path / name).write_text(text)
+    facts = [prom_metrics.facts(c) for c in ctxs.values()]
+    return prom_metrics.tree_check(facts, str(tmp_path), ctxs)
+
+
+_EXPORTER = "parsec_tpu/prof/metrics.py"
+
+
+def test_prom_flags_undocumented_family(tmp_path):
+    fs = _prom_run(
+        {_EXPORTER: 'out.append(counter_sample('
+                    '"parsec_widgets_total", 1))\n'},
+        {"README.md": "telemetry families: none yet\n"}, tmp_path)
+    assert [f.pass_id for f in fs] == ["PCL-PROM"]
+    assert "parsec_widgets_total" in fs[0].message
+    assert fs[0].path == _EXPORTER
+
+
+def test_prom_flags_stale_doc_series(tmp_path):
+    """The encoded bug class: PR 7 round 2 dropped
+    parsec_tasks_enabled_total from the registry; a doc row still
+    naming it must flag AT THE DOC LINE."""
+    fs = _prom_run(
+        {_EXPORTER: 's = counter_sample('
+                    '"parsec_tasks_retired_total", n)\n'},
+        {"README.md": "families: `parsec_tasks_retired_total` and "
+                      "`parsec_tasks_enabled_total`\n"}, tmp_path)
+    assert any(f.path == "README.md"
+               and "parsec_tasks_enabled_total" in f.message
+               for f in fs)
+    assert not any(f.path == _EXPORTER for f in fs)
+
+
+def test_prom_prefix_mention_and_template_clean(tmp_path):
+    """A family-prefix doc mention (parsec_comm_) covers both plain
+    literals and f-string templates; series-suffixed doc tokens that
+    resolve against a template are clean too."""
+    fs = _prom_run(
+        {_EXPORTER: '''
+            for key in ("frames_sent", "frames_recv"):
+                out.append(counter_sample(
+                    f"parsec_comm_{key}_total", 1))
+            out.append(gauge_sample("parsec_comm_dead_peers", 0))
+         '''},
+        {"README.md": "comm families (`parsec_comm_...`): "
+                      "`parsec_comm_frames_sent_total` etc.\n"},
+        tmp_path)
+    assert fs == []
+
+
+def test_prom_partial_scan_is_silent(tmp_path):
+    """An exporter file present on disk but outside the scanned set
+    keeps the cross-check off (the export universe is incomplete)."""
+    (tmp_path / "parsec_tpu" / "prof").mkdir(parents=True)
+    (tmp_path / _EXPORTER).write_text(
+        'counter_sample("parsec_widgets_total", 1)\n')
+    (tmp_path / "README.md").write_text("nothing\n")
+    other = _ctx("x = 1\n", rel="parsec_tpu/comm/x.py")
+    assert prom_metrics.tree_check(
+        [prom_metrics.facts(other)], str(tmp_path),
+        {other.rel: other}) == []
+
+
+def test_prom_non_series_doc_tokens_ignored(tmp_path):
+    """Reference-C symbol mentions (parsec_matrix_block_cyclic_kview)
+    carry no series suffix and never flag doc-side."""
+    fs = _prom_run(
+        {_EXPORTER: 's = counter_sample('
+                    '"parsec_tasks_retired_total", n)\n'},
+        {"COMPONENTS.md":
+         "rebuilds parsec_matrix_block_cyclic_kview; families: "
+         "`parsec_tasks_retired_total`\n"}, tmp_path)
+    assert fs == []
+
+
+def test_prom_inline_suppression(tmp_path):
+    fs = _prom_run(
+        {_EXPORTER: 'counter_sample("parsec_internal_probe_total", '
+                    '1)  # lint: ignore[PCL-PROM]\n'},
+        {"README.md": "none\n"}, tmp_path)
     assert fs == []
 
 

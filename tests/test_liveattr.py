@@ -1,0 +1,582 @@
+"""Live attribution plane (prof/liveattr.py): streaming class
+profiles, the online exec/queue/comm/idle split, straggler detection,
+the dagsim ETA, and the job server's status surface.
+
+The two acceptance legs:
+
+* a seeded keyed ``delay_dispatch`` fault plan makes the anomaly
+  event, ``parsec_stragglers_total`` and the rate-limited flight
+  recorder bundle all fire for the delayed class — and a clean run of
+  the same workload stays silent;
+* on the traced 2-rank rtt leg the ONLINE attribution split agrees
+  with offline ``critpath.attribute()`` within 10 percentage points
+  per bucket (offline coverage >= 0.9).
+"""
+
+import json
+import os
+import re
+import socket
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from parsec_tpu.prof import liveattr as la_mod  # noqa: E402
+from parsec_tpu.prof.liveattr import (bucket_quantile,  # noqa: E402
+                                      class_totals, eta_seconds,
+                                      merge_sections, telescope)
+from parsec_tpu.prof.metrics import render_text  # noqa: E402
+from parsec_tpu.utils import faultinject  # noqa: E402
+from parsec_tpu.utils.mca import params  # noqa: E402
+
+
+def _chain_pool(n, name="chain"):
+    """Serial n-task chain rooted in one collection tile (the
+    test_metrics chain shape, single rank)."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    V = VectorTwoDimCyclic(mb=4, lm=4)
+    V.data_of(0).copy_on(0).payload[:] = 0.0
+    p = PTG(name, NT=n)
+    p.task("S", k=Range(0, n - 1)) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, V=V: V(0)), when=lambda k: k == 0),
+              IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("S", "T", lambda k, n=n: dict(k=k + 1)),
+                  when=lambda k, n=n: k < n - 1),
+              OUT(DATA(lambda k, V=V: V(0)),
+                  when=lambda k, n=n: k == n - 1)) \
+        .body(lambda T: T + 1.0)
+    return p.build()
+
+
+def _flat_pool(n, name="flat"):
+    from parsec_tpu.dsl.ptg.api import PTG, Range
+    p = PTG(name, NT=n)
+    p.task("W", k=Range(0, n - 1)).body(lambda: None)
+    return p.build()
+
+
+# ---------------------------------------------------------------------------
+# unit: profiles / telescoping / merge / ETA
+# ---------------------------------------------------------------------------
+
+def test_profile_stream_and_quantiles():
+    p = la_mod._Profile(ring=64)
+    for i in range(100):
+        p.observe(1e-3, alpha=0.2)
+    p.observe(1.0, alpha=0.2)
+    assert p.n == 101
+    assert p.quantile(0.5) == pytest.approx(1e-3)
+    assert p.quantile(0.99) in (1e-3, 1.0)
+    assert 1e-3 < p.ewma < 1.0          # pulled toward the outlier
+    w = p.to_wire()
+    assert w["n"] == 101 and sum(w["b"]) == 101
+    # bucket quantile off the wire form: the 1s outlier sits in the
+    # top half of the log2 ladder
+    assert bucket_quantile(w["b"], 0.5) == pytest.approx(
+        2.0 ** -10, rel=1.0)
+
+
+def test_telescope_sums_to_elapsed_and_clamps():
+    t = telescope(10.0, 2.0, 1.0, 3.0)
+    assert t["idle"] == pytest.approx(4.0)
+    assert t["exec"] + t["queue"] + t["comm"] + t["idle"] == \
+        pytest.approx(t["elapsed"]) == pytest.approx(10.0)
+    # the comm ESTIMATE caps into what the measured buckets leave
+    t = telescope(10.0, 2.0, 1.0, 100.0)
+    assert t["comm"] == pytest.approx(7.0) and t["idle"] == 0.0
+    # wide DAG: cumulative exec+queue beyond the window scale down
+    t = telescope(6.0, 6.0, 3.0, 3.0)
+    assert t["idle"] == 0.0 and t["comm"] == 0.0
+    assert t["exec"] == pytest.approx(4.0)
+    assert t["exec"] + t["queue"] == pytest.approx(6.0)
+    assert telescope(0.0, 1.0, 1.0, 1.0)["elapsed"] == 0.0
+
+
+def test_merge_sections_sums_counts_and_buckets():
+    prof = la_mod._Profile(ring=16)
+    for _ in range(10):
+        prof.observe(1e-3, alpha=0.2)
+    row = {"job": 7, "cls": "GEMM", "done": 10, "sel": 0,
+           "t0": 5.0, "t1": 6.0, "lat": prof.to_wire(),
+           "queue": None, "exec": None}
+    sec_a = {"rank": 0, "recs": [row],
+             "strag": [[7, "GEMM", "exec", 2]], "anomalies": [],
+             "comm": {"acts": 4.0, "delay_s": 0.5, "per_peer": {}}}
+    sec_b = {"rank": 1, "recs": [dict(row, done=5)],
+             "strag": [[7, "GEMM", "exec", 1]], "anomalies": [],
+             "comm": {"acts": 2.0, "delay_s": 0.5, "per_peer": {}}}
+    m = merge_sections({0: sec_a, 1: sec_b})
+    rec = m["recs"][(7, "GEMM")]
+    assert rec["done"] == 15
+    assert rec["lat"]["n"] == 20 and sum(rec["lat"]["b"]) == 20
+    assert m["strag"][(7, "GEMM", "exec")] == 3
+    # (4 + 2) acts x the pessimistic 0.5s delay x the 2.0 load factor
+    assert m["comm_s"] == pytest.approx(6.0)
+    assert m["window_s"] == pytest.approx(1.0)
+
+
+def test_eta_through_dagsim():
+    rows = [{"cls": "A", "pending": 100, "mean_s": 0.01},
+            {"cls": "B", "pending": 100, "mean_s": 0.03}]
+    eta = eta_seconds(rows, 200, n_chips=4)
+    # 4 s of work over 4 chips: list scheduling lands near 1 s
+    assert 0.9 <= eta <= 1.5
+    # a class with no profile borrows the blended mean; never None
+    # while any class has data
+    rows.append({"cls": "C", "pending": 50, "mean_s": 0.0})
+    assert eta_seconds(rows, 250, n_chips=4) > eta * 0.9
+    assert eta_seconds([{"cls": "A", "pending": 5, "mean_s": 0.0}],
+                       5, 2) is None
+
+
+def test_eta_dynamic_pool_falls_back_to_aggregate_remaining():
+    """Unknown per-class totals (DTD / over-cap enumeration): every
+    row's pending is 0, but the aggregate remaining + the observed
+    profiles must still quote (the __rest__ path)."""
+    rows = [{"cls": "A", "pending": 0, "done": 50, "mean_s": 0.01}]
+    eta = eta_seconds(rows, 200, n_chips=2)
+    assert eta == pytest.approx(200 * 0.01 / 2, rel=0.5)
+    # and __rest__ scales WITH the throughput calibration: the gang
+    # completed 50 tasks over the 1s window -> sustains 50/s -> 200
+    # remaining ~ 4s (not the raw 1s the uncalibrated mean quotes)
+    eta = eta_seconds(rows, 200, n_chips=2, done_total=50,
+                      window_s=1.0)
+    assert 3.2 <= eta <= 4.8, eta
+
+
+def test_finish_profile_is_non_destructive():
+    """build_status finishes the same merged row once per job entry
+    and once in the aggregate: both reads must agree."""
+    prof = la_mod._Profile(ring=16)
+    for _ in range(20):
+        prof.observe(2e-3, alpha=0.2)
+    merged = la_mod._merge_profile(None, prof.to_wire())
+    first = la_mod._finish_profile(merged)
+    second = la_mod._finish_profile(merged)
+    assert first == second
+    assert first["p99_s"] == pytest.approx(2e-3)   # ring, not bucket
+
+
+def test_eta_throughput_calibration():
+    """Sojourn-based means double-count queueing (dagsim models
+    queueing itself — a deep-queued pool quoted 37x over before the
+    fix): with the observed completion rate supplied, the quote
+    extrapolates the measured throughput, not the inflated means."""
+    rows = [{"cls": "W", "pending": 300, "done": 100,
+             "mean_s": 0.1}]              # inflated sojourn mean
+    # 100 tasks completed in a 1s window on 2 chips -> the gang
+    # sustains 100/s -> 300 remaining ~ 3s (NOT 300 * 0.1 / 2 = 15s)
+    eta = eta_seconds(rows, 300, n_chips=2, done_total=100,
+                      window_s=1.0)
+    assert 2.5 <= eta <= 3.6, eta
+    # without observation data the raw profile means stand
+    assert eta_seconds(rows, 300, n_chips=2) > 10
+
+
+def test_eta_quote_tracks_actual_completion():
+    """ETA honesty e2e: a mid-run quote from the status surface must
+    land within a small factor of the ACTUAL remaining wall time."""
+    params.set("metrics_sample", 1)
+    from parsec_tpu.service.service import JobService
+    from parsec_tpu.dsl.ptg.api import PTG, Range
+
+    def slow_pool(n=400, ms=3.0):
+        p = PTG("slowjob", NT=n)
+        p.task("W", k=Range(0, n - 1)).body(
+            lambda: time.sleep(ms * 1e-3))
+        return p.build()
+
+    try:
+        svc = JobService(nb_cores=2)
+        try:
+            job = svc.submit(lambda: (slow_pool(), lambda: "ok"),
+                             name="eta")
+            la = svc.context.metrics.liveattr
+            quote = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                doc = la_mod.build_status(svc.context, svc,
+                                          {0: la.section()})
+                js = [j for j in doc["jobs"]
+                      if j["job"] == job.job_id]
+                if js and js[0]["status"] == "RUNNING" \
+                        and js[0]["eta_s"] is not None \
+                        and js[0]["progress"]["done"] >= 80:
+                    quote = (time.monotonic(), js[0]["eta_s"])
+                    break
+                time.sleep(0.05)
+            assert quote, "no mid-run ETA quote observed"
+            assert job.wait(timeout=120)
+            actual = time.monotonic() - quote[0]
+            # generous band: the quote must be the right ORDER — the
+            # pre-fix failure mode was 37x over
+            assert 0.15 * actual <= quote[1] <= 5.0 * actual, (
+                quote[1], actual)
+        finally:
+            svc.shutdown(timeout=15)
+    finally:
+        params.unset("metrics_sample")
+
+
+def test_class_totals_enumerates_and_caches():
+    tp = _flat_pool(40)
+    assert class_totals(tp) == {"W": 40}
+    assert tp._liveattr_totals == {"W": 40}     # cached
+    big = _flat_pool(50)
+    big._liveattr_totals = None                 # simulate cap overflow
+    assert class_totals(big) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end single rank: exact counts, profiles, status document
+# ---------------------------------------------------------------------------
+
+def test_liveattr_counts_exactly_and_profiles(monkeypatch):
+    params.set("metrics_sample", 1)
+    try:
+        from parsec_tpu.core.context import Context
+        with Context(nb_cores=2) as ctx:
+            tp = _flat_pool(200)
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            la = ctx.metrics.liveattr
+            sec = la.section()
+            rows = {r["cls"]: r for r in sec["recs"]}
+            assert rows["W"]["done"] == 200
+            assert rows["W"]["lat"]["n"] > 0
+            doc = la_mod.build_status(ctx, None, {0: sec})
+            agg = doc["aggregate"]
+            assert agg["done"] == 200
+            att = agg["attribution"]
+            assert att["elapsed"] > 0
+            assert att["exec"] + att["queue"] + att["comm"] \
+                + att["idle"] == pytest.approx(att["elapsed"],
+                                               rel=1e-3)
+            # reset starts a fresh window AND invalidates the
+            # per-TaskClass caches — a surviving class must not keep
+            # counting into an orphaned row
+            rec_old = tp.task_classes["W"]._la_rec
+            la.reset()
+            assert rec_old.la is None       # cache binding broken
+            assert la.section()["recs"] == []
+            ctx.add_taskpool(_flat_pool(30))
+            ctx.wait()
+            rows2 = {r["cls"]: r for r in la.section()["recs"]}
+            assert rows2["W"]["done"] == 30
+    finally:
+        params.unset("metrics_sample")
+
+
+def test_evicted_rec_does_not_orphan_live_classes():
+    """Past liveattr_max_series the oldest row evicts; a TaskClass
+    still pointing at the evicted row must re-resolve on its next
+    task instead of updating telemetry nobody can see."""
+    params.set("metrics_sample", 1)
+    params.set("liveattr_max_series", 1)
+    try:
+        from parsec_tpu.core.context import Context
+        from parsec_tpu.dsl.ptg.api import PTG, Range
+        with Context(nb_cores=2) as ctx:
+            p = PTG("two", NT=60)
+            p.task("A", k=Range(0, 59)).body(lambda: None)
+            p.task("B", k=Range(0, 59)).body(lambda: None)
+            tp = p.build()
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            la = ctx.metrics.liveattr
+            # the orphan invariant: any rec a TaskClass still binds to
+            # must be the registered one (or invalidated)
+            live = set(map(id, la._recs.values()))
+            for tc in tp.task_classes.values():
+                rec = getattr(tc, "_la_rec", None)
+                if rec is not None and rec.la is la:
+                    assert id(rec) in live
+    finally:
+        params.unset("metrics_sample")
+        params.unset("liveattr_max_series")
+
+
+def test_split_mode_separates_queue_and_exec():
+    params.set("metrics_sample", 1)
+    params.set("metrics_queue_wait", 1)
+    try:
+        from parsec_tpu.core.context import Context
+        with Context(nb_cores=2) as ctx:
+            ctx.add_taskpool(_flat_pool(120))
+            ctx.wait()
+            sec = ctx.metrics.liveattr.section()
+            row = {r["cls"]: r for r in sec["recs"]}["W"]
+            assert row["done"] == 120
+            assert row["sel"] == 120            # exact selections
+            assert row["queue"] is not None and row["queue"]["n"] > 0
+            assert row["exec"] is not None and row["exec"]["n"] > 0
+    finally:
+        params.unset("metrics_sample")
+        params.unset("metrics_queue_wait")
+
+
+# ---------------------------------------------------------------------------
+# straggler detection: deterministic fault-plan e2e + clean twin
+# ---------------------------------------------------------------------------
+
+def _straggler_run(tmp_path, plan):
+    """One chain run under (or without) a keyed delay plan; returns
+    (anomalies, rendered metrics, bundle_dir)."""
+    params.set("metrics_sample", 1)
+    params.set("liveattr_straggler_min", 16)
+    params.set("liveattr_straggler_mult", 8.0)
+    params.set("liveattr_straggler_floor_ms", 40.0)
+    params.set("flightrec_enabled", 1)
+    params.set("flightrec_dir", str(tmp_path / "bundle"))
+    if plan:
+        faultinject.arm(plan)
+    try:
+        from parsec_tpu.core.context import Context
+        with Context(nb_cores=2) as ctx:
+            ctx.add_taskpool(_chain_pool(260))
+            ctx.wait(timeout=120)
+            la = ctx.metrics.liveattr
+            anomalies = la.anomalies()
+            text = render_text(ctx.metrics.samples())
+            if plan:
+                # the incident dump runs on its own thread
+                deadline = time.monotonic() + 10
+                while not (tmp_path / "bundle" / "rank0.ptt").exists() \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.05)
+        return anomalies, text, tmp_path / "bundle"
+    finally:
+        if plan:
+            faultinject.disarm()
+        for k in ("metrics_sample", "liveattr_straggler_min",
+                  "liveattr_straggler_mult",
+                  "liveattr_straggler_floor_ms", "flightrec_enabled",
+                  "flightrec_dir"):
+            params.unset(k)
+
+
+def test_straggler_fires_under_delay_plan(tmp_path):
+    """A keyed delay_dispatch stall on one late task of an otherwise
+    uniform chain: the anomaly event names the class and kind, the
+    counter exports with {job,class,kind} labels, and the flight
+    recorder captured the neighborhood."""
+    anomalies, text, bundle = _straggler_run(
+        tmp_path, "seed=5;delay_dispatch=key~k=250,ms=150")
+    assert anomalies, "no straggler detected under the delay plan"
+    ev = anomalies[-1]
+    assert ev["cls"] == "S" and ev["kind"] == "exec"
+    assert ev["latency_s"] > ev["threshold_s"] > 0
+    assert "k=250" in ev["task"]
+    m = re.search(
+        r'parsec_stragglers_total\{class="S",job="-",kind="exec"\} '
+        r'(\d+)', text)
+    assert m is not None and int(m.group(1)) >= 1, text[:2000]
+    assert (bundle / "rank0.ptt").exists()
+    assert (bundle / "incidents.jsonl").exists()
+    inc = (bundle / "incidents.jsonl").read_text()
+    assert "straggler" in inc
+
+
+def test_straggler_clean_run_stays_silent(tmp_path):
+    """The same workload with no plan: no anomaly, no counter, no
+    bundle — detection must not cry wolf on ordinary variance."""
+    anomalies, text, bundle = _straggler_run(tmp_path, "")
+    assert anomalies == []
+    assert "parsec_stragglers_total" not in text
+    # the recorder probes its dir at arm time; no INCIDENT may land
+    assert not (bundle / "rank0.ptt").exists()
+    assert not (bundle / "incidents.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# status surface: framed op, HTTP GET, tools entry points
+# ---------------------------------------------------------------------------
+
+def _http_get(host, port, path):
+    with socket.create_connection((host, port), timeout=10) as s:
+        s.settimeout(10)
+        s.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+        buf = b""
+        while True:
+            c = s.recv(65536)
+            if not c:
+                break
+            buf += c
+    head, _, body = buf.partition(b"\r\n\r\n")
+    return head, body
+
+
+def test_status_op_and_http_surface():
+    params.set("metrics_sample", 1)
+    from parsec_tpu.service.server import JobServer, request
+    from parsec_tpu.service.service import JobService
+    try:
+        svc = JobService(nb_cores=2)
+        server = JobServer(svc, port=0)
+        try:
+            def factory():
+                tp = _flat_pool(150, name="job-pool")
+                return tp, lambda: {"ok": 1}
+            job = svc.submit(factory, name="flat")
+            assert job.wait(timeout=60)
+            st = request(server.host, server.port, {"op": "status"})
+            assert st["ok"]
+            doc = st["status"]
+            assert doc["ranks"] == [0]
+            (j,) = doc["jobs"]
+            assert j["job"] == job.job_id and j["status"] == "DONE"
+            assert j["progress"]["done"] == 150
+            cls = j["progress"]["classes"]["W"]
+            assert cls["done"] == 150 and cls["pending"] == 0
+            att = j["attribution"]
+            assert att["exec"] + att["queue"] + att["comm"] \
+                + att["idle"] == pytest.approx(att["elapsed"],
+                                               rel=1e-3)
+            assert j["stragglers"] == []
+            assert doc["service"]["running"] == 0
+            # the original per-job shape is untouched
+            info = request(server.host, server.port,
+                           {"op": "status", "job": job.job_id})
+            assert info["ok"] and info["info"]["status"] == "DONE"
+            # plain HTTP twin on the sniffed port
+            head, body = _http_get(server.host, server.port, "/status")
+            assert b"200 OK" in head and b"application/json" in head
+            hdoc = json.loads(body)
+            assert hdoc["jobs"][0]["progress"]["done"] == 150
+            # /metrics still serves next to it
+            head, body = _http_get(server.host, server.port,
+                                   "/metrics")
+            assert b"200 OK" in head
+            assert b"parsec_tasks_retired_total" in body
+        finally:
+            server.close()
+            svc.shutdown(timeout=15)
+    finally:
+        params.unset("metrics_sample")
+
+
+def test_live_view_and_metrics_client_status(tmp_path):
+    """tools/live_view.py remote mode + metrics_client --status render
+    a live server (satellites: the advertised-but-error'd scrape mode
+    now works)."""
+    import subprocess
+    from parsec_tpu.service.server import JobServer
+    from parsec_tpu.service.service import JobService
+    svc = JobService(nb_cores=2)
+    server = JobServer(svc, port=0)
+    try:
+        def factory():
+            return _flat_pool(60, name="jp"), lambda: None
+        job = svc.submit(factory, name="tview")
+        assert job.wait(timeout=60)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        r = subprocess.run(
+            [sys.executable, "tools/live_view.py", "--host",
+             server.host, "--port", str(server.port), "--once"],
+            capture_output=True, text=True, timeout=60, cwd=repo,
+            env=env)
+        assert r.returncode == 0, r.stderr
+        assert "tview" in r.stdout and "exec/queue/comm/idle" \
+            in r.stdout
+        r = subprocess.run(
+            [sys.executable, "tools/metrics_client.py", "--host",
+             server.host, "--port", str(server.port), "--status",
+             "--job", str(job.job_id)],
+            capture_output=True, text=True, timeout=60, cwd=repo,
+            env=env)
+        assert r.returncode == 0, r.stderr
+        doc = json.loads(r.stdout)
+        assert [j["job"] for j in doc["jobs"]] == [job.job_id]
+    finally:
+        server.close()
+        svc.shutdown(timeout=15)
+
+
+# ---------------------------------------------------------------------------
+# 2-rank validation: online split vs offline critpath.attribute()
+# ---------------------------------------------------------------------------
+
+def _online_pp_worker(ctx, rank, nranks, outdir):
+    from parsec_tpu.apps.pingpong import run_pingpong
+    from parsec_tpu.prof.causal import install_causal_tracer
+    from parsec_tpu.prof.pins import install_task_profiler
+    from parsec_tpu.prof.profiling import Profile
+    run_pingpong(ctx, 8, 10)                  # warm link + code paths
+    prof = Profile(f"la-pp-r{rank}")
+    mod = install_task_profiler(ctx, prof)
+    tr = install_causal_tracer(ctx, prof)
+    la = ctx.metrics.liveattr
+    la.reset()                                # window = the measured run
+    run_pingpong(ctx, 8, 150)
+    deadline = time.time() + 15               # one clock round for the
+    while len(ctx.comm.ce.clock) < nranks - 1 \
+            and time.time() < deadline:       # offline merge + the
+        time.sleep(0.05)                      # online comm estimate
+    section = la.section()
+    mod.uninstall(ctx)
+    tr.uninstall(ctx)
+    path = prof.dump(os.path.join(outdir, f"rank{rank}.ptt"))
+    return {"path": path, "section": section}
+
+
+def test_online_split_matches_offline_attribution(tmp_path):
+    """ISSUE acceptance: on the traced 2-rank rtt leg the ONLINE
+    exec/queue/comm/idle split agrees with the offline
+    critpath.attribute() decomposition within 10 percentage points
+    per bucket (offline coverage >= 0.9)."""
+    from parsec_tpu.comm.launch import run_distributed
+    from parsec_tpu.prof import critpath
+    env = {"PARSEC_MCA_METRICS_SAMPLE": "1",
+           "PARSEC_MCA_METRICS_QUEUE_WAIT": "1"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    last = None
+    try:
+        # one retry: host-load noise can produce a pathological
+        # OFFLINE trace (coverage far from 1) or smear one window —
+        # the same single-sample fragility the bench's min-of-pairs
+        # discipline exists for
+        for attempt in range(2):
+            out = tmp_path / f"try{attempt}"
+            out.mkdir()
+            res = run_distributed(_online_pp_worker, 2,
+                                  args=(str(out),), timeout=240)
+            offline = critpath.attribution([r["path"] for r in res])
+            merged = merge_sections({i: r["section"]
+                                     for i, r in enumerate(res)})
+            exec_s, queue_s = la_mod._bucket_sums(
+                list(merged["recs"].values()))
+            online = telescope(merged["window_s"], exec_s, queue_s,
+                               merged["comm_s"])
+            ms = offline["makespan"]
+            last = (offline, online)
+            if not 0.9 <= offline["coverage"] <= 1.1:
+                continue     # unusable offline reference — re-trace
+            deltas = {
+                b: abs(offline["buckets"][b] / ms
+                       - online[b] / online["elapsed"])
+                for b in ("exec", "queue", "comm", "idle")}
+            if all(d <= 0.10 for d in deltas.values()):
+                return
+        raise AssertionError(
+            f"online split disagrees with offline attribution "
+            f"beyond 10pp after retry: {last}")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -73,11 +73,13 @@ SKIP_KEYS = {"metric", "unit", "storage", "note", "ib",
              # eager coverage eroding is the regression)
              "partial_writes", "wakeups", "act_rdv", "act_inline",
              "coalesced_msgs", "transport",
-             # critical-path attribution (PARSEC_BENCH_TRACE=1) is
+             # critical-path attribution (PARSEC_BENCH_TRACE=1) — and
+             # its r14 online twin + the per-bucket agreement — is
              # informational: the buckets reshuffle with host load and
              # have no regression direction; the tracer-overhead gate
              # is the off-vs-on tasks comparison in premerge_bench.sh
-             "attribution",
+             "attribution", "attribution_online",
+             "attribution_agreement_pp",
              # host core inventory on bw/rtt lines (where the number
              # was measured, not what was measured) and the telemetry
              # mode's raw side readings (the gated value is the ratio)

@@ -8,16 +8,22 @@ interval and prints per-second rates for counter families):
     python tools/metrics_client.py --port 41990
     python tools/metrics_client.py --watch 2
     python tools/metrics_client.py --grep parsec_comm
+    python tools/metrics_client.py --job 7          # one job's series
+    python tools/metrics_client.py --status         # live status doc
+    python tools/metrics_client.py --status --watch 2
     curl http://127.0.0.1:41990/metrics        # same data, plain HTTP
 
-The framed request is ``{"op": "metrics"}`` (service/server.py); pass
-``--local`` to skip the cross-rank pull and read only the server
-rank's registry.
+The framed requests are ``{"op": "metrics"}`` and ``{"op": "status"}``
+(service/server.py); ``--status`` prints the live attribution document
+(per-job progress, exec/queue/comm/idle split, stragglers, dagsim ETA
+— prof/liveattr.py).  Pass ``--local`` to skip the cross-rank pull and
+read only the server rank's registry.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -34,6 +40,16 @@ def scrape(host: str, port: int, aggregate: bool = True,
     if not reply.get("ok"):
         raise RuntimeError(f"scrape failed: {reply.get('error')}")
     return reply["text"]
+
+
+def scrape_status(host: str, port: int, aggregate: bool = True,
+                  timeout: float = 10.0) -> dict:
+    from parsec_tpu.service.server import request
+    reply = request(host, port, {"op": "status", "aggregate": aggregate},
+                    timeout=timeout)
+    if not reply.get("ok"):
+        raise RuntimeError(f"status failed: {reply.get('error')}")
+    return reply["status"]
 
 
 def _parse_counters(text: str):
@@ -60,6 +76,16 @@ def _parse_counters(text: str):
     return out
 
 
+def _status_filtered(doc: dict, job: int | None) -> dict:
+    if job is None:
+        return doc
+    return {**doc,
+            "jobs": [j for j in doc.get("jobs", [])
+                     if j.get("job") == job],
+            "stragglers": [e for e in doc.get("stragglers", [])
+                           if e.get("job") == job]}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -71,6 +97,14 @@ def main(argv=None) -> int:
                          "print per-second rates alongside totals")
     ap.add_argument("--grep", default="",
                     help="only print lines containing this substring")
+    ap.add_argument("--job", type=int, default=None,
+                    help="filter to one job: metric series carrying "
+                         'its job="<id>" label, or its entry in the '
+                         "--status document")
+    ap.add_argument("--status", action="store_true",
+                    help="print the live job-status document (per-job "
+                         "progress, attribution split, stragglers, "
+                         "ETA) instead of the Prometheus exposition")
     ap.add_argument("--local", action="store_true",
                     help="server rank only (skip the TAG_METRICS "
                          "cross-rank pull)")
@@ -80,9 +114,28 @@ def main(argv=None) -> int:
         from parsec_tpu.utils.mca import params
         port = int(params.get("service_port", 41990))
 
+    if args.status:
+        while True:
+            doc = _status_filtered(
+                scrape_status(args.host, port,
+                              aggregate=not args.local), args.job)
+            if args.watch > 0:
+                print(f"--- status @ {time.strftime('%H:%M:%S')} ---")
+            print(json.dumps(doc, indent=2, sort_keys=True))
+            if args.watch <= 0:
+                return 0
+            try:
+                time.sleep(args.watch)
+            except KeyboardInterrupt:
+                return 0
+
+    job_tag = None if args.job is None else f'job="{args.job}"'
+
     def emit(text: str) -> None:
         for line in text.splitlines():
             if args.grep and args.grep not in line:
+                continue
+            if job_tag and job_tag not in line:
                 continue
             print(line)
 
@@ -106,8 +159,11 @@ def main(argv=None) -> int:
             if rates:
                 print("--- rates (per second) ---")
                 for k, r in rates:
-                    if not args.grep or args.grep in k:
-                        print(f"{k} {r:.1f}/s")
+                    if args.grep and args.grep not in k:
+                        continue
+                    if job_tag and job_tag not in k:
+                        continue
+                    print(f"{k} {r:.1f}/s")
         prev, prev_t = cur, now
         try:
             time.sleep(args.watch)

@@ -7,13 +7,15 @@ the driver).  Adding a pass = adding a module here and listing it in
 
 from tools.parseclint.passes import (assert_hazard, device_put,
                                      evloop_blocking, except_hygiene,
-                                     lock_discipline, mca_knobs)
+                                     lock_discipline, mca_knobs,
+                                     prom_metrics)
 
 ALL_PASSES = (
     lock_discipline,
     evloop_blocking,
     device_put,
     mca_knobs,
+    prom_metrics,
     except_hygiene,
     assert_hazard,
 )

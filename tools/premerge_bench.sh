@@ -26,9 +26,11 @@
 # full catalog is `python tools/chaos.py --seeds 12`.
 #
 # r10 adds the TELEMETRY-OVERHEAD gate: the always-on metrics registry
-# plus an ARMED flight recorder must cost <= $telemetry_bound (default
-# 5%) on the tasks probe — an order cheaper than the causal tracer's
-# 50% gate, which is the point of the production telemetry plane.  The
+# plus an ARMED flight recorder — and, since r14, the live attribution
+# engine with straggler detection (prof/liveattr.py) — must cost
+# <= $telemetry_bound (default 5%) on the tasks probe — an order
+# cheaper than the causal tracer's 50% gate, which is the point of the
+# production telemetry plane.  The
 # measurement is bench.py's telemetry mode (four back-to-back off/on
 # pairs in one process, gating on the MINIMUM pair ratio — host-load
 # noise contaminates single pairs in either direction but a real
@@ -170,7 +172,7 @@ else
     rc=1
 fi
 rm -f "$shmout"
-echo "== premerge probe: telemetry overhead (metrics + flight recorder armed) =="
+echo "== premerge probe: telemetry overhead (metrics + flight recorder + liveattr armed) =="
 tel="/tmp/premerge_telemetry_$$.json"
 if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=telemetry \
      python "$repo/bench.py" > "$tel" 2>/dev/null; then
