@@ -888,6 +888,51 @@ def run_tracer_bench(n: int = 100000):
     return max(0.0, (traced - base) / n * 1e6)
 
 
+def run_recovery_bench():
+    """Recovery A/B (r13): one no-fault baseline (same injected frame
+    delays, no kill) plus the acceptance kill under recorded-lineage
+    MINIMAL replay and forced replay-from-restore-point
+    (tools/chaos.run_ab_pair).  Value = killed-minimal makespan over
+    the no-fault makespan — the metric of the ≤2x acceptance bound —
+    and the extras record BOTH re-execution counts: the
+    tasks_reexecuted(minimal) < tasks_reexecuted(full) delta is the
+    minimal-replay headline."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import chaos
+    from parsec_tpu.comm.launch import run_distributed
+    keys = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
+            "PARSEC_MCA_RECOVERY_ENABLE")
+    saved = {k: os.environ.get(k) for k in keys}
+    # baseline: the SAME A/B chain DAG under the same injected body
+    # delays, no kill — the ratio isolates the RECOVERY cost
+    os.environ["PARSEC_MCA_FAULT_PLAN"] = "seed=11;" + \
+        chaos._AB_PLAN.split(";", 2)[2]
+    os.environ["PARSEC_CHAOS_WAIT_S"] = "45"
+    os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
+    try:
+        t0 = time.perf_counter()
+        run_distributed(chaos.ab_chain_recover_workload, 2, timeout=90)
+        base_s = time.perf_counter() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    ab = chaos.run_ab_pair(timeout=120.0)
+    ratio = ab["minimal"]["makespan_s"] / max(base_s, 1e-9)
+    extras = {"recovery": {
+        "baseline_s": round(base_s, 2),
+        "minimal": ab["minimal"],
+        "full": ab["full"],
+        "makespan_ratio_minimal": round(ratio, 3),
+        "makespan_ratio_full": round(
+            ab["full"]["makespan_s"] / max(base_s, 1e-9), 3),
+    }}
+    return ratio, extras
+
+
 #: secondary §6 probes: mode -> (runner, metric name, unit, self-declared
 #: target, "higher is better").  Targets documented in BENCH.md.
 _AUX_MODES = {
@@ -899,6 +944,8 @@ _AUX_MODES = {
     "stencil": (run_stencil_bench, "stencil_throughput", "points/s",
                 1e8, True),
     "tracer": (run_tracer_bench, "tracer_overhead", "us/task", 1.0, False),
+    "recovery": (run_recovery_bench, "recovery_makespan_ratio", "ratio",
+                 2.0, False),
 }
 
 

@@ -63,7 +63,8 @@ TAG_HB = 13       # heartbeat (active failure detection of HUNG peers)
 TAG_METRICS = 14  # telemetry pull/push (cross-rank /metrics aggregation)
 TAG_FLIGHT = 15   # flight-recorder incident dump request (prof/flightrec)
 TAG_REJOIN = 16   # elastic-rejoin handshake of a restarted incarnation
-TAG_USER = 17     # first tag available to applications
+TAG_RECOVER = 17  # recovery control lane (dead-set agreement, replay needs)
+TAG_USER = 18     # first tag available to applications
 
 # the fault injector names tags without importing this module (it is
 # below us in the layering); a drift between the two maps would
@@ -71,7 +72,7 @@ TAG_USER = 17     # first tag available to applications
 # raise, not assert: python -O would compile the guard away
 for _name, _tag in (("ACT", TAG_ACTIVATE), ("DTD", TAG_DTD),
                     ("GET_REP", TAG_GET_REP), ("HB", TAG_HB),
-                    ("REJOIN", TAG_REJOIN)):
+                    ("REJOIN", TAG_REJOIN), ("RECOVER", TAG_RECOVER)):
     if faultinject.TAG_NAMES[_name] != _tag:
         raise RuntimeError(
             f"faultinject.TAG_NAMES[{_name!r}] drifted from "
@@ -383,6 +384,11 @@ class CommEngine:
         self._rejoin_cond = threading.Condition()
         self._rejoin_ack: Optional[dict] = None   # guarded-by: _rejoin_cond
         self.tag_register(TAG_REJOIN, self._rejoin_cb)
+        #: recovery control lane (core/recovery.py): dead-set agreement
+        #: reports/broadcasts and minimal-replay need/ack messages all
+        #: ride one tag, dispatched to the coordinator's handler
+        self.on_recover: Optional[Callable[[int, dict], None]] = None
+        self.tag_register(TAG_RECOVER, self._recover_cb)
         #: set when an injected kill_rank fired on THIS rank: its own
         #: containment must not be "recovered" into a split brain
         self.fault_killed = False
@@ -880,6 +886,19 @@ class CommEngine:
         # higher fence) must not mask a later ack from a survivor that
         # already validated us and flipped peer_rejoined — the waiter
         # keeps waiting for an ack until its timeout
+
+    # lint: on-loop (AM callback)
+    def _recover_cb(self, src: int, msg: Any) -> None:
+        """Recovery control lane: hand the message to the coordinator's
+        handler (dead-set agreement + minimal-replay needs).  Handlers
+        must not block — they store and signal only."""
+        cb = self.on_recover
+        if cb is not None and isinstance(msg, dict):
+            try:
+                cb(src, msg)
+            except Exception as exc:
+                warning("rank %d: recovery control message from %d "
+                        "failed: %s", self.rank, src, exc)
 
     def wait_rejoin_ack(self, timeout: float) -> Optional[dict]:
         """Block for a rejoin ACK (restarted-rank side); None when no
@@ -1729,7 +1748,8 @@ class SocketCE(CommEngine):
 #: frames (a termination token or GET request must not wait behind a
 #: multi-MB payload drain); a partially-written frame is never preempted
 _CTL_TAGS = frozenset((TAG_TERMDET, TAG_BARRIER, TAG_GET_REQ, TAG_UTRIG,
-                       TAG_CLOCK, TAG_HB, TAG_METRICS, TAG_FLIGHT))
+                       TAG_CLOCK, TAG_HB, TAG_METRICS, TAG_FLIGHT,
+                       TAG_RECOVER))
 
 #: receive state machine stages
 _ST_HS, _ST_HDR, _ST_BODY, _ST_BLEN, _ST_BUF = range(5)

@@ -828,6 +828,12 @@ class RemoteDepEngine:
                 "ranks": ranks,
             }
             tp.peer_ranks.update(ranks)   # containment attribution
+            lin = tp._lineage
+            if lin is not None:
+                # recovery lineage: the recorded dests seed the
+                # minimal-replay plan (tasks that fed a dead rank must
+                # re-run so the re-executed partition is re-fed)
+                lin.note_send(task, ranks)
             if self._sinks:
                 # producer identity for the causal DAG: the same oid the
                 # task_profiler's exec interval carries (forwarders keep
@@ -1498,10 +1504,20 @@ class RemoteDepEngine:
                                      coherency=Coherency.SHARED, version=1)
         from parsec_tpu.data.reshape import as_dtt, needs_reshape
         sinks = self._sinks
+        replay_filter = tp._replay_filter
         for tc_name, locs, dflow in deliveries:
             tc = tp.task_classes.get(tc_name)
             if tc is None:
                 raise RuntimeError(f"unknown task class {tc_name!r}")
+            if replay_filter is not None and \
+                    tc.make_key(tc.complete_locals(locs)) \
+                    not in replay_filter:
+                # minimal-replay restart: a re-sending peer's activation
+                # for a consumer whose output is already materialized
+                # here — the Safra credit landed at receive; the
+                # delivery itself is redundant and must not instantiate
+                # an uncounted task into the restarted generation
+                continue
             if sinks:
                 try:
                     oid = hash(tc.make_key(locs))
@@ -1543,8 +1559,28 @@ class RemoteDepEngine:
         if self._flushbox:
             self._drain_flush_window(force=True)
             return False
+        rec = getattr(ctx, "recovery", None)
+        if rec is not None and rec.busy():
+            # a queued/active restart is about to rewind a pool: the
+            # gang is NOT done, even if every counter reads zero right
+            # now (the completed-pool-grace window)
+            return False
         with ctx._lock:
             return ctx._active_taskpools == 0
+
+    def _wait_recovery_idle(self, deadline) -> None:
+        """Sole-survivor quiescence short-circuits must not outrun a
+        queued recovery restart (the multi-rank rings are covered by
+        the idle predicates; a lone rank has no ring to hold it)."""
+        rec = getattr(self.context, "recovery", None)
+        if rec is None:
+            return
+        while rec.busy():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rank {self.rank}: recovery still restarting "
+                    "pools at the quiescence deadline")
+            time.sleep(0.01)
 
     def _balance(self) -> int:
         with self._term_lock:
@@ -1680,6 +1716,16 @@ class RemoteDepEngine:
         with self._term_lock:
             self._dyn_holds.append(tp)
 
+    def rearm_dynamic_hold(self, tp) -> None:
+        """Recovery restart of a DynamicTaskpool: the pool keeps (or
+        regains) exactly one registration, so the restarted generation
+        still resolves through the pool-scoped quiescence round —
+        previously a kill with the hold outstanding stranded it across
+        the restart."""
+        with self._term_lock:
+            if tp not in self._dyn_holds:
+                self._dyn_holds.append(tp)
+
     def _dyn_idle(self) -> bool:
         """Locally drained MODULO the dynamic holds: every non-held pool
         done, every held pool at zero tasks with only its hold pending,
@@ -1695,8 +1741,18 @@ class RemoteDepEngine:
         if self._flushbox:
             self._drain_flush_window(force=True)
             return False
+        rec = getattr(ctx, "recovery", None)
+        if rec is not None and rec.busy():
+            return False   # a restart is rebuilding a held pool
         with self._term_lock:
-            holds = list(self._dyn_holds)
+            # a CONTAINED/cancelled dyn pool released its active-pool
+            # slot but its hold entry lingers: counting it would wedge
+            # the ring forever on a pool that can never drain — the
+            # stranded-hold class recovery restarts now avoid, and
+            # containment must not reintroduce
+            holds = [tp for tp in self._dyn_holds
+                     if getattr(tp, "_dyn_hold", False)
+                     and not tp.cancelled and not tp.completed]
         with ctx._lock:
             if ctx._active_taskpools != len(holds):
                 return False
@@ -1748,15 +1804,37 @@ class RemoteDepEngine:
         while not done_evt.wait(0.05):
             fatal = self.ce.dead_peers - self.ce.excused_peers
             if fatal:
+                rec = getattr(self.context, "recovery", None)
+                if rec is not None and rec.enabled:
+                    # the excusal runs on the DECLARING comm thread a
+                    # few instructions after the dead mark; this poll
+                    # can land in that window when the GIL deschedules
+                    # the declarer — give the excusal one bounded beat
+                    # before calling the death fatal (recovery-off
+                    # keeps the immediate containment)
+                    grace = time.monotonic() + 0.5
+                    while fatal and time.monotonic() < grace:
+                        time.sleep(0.01)
+                        fatal = self.ce.dead_peers - \
+                            self.ce.excused_peers
+            if fatal:
                 dead = sorted(fatal)
                 raise PeerFailedError(
                     dead[0], f"rank {self.rank}: {what} with dead "
                              f"peer(s) {dead}")
+            if not self._live_peers():
+                # sole survivor: local idle = global — once the
+                # death's queued restart finished re-arming (the
+                # completed-pool-grace race).  Checked EVERY iteration,
+                # not only on a dead-set delta: a token sent to a peer
+                # that died in the window between this ring starting
+                # and seen_dead's snapshot is lost with no delta to
+                # observe, and the ring would wait on it forever
+                self._wait_recovery_idle(deadline)
+                on_done()
+                return
             if self.ce.dead_peers != seen_dead:
                 seen_dead = set(self.ce.dead_peers)
-                if not self._live_peers():
-                    on_done()   # sole survivor: local idle = global
-                    return
                 if self.rank == self._ring_root():
                     threading.Thread(target=kick, daemon=True).start()
             if deadline is not None and time.monotonic() > deadline:
@@ -1774,7 +1852,11 @@ class RemoteDepEngine:
                 return
         if self.nranks == 1 or not self._live_peers():
             # single rank, or the sole survivor of a recovered gang:
-            # local drain IS global drain
+            # local drain IS global drain — once no restart is queued
+            # over the held pools
+            self._wait_recovery_idle(
+                None if timeout is None
+                else time.monotonic() + timeout)
             self._release_dyn_holds()
             self._dyn_released.clear()
             return
@@ -1790,7 +1872,11 @@ class RemoteDepEngine:
         ring: a recovery-excused death narrows the collective to the
         survivors; an unexcused one still fails fast."""
         if self.nranks == 1 or not self._live_peers():
-            return   # sole survivor: local idle is global idle
+            # sole survivor: local idle is global idle — but a queued
+            # recovery restart must finish re-arming first, or the
+            # caller retires/reads pools the restore is rewinding
+            self._wait_recovery_idle(time.monotonic() + timeout)
+            return
         self._drive_ring(
             self._local_idle, self._terminated, "token",
             self._terminated.set, "global termination",

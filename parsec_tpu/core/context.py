@@ -392,45 +392,55 @@ class Context:
         deadline = None if timeout is None else start + timeout
         pred = lambda: self._active_taskpools == 0 or self._errors  # noqa: E731
         while True:
-            with self._cond:
-                bounds = [t for t in (autopsy_at, deadline)
-                          if t is not None]
-                slice_s = max(0.0, min(bounds) - _time.monotonic()) \
-                    if bounds else None
-                ok = self._cond.wait_for(pred, timeout=slice_s)
-            if ok:
+            while True:
+                with self._cond:
+                    bounds = [t for t in (autopsy_at, deadline)
+                              if t is not None]
+                    slice_s = max(0.0, min(bounds) - _time.monotonic()) \
+                        if bounds else None
+                    ok = self._cond.wait_for(pred, timeout=slice_s)
+                if ok:
+                    break
+                now = _time.monotonic()
+                if autopsy_at is not None and now >= autopsy_at:
+                    from parsec_tpu.utils.output import warning
+                    warning("context wait exceeded the %.0fs soft "
+                            "deadline — hang autopsy:\n%s", autopsy_s,
+                            self.hang_autopsy())
+                    autopsy_at = None
+                if deadline is not None and now >= deadline:
+                    break
+            self._raise_first_error()
+            if not ok:
+                raise TimeoutError("parsec context wait timed out")
+            # drain accelerator pipelines: deps are released eagerly on
+            # dispatch (devices/xla.py completer), so pool termination
+            # means "all work dispatched" — quiescence means "all work
+            # done", and late device-side failures surface here
+            self.sync_devices(timeout=timeout)
+            self._raise_first_error()
+            if self.comm is None:
                 break
-            now = _time.monotonic()
-            if autopsy_at is not None and now >= autopsy_at:
-                from parsec_tpu.utils.output import warning
-                warning("context wait exceeded the %.0fs soft deadline "
-                        "— hang autopsy:\n%s", autopsy_s,
-                        self.hang_autopsy())
-                autopsy_at = None
-            if deadline is not None and now >= deadline:
-                break
-        self._raise_first_error()
-        if not ok:
-            raise TimeoutError("parsec context wait timed out")
-        # drain accelerator pipelines: deps are released eagerly on
-        # dispatch (devices/xla.py completer), so pool termination means
-        # "all work dispatched" — quiescence means "all work done", and
-        # late device-side failures surface here
-        self.sync_devices(timeout=timeout)
-        self._raise_first_error()
-        if self.comm is not None:
             # distributed: local completion is not global completion —
             # peers may still pull our data (reference: ranks keep
             # progressing comm until termdet quiesces the whole run)
             self.comm.wait_quiescence()
-            # past global quiescence every completed pool is GLOBALLY
-            # done: retire them so a later peer death cannot resurrect
-            # them for re-execution (core/recovery.py restarts only
-            # locally-complete, not-yet-retired pools)
             with self._lock:
+                if self._active_taskpools != 0 and not self._errors:
+                    # a recovery restart re-armed a pool while the
+                    # quiescence round ran (completed-pool grace): the
+                    # gang is NOT done — go back to waiting instead of
+                    # handing tiles mid-restore to the application
+                    continue
+                # past global quiescence every completed pool is
+                # GLOBALLY done: retire them so a later peer death
+                # cannot resurrect them for re-execution
+                # (core/recovery.py restarts only locally-complete,
+                # not-yet-retired pools)
                 for tp in self.taskpools.values():
                     if getattr(tp, "completed", False):
                         tp.retired = True
+            break
 
     def sync_devices(self, timeout: Optional[float] = None) -> None:
         """Quiesce accelerator pipelines (shared by wait() and the job

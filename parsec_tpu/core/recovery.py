@@ -8,24 +8,38 @@ This module adds the second exit from every containment path — RECOVER:
   1. **Lineage re-execution.**  When ``declare_peer_dead`` fires, the
      surviving ranks reconstruct the dead rank's lost tiles instead of
      failing the pool.  Each survivor deterministically computes the
-     same recovery decision (coordinator = lowest surviving rank, but
-     the per-rank work needs no election round: translation targets and
-     partitions are pure functions of the dead set), rewinds the
-     affected pool's termdet counters (``taskpool_reset``), restores the
-     pool's collections to their last surviving version — the
-     registration-time snapshot, or the collection's re-runnable source
-     (``DataCollection.set_init``) for tiles whose only copy died with
-     their rank — and re-inserts the re-execution sub-DAG on the
-     survivors (``ParameterizedTaskpool.startup`` re-enumeration with
-     translated owner-computes, or the pool's ``recovery_replay`` for
-     insert-driven DTD pools).  ``lineage_plan`` below is the exact
-     minimal-set walk over a recorded lineage; the end-to-end restart
-     is deliberately CONSERVATIVE — it replays the pool's whole local
-     partition from the restore point, because in-place tile mutation
-     means a partial replay is only sound from a globally consistent
-     cut (which the registration snapshot / checkpoint shard is, and
-     arbitrary mid-run tile states are not).  The ≤2x-makespan
-     acceptance bound is the bound of exactly this policy.
+     same recovery decision (coordinator = lowest surviving rank, with
+     an AGREEMENT ROUND below converging the dead-set view), rewinds
+     the affected pool's termdet counters (``taskpool_reset``),
+     restores what the replay needs — the registration-time snapshot,
+     an incremental tile checkpoint
+     (``utils/checkpoint.TileCheckpointStore``), or the collection's
+     re-runnable source (``DataCollection.set_init``) for tiles whose
+     only copy died with their rank — and re-inserts the re-execution
+     sub-DAG on the survivors (``ParameterizedTaskpool.startup``
+     re-enumeration with translated owner-computes, or the pool's
+     ``recovery_replay`` for insert-driven DTD pools).
+
+     **Minimal replay** (``recovery_minimal``, default on): every pool
+     with the lineage plane armed keeps a RECORDED per-task lineage
+     ring (``Taskpool._lineage``: task key, write-flow tile versions,
+     read versions, remote activation dests — recorded at
+     ``complete_execution`` off the release path), and the restart
+     re-executes only the LOST SET: the whole adopted dead partition
+     (its log died with it), the survivor's not-yet-completed tasks,
+     and the recorded backward closure of everything that must re-feed
+     the dead partition's replay — ``minimal_plan`` below computes it
+     from the RECORDED (not re-derived) edges, with the replay cut
+     always landing on a checkpointed, snapshotted, or live-intact
+     version.  Skipped tasks' deliveries are synthesized from those
+     materialized versions; cross-survivor re-feeds negotiate over the
+     TAG_RECOVER control lane (a peer that cannot honor a need nacks
+     and both sides fall back).  Replay-from-restore-point stays the
+     fallback — taken whenever the lineage ring evicted the cut, the
+     pool is insert-driven/dynamic, or a need was refused — counted in
+     ``parsec_recovery_full_replays_total``.  The ≤2x-makespan
+     acceptance bound is the bound of the FALLBACK policy; minimal
+     replay's headline is the ``parsec_tasks_reexecuted_total`` delta.
 
   2. **Partition re-mapping.**  The dead rank's key range re-balances
      onto survivors through a rank-translation table installed PER
@@ -58,19 +72,29 @@ after re-insertion.
 Everything here is OPT-IN (``recovery_enable``, default 0): disabled,
 every path reproduces PR 5's containment behavior exactly.
 
-Known limits (documented, structured-failure fallbacks): DynamicTaskpool
-(PTG ``%option dynamic``) pools, pools whose collections lack both a
-snapshot and an ``init_fn`` for the adopted tiles, cancelled pools, and
-a rank's own injected death are not recovered; rejoin is supported on
-the socket transports (threads/evloop) — an shm receiver unlinks its
-rings at death, so a restarted shm rank needs a fresh gang instead.
-Under NEAR-SIMULTANEOUS multi-rank deaths, survivors whose detectors
-fire in different orders transiently compute divergent translation
-tables (each is a pure function of that survivor's dead SET, which
-converges as detections land); a restart run against the stale view
-can address a just-dead adopter, fail contained, and burn one
-``recovery_max_attempts`` slot before the next event re-normalizes —
-bounded, never silent, but a true agreement round is future work.
+Agreement round (TAG_RECOVER): before computing the translation table,
+every survivor converges its dead-set view with the coordinator —
+non-coordinators report their observed deaths and wait (bounded,
+``recovery_agree_timeout_s``) for the coordinator's CONFIRMED excusal
+broadcast; the coordinator coalesces reports for
+``recovery_agree_window_s`` and broadcasts the union, and a receiver
+learning of a death it has not detected yet declares it immediately.
+Near-simultaneous multi-deaths therefore land every survivor on the
+SAME dead set (and the same wholesale-recomputed table) instead of
+transiently divergent ones; only a coordinator that dies mid-round
+degrades to the old bounded behavior (the waiter times out and
+proceeds with its local view — never silent, one
+``recovery_max_attempts`` slot at worst).
+
+Known limits (documented, structured-failure fallbacks): pools whose
+collections lack both a snapshot and an ``init_fn`` for the adopted
+tiles, cancelled pools, and a rank's own injected death are not
+recovered.  DynamicTaskpool pools recover with a FULL replay (their
+discovered DAG has no enumeration to filter) and re-arm their
+distributed termination hold across the restart.  Rejoin is supported
+on all three transports — the shm survivor re-creates its unlinked
+inbound rings when the death is declared, so a restarted incarnation's
+TAG_REJOIN handshake finds fresh rings (comm/shm.py).
 """
 
 from __future__ import annotations
@@ -116,6 +140,38 @@ params.register("recovery_completed_grace_s", 30.0,
                 "global), past it the pool's recovery spec and tile "
                 "snapshots are evicted, so a resident service's job "
                 "history is never resurrected or leaked")
+params.register("recovery_lineage", 1,
+                "record the per-task lineage ring (task key, write-flow "
+                "tile versions, read versions, remote dests) at "
+                "complete_execution for every registered pool — the "
+                "recorded edges minimal replay walks.  0 disables "
+                "recording AND minimal replay (needs recovery_enable)")
+params.register("recovery_lineage_ring", 8192,
+                "per-pool bound on lineage records and completed-key "
+                "tracking; a pool whose completions exceed it falls "
+                "back to replay-from-restore-point on the next death "
+                "(counted in parsec_recovery_full_replays_total)")
+params.register("recovery_minimal", 1,
+                "re-execute only the recorded-lineage minimal set on a "
+                "peer death (adopted partition + pending tasks + the "
+                "backward closure re-feeding them) instead of the "
+                "whole local partition.  Falls back to the full "
+                "restore-point replay whenever the plan is infeasible "
+                "(ring evicted, no snapshot for an exact-version cut, "
+                "a peer nacked a re-feed need, dynamic/insert-driven "
+                "pool)")
+params.register("recovery_agree_window_s", 0.25,
+                "coordinator-side coalescing window of the dead-set "
+                "agreement round: death reports arriving within it "
+                "merge into ONE confirmed excusal broadcast, so "
+                "near-simultaneous multi-deaths cannot transiently "
+                "diverge survivors' translation tables")
+params.register("recovery_agree_timeout_s", 3.0,
+                "how long a non-coordinator survivor waits for the "
+                "confirmed dead-set broadcast (and a minimal-replay "
+                "requester for its need acks) before proceeding with "
+                "its local view / full replay — the bounded fallback "
+                "when the coordinator itself died mid-round")
 
 
 class RecoveryUnsupported(RuntimeError):
@@ -131,16 +187,117 @@ class RecoveryUnsupported(RuntimeError):
 class LineageRecord:
     """One completed task in a lineage log: the tile versions it read
     and the tile versions it produced (versions are per-tile monotone,
-    the datum version-clock discipline)."""
+    the datum version-clock discipline).  ``rmap``/``wmap`` key the
+    same pairs by FLOW NAME (minimal replay synthesizes per-flow
+    deliveries from them); ``dests`` are the remote ranks this task's
+    activations reached (the minimal-plan seeds); ``seq`` is the
+    recording order — for DTD pools the insert-stream position rides
+    in the key's tid, so the record doubles as insert-stream lineage."""
 
-    __slots__ = ("key", "reads", "writes")
+    __slots__ = ("key", "reads", "writes", "dests", "rmap", "wmap",
+                 "seq")
 
     def __init__(self, key: Any,
                  reads: List[Tuple[Any, int]] = (),
-                 writes: List[Tuple[Any, int]] = ()):
+                 writes: List[Tuple[Any, int]] = (),
+                 dests=(), rmap: Optional[Dict] = None,
+                 wmap: Optional[Dict] = None, seq: int = -1):
         self.key = key
         self.reads = list(reads)
         self.writes = list(writes)
+        self.dests = frozenset(dests)
+        self.rmap = dict(rmap or {})
+        self.wmap = dict(wmap or {})
+        self.seq = seq
+
+
+class LineageLog:
+    """Ring-bounded per-pool lineage (``Taskpool._lineage``): appended
+    by worker threads at ``complete_execution`` (deque append + set add
+    under the GIL — no lock round-trips beyond what termdet already
+    takes), read by the recovery thread AFTER the run_epoch fence
+    drained every in-flight body.  ``overflow`` latches once the ring
+    or the completed-key set exceeds its cap: the recorded view is no
+    longer complete, so the next restart takes the full-replay
+    fallback instead of planning from a truncated log."""
+
+    __slots__ = ("cap", "records", "completed", "overflow", "_sends",
+                 "ckpt")
+
+    def __init__(self, cap: int, ckpt=None):
+        self.cap = max(16, int(cap))
+        self.records: deque = deque(maxlen=self.cap)
+        self.completed: set = set()
+        self.overflow = False
+        #: id(task) -> remote dests noted by flush_activations while
+        #: the task's release path runs (same worker thread records)
+        self._sends: Dict[int, set] = {}
+        #: incremental checkpoint store (utils/checkpoint.py), shared
+        #: across the context's pools; None = capture plane off
+        self.ckpt = ckpt
+
+    def note_send(self, task, ranks) -> None:
+        s = self._sends.get(id(task))
+        if s is None:
+            self._sends[id(task)] = s = set()
+        s.update(ranks)
+
+    def snap_reads(self, task) -> Dict[str, Tuple[Any, int]]:
+        """Per-flow (tile, version) of every collection-backed input —
+        taken BEFORE complete_write bumps the clocks, so an RW flow
+        records the version the body actually consumed."""
+        rmap: Dict[str, Tuple[Any, int]] = {}
+        for flow in task.task_class._in_flows:
+            copy = task.data.get(flow.name)
+            if copy is None:
+                continue
+            d = copy.data
+            if d is not None and d.collection is not None:
+                rmap[flow.name] = (d.key, copy.version)
+        return rmap
+
+    def record(self, task, rmap) -> None:
+        wmap: Dict[str, Tuple[Any, int]] = {}
+        for flow in task.task_class._write_flows:
+            copy = task.data.get(flow.name)
+            if copy is None or copy.data is None:
+                continue
+            d = copy.data
+            if d.collection is None:
+                continue   # arena/NEW temporaries are not tile lineage
+            ver = d.newest_version()
+            wmap[flow.name] = (d.key, ver)
+            ckpt = self.ckpt
+            if ckpt is not None:
+                host = d.copy_on(0)
+                if host is not None and host.payload is not None \
+                        and host.version == ver:
+                    # captures key by (collection identity, tile): a
+                    # later job's same-NAMED collection must never be
+                    # served this job's bytes as a replay cut
+                    ckpt.note_write((id(d.collection), d.key), ver,
+                                    host.payload)
+        dests = self._sends.pop(id(task), None)
+        if len(self.completed) >= self.cap or \
+                len(self.records) >= self.cap:
+            self.overflow = True   # a truncated log cannot plan
+            return
+        self.completed.add(task.key)
+        self.records.append(LineageRecord(
+            task.key, reads=list((rmap or {}).values()),
+            writes=list(wmap.values()), dests=dests or (),
+            rmap=rmap, wmap=wmap, seq=task.seq))
+        if len(self.records) < len(self.completed):
+            # two workers raced the cap guard and the bounded deque
+            # silently evicted a record: the log is incomplete — latch,
+            # or the planner would trust a truncated view
+            self.overflow = True
+
+    def clear(self) -> None:
+        self.records.clear()
+        self.completed.clear()
+        self._sends.clear()
+        self.overflow = False
 
 
 def lineage_plan(log: List[LineageRecord],
@@ -188,6 +345,174 @@ def lineage_plan(log: List[LineageRecord],
     return [log[i].key for i in sorted(chosen)], base
 
 
+class ReplayPlan:
+    """Output of :func:`minimal_plan`: the re-execution set, the tile
+    versions the restore must rewind to, the deliveries to synthesize
+    for edges whose producer is skipped, and the cross-survivor
+    re-feed needs to negotiate."""
+
+    __slots__ = ("tasks", "base", "synth", "needs")
+
+    def __init__(self):
+        self.tasks: set = set()
+        #: tile -> version the restore rewinds it to (desc-read cuts)
+        self.base: Dict[Any, int] = {}
+        #: (consumer_key, consumer_flow, tile|None, version, producer_key)
+        self.synth: List[Tuple] = []
+        #: (peer_rank, consumer_key, consumer_flow)
+        self.needs: List[Tuple[int, Any, str]] = []
+
+
+def minimal_plan(records, *, dead_set, pending=(), adopted=(),
+                 live=None, materializable=None, edges=None,
+                 extra_seeds=()) -> ReplayPlan:
+    """The recorded-lineage minimal re-execution set for ONE rank.
+
+    Starts from the lost work — the whole adopted dead partition (its
+    log died with it), the local not-yet-completed tasks, and every
+    recorded task whose activations reached a dead rank (``dests`` —
+    the dead partition's replay must be re-fed) — then walks the
+    RECORDED edges backward: a task-fed input whose (tile, version) is
+    still materializable (live-intact, checkpointed, or snapshotted —
+    the replay cut) becomes a synthesized delivery; otherwise its
+    recorded producer joins the set.  Soundness against in-place tile
+    mutation: a re-run writer that would regress a tile below its live
+    version pulls every recorded LATER writer in (their re-executed
+    writebacks reproduce the final state), and a collection-direct
+    (desc) read rewinds its tile to the pool-attach snapshot version.
+    Cross-survivor edges become ``needs`` the caller negotiates.
+
+    ``edges(key)`` yields the structural task-fed/desc input edges of
+    one task (the coordinator derives them from the task classes; unit
+    tests pass a dict lookup):
+
+    * ``("task", producer_key, producer_flow, consumer_flow, where,
+      is_ctl)`` with ``where`` in ``"local"`` / ``"dead"`` /
+      ``("peer", rank)``
+    * ``("desc", tile, snapshot_version)``
+
+    Raises :class:`RecoveryUnsupported` when the recorded view cannot
+    prove the plan sound (evicted producer record, unrecorded later
+    writer, no exact-version cut) — the caller then takes the full
+    restore-point replay.
+    """
+    live = dict(live or {})
+    mat = {t: set(v) for t, v in (materializable or {}).items()}
+    by_key = {r.key: r for r in records}
+    writers: Dict[Any, List[Tuple[int, Any]]] = {}
+    for r in records:
+        for t, v in r.writes:
+            writers.setdefault(t, []).append((v, r.key))
+    for lst in writers.values():
+        lst.sort(key=lambda p: p[0])
+
+    plan = ReplayPlan()
+    work: deque = deque()
+
+    def join(key):
+        if key not in plan.tasks:
+            plan.tasks.add(key)
+            work.append(key)
+
+    for k in pending:
+        join(k)
+    for k in adopted:
+        join(k)
+    for k in extra_seeds:
+        join(k)
+    for r in records:
+        if r.dests & dead_set:
+            join(r.key)
+
+    def usable(tile, ver) -> bool:
+        return ver == live.get(tile) or ver in mat.get(tile, ())
+
+    def join_later_writers(tile, after: int) -> None:
+        """The tile's content will regress below its live version:
+        every recorded later writer re-runs so the re-executed
+        writeback chain reproduces the final state."""
+        lst = writers.get(tile, ())
+        lv = live.get(tile)
+        if lv is not None and lv > after:
+            covered = max((v for v, _k in lst), default=-1)
+            if covered < lv:
+                raise RecoveryUnsupported(
+                    f"minimal replay: the writer of {tile!r} v{lv} is "
+                    "not in the recorded lineage")
+        for v, k in lst:
+            if v > after:
+                join(k)
+
+    synth_seen: set = set()
+    while work:
+        key = work.popleft()
+        rec = by_key.get(key)
+        if rec is not None:
+            for tile, ver in rec.writes:
+                if live.get(tile, ver) > ver:
+                    join_later_writers(tile, ver)
+        if edges is None:
+            continue
+        for edge in edges(key):
+            if edge[0] == "desc":
+                _kind, tile, snap_ver = edge
+                lv = live.get(tile)
+                if lv is None:
+                    continue   # tile not materialized here (external)
+                if snap_ver is None:
+                    raise RecoveryUnsupported(
+                        f"minimal replay: no snapshot version for a "
+                        f"desc read of {tile!r} (recovery_snapshot=0?)")
+                if lv != snap_ver:
+                    if snap_ver not in mat.get(tile, ()):
+                        raise RecoveryUnsupported(
+                            f"minimal replay: desc read of {tile!r} "
+                            f"needs v{snap_ver}, which is not "
+                            "materializable")
+                    prev = plan.base.get(tile)
+                    if prev is None or snap_ver < prev:
+                        plan.base[tile] = snap_ver
+                    join_later_writers(tile, snap_ver)
+                continue
+            _kind, pkey, pflow, cflow, where, ctl = edge
+            if where == "dead":
+                continue   # re-fed by the dead partition's replay
+            if isinstance(where, tuple):
+                plan.needs.append((where[1], key, cflow))
+                continue
+            if pkey in plan.tasks:
+                continue   # natural re-delivery
+            prec = by_key.get(pkey)
+            if prec is None:
+                # no record and not pending/planned: the ring evicted
+                # the producer — the recorded view is incomplete
+                raise RecoveryUnsupported(
+                    f"minimal replay: producer {pkey!r} of {key!r} has "
+                    "no lineage record (ring evicted?)")
+            sk = (key, cflow, pkey)
+            if ctl:
+                if sk not in synth_seen:
+                    synth_seen.add(sk)
+                    plan.synth.append((key, cflow, None, 0, pkey))
+                continue
+            crec = by_key.get(key)
+            tv = crec.rmap.get(cflow) if crec is not None else None
+            if tv is None:
+                tv = prec.wmap.get(pflow)
+            if tv is not None and usable(*tv):
+                if sk not in synth_seen:
+                    synth_seen.add(sk)
+                    plan.synth.append((key, cflow, tv[0], tv[1], pkey))
+                continue
+            join(pkey)
+
+    # a producer that joined AFTER one of its edges chose synthesis
+    # now re-delivers naturally: drop the synth twin or the consumer's
+    # arrival count overshoots
+    plan.synth = [s for s in plan.synth if s[4] not in plan.tasks]
+    return plan
+
+
 # ---------------------------------------------------------------------------
 # the coordinator
 # ---------------------------------------------------------------------------
@@ -210,7 +535,48 @@ class RecoveryCoordinator:
         self.drain_s = float(params.get("recovery_drain_s", 10.0))
         self.completed_grace = float(
             params.get("recovery_completed_grace_s", 30.0))
+        self.lineage_on = bool(int(params.get("recovery_lineage", 1)))
+        self.lineage_cap = int(params.get("recovery_lineage_ring", 8192))
+        self.minimal_on = bool(int(params.get("recovery_minimal", 1)))
+        self.agree_window = float(
+            params.get("recovery_agree_window_s", 0.25))
+        self.agree_timeout = float(
+            params.get("recovery_agree_timeout_s", 3.0))
+        #: incremental tile checkpoint store (utils/checkpoint.py),
+        #: shared by every registered pool's lineage hook; None = the
+        #: capture plane is off (interval 0, the default)
+        self.ckpt = None
+        ck_interval = float(
+            params.get("recovery_checkpoint_interval_s", 0.0))
+        if ck_interval > 0:
+            from parsec_tpu.utils.checkpoint import TileCheckpointStore
+            self.ckpt = TileCheckpointStore(
+                ck_interval,
+                int(params.get("recovery_checkpoint_keep", 2)))
         self._lock = threading.Lock()
+        #: TAG_RECOVER control-lane state: dead-set agreement reports/
+        #: confirmations and minimal-replay need bookkeeping
+        #: (guarded-by: _ctl_cond)
+        self._ctl_cond = threading.Condition()
+        self._agree_reports: Dict[int, set] = {}
+        self._agree_confirmed: set = set()
+        #: taskpool_id -> "open" | "frozen" | "full" (minimal-replay
+        #: plan lifecycle; a need arriving on a frozen plan nacks)
+        self._plan_state: Dict[int, str] = {}
+        #: taskpool_id -> producer keys peers asked this rank to
+        #: include in its replay set
+        self._extra_seeds: Dict[int, set] = {}
+        #: (taskpool_id, peer) -> ack verdict of our need request
+        self._need_acks: Dict[Tuple[int, int], bool] = {}
+        #: (taskpool_id, peer) -> (round, mode) — the mode-agreement
+        #: votes, stamped with the voter's restart-attempt round so a
+        #: stale round's ballot can never satisfy (or poison) the
+        #: current agreement
+        self._peer_modes: Dict[Tuple[int, int], Tuple[int, str]] = {}
+        #: taskpool_id -> (round, mode) this rank itself declared —
+        #: replayed to late voters so an early committer's exit from
+        #: the agreement wait cannot strand them into a timeout
+        self._my_mode: Dict[int, Tuple[int, str]] = {}
         self._rde = None               # RemoteDepEngine (attach_comm)
         #: taskpool_id -> {"tp", "collections", "replay"}
         #: (guarded-by: _lock)
@@ -245,6 +611,10 @@ class RecoveryCoordinator:
         self.counts = {"started": 0, "completed": 0, "failed": 0}
         self.tasks_reexecuted = 0
         self.rejoins = 0
+        #: restart-policy split: minimal (recorded-lineage plan) vs
+        #: full (replay-from-restore-point fallback) pool restarts
+        self.minimal_replays = 0
+        self.full_replays = 0
         from parsec_tpu.prof.metrics import Histogram
         self.duration_hist = Histogram()
         m = getattr(context, "metrics", None)
@@ -257,6 +627,7 @@ class RecoveryCoordinator:
         handshake and let the transport accept reconnections from dead
         ranks (the recovery knob gates it)."""
         self._rde = rde
+        rde.ce.on_recover = self._on_recover_msg
         if int(params.get("recovery_rejoin", 1)):
             rde.ce.rejoin_allowed = True
             rde.ce.on_rejoin = self.on_rejoin_request
@@ -290,19 +661,30 @@ class RecoveryCoordinator:
                 "completed_at": None}
         if collections:
             tp.on_complete(self._pool_done)
+            if self.lineage_on:
+                # arm the recorded lineage ring (the minimal-replay
+                # evidence; complete_execution's hook is a None check
+                # for every unregistered pool)
+                tp._lineage = LineageLog(self.lineage_cap,
+                                         ckpt=self.ckpt)
         snaps = []
         if collections and self.snapshot_on:
             for dc in collections:
                 if not hasattr(dc, "local_tiles"):
                     continue
-                snap: Dict[Tuple, np.ndarray] = {}
+                #: idx -> (version at snapshot, payload copy) — the
+                #: version stamp names this cut in the lineage planner
+                snap: Dict[Tuple, Tuple[int, np.ndarray]] = {}
                 try:
                     for idx in dc.local_tiles():
                         idx = tuple(idx) if isinstance(idx, (tuple, list)) \
                             else (idx,)
-                        copy = dc.data_of(*idx).pull_to_host()
+                        datum = dc.data_of(*idx)
+                        copy = datum.pull_to_host()
                         if copy is not None and copy.payload is not None:
-                            snap[idx] = np.array(copy.payload, copy=True)
+                            snap[idx] = (datum.newest_version(),
+                                         np.array(copy.payload,
+                                                  copy=True))
                 except Exception as exc:
                     warning("recovery: snapshot of %s failed (%s); "
                             "relying on init_fn", dc.name, exc)
@@ -333,6 +715,8 @@ class RecoveryCoordinator:
         pool objects and snapshot bytes, nor resurrect ancient jobs on
         a peer death.  Caller holds _lock."""
         now = time.monotonic()
+        evicted: List[int] = []
+        evicted_dcs: set = set()
         for tpid in list(self._specs):
             spec = self._specs[tpid]
             tp = spec["tp"]
@@ -343,11 +727,35 @@ class RecoveryCoordinator:
             if stale and tpid not in self._active:
                 del self._specs[tpid]
                 self._attempts.pop(tpid, None)
+                evicted.append(tpid)
+                evicted_dcs.update(id(dc) for dc in spec["collections"])
+        if evicted:
+            # the TAG_RECOVER control state retires with the spec — a
+            # resident service must not accumulate per-restart entries
+            # (safe nesting: _ctl_cond is never held while taking _lock)
+            with self._ctl_cond:
+                for tpid in evicted:
+                    self._plan_state.pop(tpid, None)
+                    self._extra_seeds.pop(tpid, None)
+                    for kk in [kk for kk in self._need_acks
+                               if kk[0] == tpid]:
+                        del self._need_acks[kk]
+                    for kk in [kk for kk in self._peer_modes
+                               if kk[0] == tpid]:
+                        del self._peer_modes[kk]
         live_dcs = {id(dc) for spec in self._specs.values()
                     for dc in spec["collections"]}
         for key in [k for k in self._snaps if k not in live_dcs]:
             self._snaps.pop(key, None)
             self._snap_dcs.pop(key, None)
+        if self.ckpt is not None:
+            # the incremental captures retire WITH the spec — keyed on
+            # the EVICTED specs' collections, not on _snaps, so the
+            # recovery_snapshot=0 configuration still evicts: a
+            # resident service must not accumulate captures, and a
+            # gc-recycled collection identity must start clean
+            for key in evicted_dcs - live_dcs:
+                self.ckpt.drop_owner(key)
 
     # -- containment hand-off (comm thread; must not block) --------------
     def on_peer_dead(self, rank: int, exc: Exception,
@@ -410,12 +818,14 @@ class RecoveryCoordinator:
                 replayable = spec is not None and (
                     spec["replay"] is not None
                     or isinstance(tp, ParameterizedTaskpool))
+                # DynamicTaskpool pools (incl. distributed ones holding
+                # a _dyn_hold) recover too: startup() re-seeds the
+                # discovery roots and _restart_pool re-arms the hold
                 ok = (spec is not None and spec["collections"]
                       and replayable
                       and not tp.cancelled
                       and not getattr(tp, "retired", False)
                       and not getattr(tp, "_compound_member", False)
-                      and not getattr(tp, "_dyn_hold", False)
                       and hasattr(tp.termdet, "taskpool_reset")
                       and self._attempts.get(tp.taskpool_id, 0)
                       < self.max_attempts)
@@ -435,6 +845,19 @@ class RecoveryCoordinator:
                                           daemon=True)
                 self._worker = worker
                 worker.start()
+        with self._ctl_cond:
+            # a PREVIOUS restart left this pool's plan state "frozen":
+            # reset it the moment the death is accepted, or a faster
+            # peer's re-feed needs for THIS event get spuriously
+            # nacked against the stale state (silently degrading every
+            # death after the first to full replay).  Seeds promised
+            # in an earlier event whose restart took the full path
+            # (never popped) must not leak into this event's plan
+            # either — a full replay honored them by re-running
+            # everything
+            for tp_ in take:
+                self._plan_state.pop(tp_.taskpool_id, None)
+                self._extra_seeds.pop(tp_.taskpool_id, None)
         # excuse SYNCHRONOUSLY, on the declaring thread: a survivor
         # polling wait_quiescence every 50 ms must never observe
         # dead-but-not-yet-excused in the window before the recovery
@@ -451,6 +874,18 @@ class RecoveryCoordinator:
                 len(take), len(leave))
         self._notify_services("start", rank)
         return True, leave
+
+    def busy(self) -> bool:
+        """A death was accepted, an event is queued, or a restart is
+        mid-flight.  Global-quiescence deciders (Safra ring idle
+        predicates, the sole-survivor short-circuits) consult this:
+        declaring the gang done over a pool a queued restart is about
+        to rewind would hand Context.wait back to the application
+        while the restore overwrites the very tiles it then reads —
+        the completed-pool-grace race the chaos smoke caught."""
+        with self._lock:
+            return bool(self._events or self._active
+                        or self._pending_dead)
 
     def recovering(self, tp) -> bool:
         """Is a recovery restart pending/active for this pool?  The
@@ -520,12 +955,19 @@ class RecoveryCoordinator:
         # balance reflects live traffic only
         ce.excuse_peer(rank)
         rde.recovery_reconcile(rank)
+        # AGREEMENT ROUND (TAG_RECOVER): converge the dead-set view
+        # with the coordinator before any table is computed, so
+        # near-simultaneous multi-deaths land every survivor on the
+        # same set instead of transiently divergent ones (the round is
+        # bounded — a dead coordinator degrades to the local view)
+        observed = (set(ce.dead_peers) | {rank}) - {ce.rank}
+        agreed = self._agree_dead_set(observed)
         # the translation recomputes WHOLESALE from the dead SET (not
         # incrementally from event order): two survivors detecting two
         # near-simultaneous deaths in opposite order must still land on
         # the same table, and a chained adopter death (1->2, then 2
         # dies) must collapse onto a live rank
-        dead_set = (set(ce.dead_peers) | {rank}) - {ce.rank}
+        dead_set = (set(ce.dead_peers) | observed | agreed) - {ce.rank}
         survivors = sorted(r for r in range(ce.nranks)
                            if r not in dead_set)
         if not survivors:
@@ -594,6 +1036,27 @@ class RecoveryCoordinator:
                 if dc not in self._translated:
                     self._translated.append(dc)
         tp.rank_translation = dead_map
+        dead_set = set(dead_map)
+        tpid = tp.taskpool_id
+        # minimal replay applies to enumerable PTG pools with a
+        # complete lineage ring; everything else (insert-driven,
+        # dynamic discovery, evicted/disabled ring) takes the
+        # restore-point fallback
+        want_minimal = (self.minimal_on and self.lineage_on
+                        and spec["replay"] is None
+                        and not getattr(tp, "dynamic", False)
+                        and isinstance(tp, ParameterizedTaskpool)
+                        and tp._lineage is not None
+                        and not tp._lineage.overflow)
+        with self._ctl_cond:
+            # (stale votes need no purge here: ballots carry the
+            # restart-attempt round, and _agree_mode matches rounds —
+            # purging instead would delete a FASTER peer's
+            # current-round vote and split the gang's modes)
+            self._plan_state[tpid] = "open" if want_minimal else "full"
+        if not want_minimal:
+            self._broadcast_mode(tpid, False)
+        rplan = synth = base_restores = None
         try:
             # pre-flight: every tile this rank now owns must have a
             # restore source — check BEFORE tearing runtime state down
@@ -614,6 +1077,36 @@ class RecoveryCoordinator:
                 debug_verbose(2, "recovery device sync: %s", exc)
             # comm: drop the torn generation's parked/queued state
             rde.forget_pool(tp)
+            if want_minimal:
+                # the lineage is stable now (fence + drain): compute
+                # the recorded minimal plan, negotiate cross-survivor
+                # re-feeds, and capture every synthesis/rewind payload
+                # BEFORE any tile is overwritten
+                try:
+                    rplan = self._plan_minimal(tp, spec, dead_set)
+                    synth, base_restores = \
+                        self._materialize_plan(tp, spec, rplan)
+                    # MODE AGREEMENT: commit to minimal only when every
+                    # live survivor voted minimal too — a full-replaying
+                    # peer sends no re-feed needs, and skipping its
+                    # producers would strand its re-enumeration forever
+                    self._broadcast_mode(tpid, True)
+                    if not self._agree_mode(tpid):
+                        debug_verbose(1, "rank %d: pool %d minimal "
+                                      "replay fell back (a peer took "
+                                      "full replay)", ctx.rank, tpid)
+                        rplan = None
+                        with self._ctl_cond:
+                            self._plan_state[tpid] = "full"
+                        self._broadcast_mode(tpid, False)
+                except RecoveryUnsupported as why:
+                    debug_verbose(1, "rank %d: pool %d minimal replay "
+                                  "fell back to restore-point (%s)",
+                                  ctx.rank, tpid, why)
+                    rplan = None
+                    with self._ctl_cond:
+                        self._plan_state[tpid] = "full"
+                    self._broadcast_mode(tpid, False)
             # termdet rewind.  force_terminated: a pool that completed
             # LOCALLY (its partition drained before the kill) must
             # still restart — the adopter's re-executed activations
@@ -632,10 +1125,29 @@ class RecoveryCoordinator:
                     ctx._active_taskpools += 1
                 tp._done_event.clear()
             tp.termdet.taskpool_addto_runtime_actions(tp, 1)  # startup
+            if getattr(tp, "_dyn_hold", False):
+                # a DynamicTaskpool's distributed termination hold was
+                # zeroed with the counters: re-take it (and keep the
+                # comm layer's registration) so the restarted pool
+                # still resolves through the pool-scoped Safra round
+                # instead of stranding resolve_dynamic_holds
+                tp.termdet.taskpool_addto_runtime_actions(tp, 1)
+                rde.rearm_dynamic_hold(tp)
             tp.recovery_reset()
-            # restore the last surviving version of every owned tile
-            for dc, idx, arr in plan:
-                dc.data_of(*idx).overwrite_host(np.asarray(arr))
+            if rplan is not None:
+                # minimal: restore the adopted partition (its versions
+                # died with the rank) and the planned rewinds only —
+                # every other local tile keeps its live final state
+                tp._replay_filter = set(rplan.tasks)
+                for dc, idx, arr in plan:
+                    if dc.rank_of(*idx) in dead_set:
+                        dc.data_of(*idx).overwrite_host(np.asarray(arr))
+                for dc, idx, arr in base_restores:
+                    dc.data_of(*idx).overwrite_host(np.asarray(arr))
+            else:
+                # restore the last surviving version of every owned tile
+                for dc, idx, arr in plan:
+                    dc.data_of(*idx).overwrite_host(np.asarray(arr))
         except Exception:
             # anything failing BEFORE the restore finished leaves the
             # adopted partition unrestored: roll the translation back
@@ -650,9 +1162,21 @@ class RecoveryCoordinator:
             n = max(int(tp.nb_tasks), 0)
         else:
             ready = tp.startup()
+            if rplan is not None and synth:
+                # deliveries whose producers are skipped: hand the
+                # materialized versions straight to the dep countdown
+                ready.extend(self._deliver_synth(tp, synth))
             n = max(int(tp.nb_tasks), 0)
             if ready:
                 scheduling.schedule(ctx.streams[0], ready)
+        if rplan is not None:
+            self.minimal_replays += 1
+            debug_verbose(1, "rank %d: pool %d MINIMAL replay: %d "
+                          "task(s), %d synthesized edge(s), %d "
+                          "rewound tile(s)", ctx.rank, tpid, n,
+                          len(synth), len(base_restores))
+        else:
+            self.full_replays += 1
         tp.ready()
         with self._lock:
             self._active.discard(tp.taskpool_id)
@@ -663,6 +1187,521 @@ class RecoveryCoordinator:
         if drain is not None and hasattr(tp, "_dtd_incoming"):
             drain(tp)
         return n
+
+    # -- dead-set agreement + replay-need negotiation (TAG_RECOVER) ------
+    def _agree_dead_set(self, observed: set) -> set:
+        """Converge this survivor's dead-set view with the coordinator
+        (lowest live rank).  The coordinator coalesces reports for
+        ``recovery_agree_window_s`` and broadcasts the CONFIRMED union;
+        everyone else reports and waits (bounded) for a broadcast
+        covering its observation.  Returns the agreed set; on timeout
+        (coordinator died mid-round) the local view — bounded, never
+        silent."""
+        rde = self._rde
+        if rde is None:
+            return set(observed)
+        ce = rde.ce
+        me = ce.rank
+        live = [r for r in range(ce.nranks)
+                if r != me and r not in ce.dead_peers
+                and r not in observed]
+        if not live:
+            return set(observed)   # sole survivor: nothing to agree
+        from parsec_tpu.comm.engine import TAG_RECOVER
+        coord = min([me] + live)
+        if coord == me:
+            deadline = time.monotonic() + self.agree_window
+            with self._ctl_cond:
+                while True:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._ctl_cond.wait(left)
+                reported = set()
+                for s in self._agree_reports.values():
+                    reported |= s
+            confirmed = (set(observed) | reported
+                         | set(ce.dead_peers)) - {me}
+            with self._ctl_cond:
+                self._agree_confirmed.update(confirmed)
+            for r in sorted(set(range(ce.nranks)) - confirmed - {me}):
+                try:
+                    ce.send_am(TAG_RECOVER, r,
+                               {"k": "deadset",
+                                "ranks": sorted(confirmed)})
+                except OSError:
+                    pass   # its death will get its own event
+            return confirmed
+        try:
+            ce.send_am(TAG_RECOVER, coord,
+                       {"k": "dead", "ranks": sorted(observed)})
+        except OSError:
+            return set(observed)
+        deadline = time.monotonic() + self.agree_timeout
+        with self._ctl_cond:
+            while not (observed <= self._agree_confirmed):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    warning("rank %d: dead-set agreement timed out "
+                            "waiting for coordinator %d; proceeding "
+                            "with the local view %s", me, coord,
+                            sorted(observed))
+                    return set(observed)
+                self._ctl_cond.wait(left)
+            return set(observed) | set(self._agree_confirmed)
+
+    def _declare_reported(self, ranks: set, src: int) -> None:
+        """A peer's report/broadcast names deaths this rank has not
+        detected yet: declare them now so the local recovery event
+        fires and every survivor converges on one dead set."""
+        rde = self._rde
+        if rde is None:
+            return
+        ce = rde.ce
+        from parsec_tpu.core.errors import PeerFailedError
+        for r in ranks:
+            if r == ce.rank or r == src or r in ce.dead_peers:
+                continue
+            ce.declare_peer_dead(r, PeerFailedError(
+                r, f"rank {ce.rank}: rank {r} reported dead by rank "
+                   f"{src} (dead-set agreement)", detector="agreement"))
+
+    # lint: on-loop (TAG_RECOVER AM handler via CommEngine.on_recover)
+    def _on_recover_msg(self, src: int, msg: dict) -> None:
+        """Recovery control lane (comm thread: store, signal, reply —
+        the heavy work stays on the recovery thread)."""
+        k = msg.get("k")
+        if k == "dead":
+            ranks = {int(r) for r in msg.get("ranks", ())}
+            with self._ctl_cond:
+                self._agree_reports.setdefault(src, set()).update(ranks)
+                self._ctl_cond.notify_all()
+            self._declare_reported(ranks, src)
+        elif k == "deadset":
+            ranks = {int(r) for r in msg.get("ranks", ())}
+            with self._ctl_cond:
+                self._agree_confirmed.update(ranks)
+                self._ctl_cond.notify_all()
+            self._declare_reported(ranks, src)
+        elif k == "need":
+            self._handle_need(src, msg)
+        elif k == "need_ack":
+            with self._ctl_cond:
+                self._need_acks[(msg.get("tp"), src)] = \
+                    bool(msg.get("ok"))
+                self._ctl_cond.notify_all()
+        elif k == "mode":
+            tpid = msg.get("tp")
+            rnd = int(msg.get("round", 0))
+            reply = None
+            with self._ctl_cond:
+                self._peer_modes[(tpid, src)] = \
+                    (rnd, "minimal" if msg.get("minimal") else "full")
+                self._ctl_cond.notify_all()
+                mine = self._my_mode.get(tpid)
+                if mine is not None and mine[0] == rnd \
+                        and not msg.get("re"):
+                    # answer a late voter with our same-round ballot —
+                    # we may have committed and left the agreement
+                    # wait already ("re" marks replies: never reply to
+                    # a reply, or two committed ranks ping-pong)
+                    reply = {"k": "mode", "tp": tpid, "round": rnd,
+                             "minimal": mine[1] == "minimal",
+                             "re": True}
+            if reply is not None and self._rde is not None:
+                from parsec_tpu.comm.engine import TAG_RECOVER
+                try:
+                    self._rde.ce.send_am(TAG_RECOVER, src, reply)
+                except OSError:
+                    pass
+
+    def _handle_need(self, src: int, msg: dict) -> None:
+        """A peer's minimal plan needs producers living here re-run so
+        its re-executing consumers are re-fed.  Ack = a PROMISE: the
+        resolved producer keys join this rank's replay set before its
+        plan freezes.  Nack (plan already frozen, pool not restarting
+        here, or unresolvable need) sends the requester to its full-
+        replay fallback."""
+        tpid = msg.get("tp")
+        tp = self.context.taskpools.get(tpid)
+        ok = False
+        if tp is not None and self.recovering(tp):
+            seeds: List[Any] = []
+            resolvable = True
+            for ckey, cflow in msg.get("needs", ()):
+                got = self._resolve_need(tp, tuple(ckey), cflow)
+                if not got:
+                    resolvable = False
+                    break
+                seeds.extend(got)
+            if resolvable:
+                with self._ctl_cond:
+                    state = self._plan_state.get(tpid)
+                    if state != "frozen":
+                        # "open"/None: merged before the freeze;
+                        # "full": everything re-runs anyway
+                        self._extra_seeds.setdefault(
+                            tpid, set()).update(seeds)
+                        ok = True
+        rde = self._rde
+        if rde is not None:
+            from parsec_tpu.comm.engine import TAG_RECOVER
+            try:
+                rde.ce.send_am(TAG_RECOVER, src,
+                               {"k": "need_ack", "tp": tpid, "ok": ok})
+            except OSError:
+                pass   # the requester died; its death routes elsewhere
+
+    def _resolve_need(self, tp, ckey: Tuple, cflow: str) -> List[Any]:
+        """Structurally invert a consumer's task-fed input edge to the
+        producer instance(s) THIS rank owns (the requester only knows
+        the consumer side).  Empty list = unresolvable (nack)."""
+        from parsec_tpu.core.task import FromTask
+        tc = tp.task_classes.get(ckey[0]) if ckey else None
+        if tc is None or tc.key_fn is not None:
+            return []
+        try:
+            locals_ = tc.key_to_locals(ckey)
+            fl = tc._flow_by_name.get(cflow)
+            dep = fl.active_input(locals_) if fl is not None else None
+            if dep is None or not isinstance(dep.end, FromTask):
+                return []
+            ptc = tp.task_classes.get(dep.end.task_class)
+            if ptc is None or ptc.key_fn is not None:
+                return []
+            out = []
+            myrank = self.context.rank
+            for pl in dep.end.instances(locals_):
+                pl = ptc.complete_locals(dict(pl))
+                if ptc.rank_of(pl) == myrank:
+                    out.append(ptc.make_key(pl))
+            return out
+        except Exception:
+            return []
+
+    def _negotiate_needs(self, tp, needs: List[Tuple[int, Any, str]]) \
+            -> bool:
+        """Ask each producing survivor to include our needed producers
+        in ITS replay set; True only when every peer acked within the
+        agreement timeout."""
+        rde = self._rde
+        if rde is None:
+            return False
+        ce = rde.ce
+        tpid = tp.taskpool_id
+        by_peer: Dict[int, List] = {}
+        for r, ckey, cflow in needs:
+            by_peer.setdefault(r, []).append((tuple(ckey), cflow))
+        from parsec_tpu.comm.engine import TAG_RECOVER
+        with self._ctl_cond:
+            for r in by_peer:
+                self._need_acks.pop((tpid, r), None)
+        for r, items in by_peer.items():
+            try:
+                ce.send_am(TAG_RECOVER, r,
+                           {"k": "need", "tp": tpid, "needs": items})
+            except OSError:
+                return False
+        deadline = time.monotonic() + self.agree_timeout
+        with self._ctl_cond:
+            while True:
+                missing = [r for r in by_peer
+                           if (tpid, r) not in self._need_acks]
+                if not missing:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._ctl_cond.wait(left)
+            return all(self._need_acks.get((tpid, r))
+                       for r in by_peer)
+
+    def _mode_round(self, tpid: int) -> int:
+        """The mode-vote round = this pool's restart-attempt count —
+        symmetric across survivors under the gang-wide restart rule,
+        so two ranks agreeing are provably talking about the SAME
+        death event (divergent rounds time out into full replay)."""
+        with self._lock:
+            return self._attempts.get(tpid, 0)
+
+    def _broadcast_mode(self, tpid: int, minimal: bool) -> None:
+        """Declare this rank's replay mode for one pool restart to
+        every live peer (the mode-agreement vote), and remember it so
+        a late voter's ballot gets answered after we committed."""
+        rde = self._rde
+        if rde is None:
+            return
+        rnd = self._mode_round(tpid)
+        mode = "minimal" if minimal else "full"
+        with self._ctl_cond:
+            self._my_mode[tpid] = (rnd, mode)
+        from parsec_tpu.comm.engine import TAG_RECOVER
+        for r in rde._live_peers():
+            try:
+                rde.ce.send_am(TAG_RECOVER, r,
+                               {"k": "mode", "tp": tpid, "round": rnd,
+                                "minimal": bool(minimal)})
+            except OSError:
+                pass
+
+    def _agree_mode(self, tpid: int) -> bool:
+        """Every survivor must take the SAME replay mode for a pool: a
+        full-replaying peer sends no re-feed needs, so a minimal peer
+        would skip producers that peer's re-enumeration waits on
+        forever — asymmetric modes deadlock the gang.  True only when
+        EVERY live peer declared minimal FOR THIS ROUND within the
+        agreement timeout; a declared full, a missing vote, or a
+        divergent round falls this rank back too (full-on-all-sides is
+        always safe: it is the r12 policy)."""
+        rde = self._rde
+        peers = rde._live_peers() if rde is not None else []
+        if not peers:
+            return True
+        rnd = self._mode_round(tpid)
+        deadline = time.monotonic() + self.agree_timeout
+        with self._ctl_cond:
+            while True:
+                modes = [self._peer_modes.get((tpid, r)) for r in peers]
+                modes = [m[1] if m is not None and m[0] == rnd else None
+                         for m in modes]
+                if any(m == "full" for m in modes):
+                    return False
+                if all(m == "minimal" for m in modes):
+                    return True
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._ctl_cond.wait(left)
+
+    # -- minimal replay (recorded-lineage plan) ---------------------------
+    def _plan_minimal(self, tp, spec, dead_set: set) -> ReplayPlan:
+        """Compute, negotiate, and FREEZE the minimal plan for one pool
+        restart.  Raises RecoveryUnsupported on any infeasibility — the
+        caller then takes the restore-point fallback."""
+        tpid = tp.taskpool_id
+        with self._ctl_cond:
+            extra = set(self._extra_seeds.get(tpid, ()))
+        plan = self._compute_minimal(tp, spec, dead_set, extra)
+        first_needs = {(r, k, f) for r, k, f in plan.needs}
+        if plan.needs and not self._negotiate_needs(tp, plan.needs):
+            raise RecoveryUnsupported(
+                "a peer nacked (or never acked) a re-feed need")
+        if self._rde is not None and self._rde._live_peers():
+            # one window for LATE cross-survivor needs to land before
+            # the plan freezes (peers restarting the same pool send
+            # theirs concurrently)
+            time.sleep(min(self.agree_window, 1.0))
+        with self._ctl_cond:
+            self._plan_state[tpid] = "frozen"
+            extra2 = set(self._extra_seeds.pop(tpid, ()))
+        if extra2 - extra:
+            plan = self._compute_minimal(tp, spec, dead_set, extra2)
+            if {(r, k, f) for r, k, f in plan.needs} - first_needs:
+                # the merged seeds' closure reached a peer nobody asked
+                # — a second negotiation round could cascade; fall back
+                raise RecoveryUnsupported(
+                    "merged re-feed seeds widened the remote needs")
+        return plan
+
+    def _compute_minimal(self, tp, spec, dead_set: set,
+                         extra_seeds: set) -> ReplayPlan:
+        """Adapter feeding :func:`minimal_plan`: enumerate the local +
+        adopted instance space, derive structural edges from the task
+        classes, and expose live/materializable tile versions."""
+        from parsec_tpu.core.task import FromDesc, FromTask
+        lin = tp._lineage
+        if lin is None or lin.overflow:
+            raise RecoveryUnsupported(
+                "lineage ring evicted records (or recording disabled)")
+        if any(tc.key_fn is not None
+               for tc in tp.task_classes.values()):
+            raise RecoveryUnsupported(
+                "custom key_fn task class: keys are not invertible")
+        myrank = self.context.rank
+        records = list(lin.records)
+        completed = set(lin.completed)
+        dcs = {dc.name: dc for dc in spec["collections"]}
+        with self._lock:
+            snaps = {dc.name: dict(self._snaps.get(id(dc), ()))
+                     for dc in spec["collections"]}
+        #: key -> (tc, locals, original owner rank)
+        keymap: Dict[Any, Tuple] = {}
+        pending: set = set()
+        adopted: set = set()
+        for tc in tp.task_classes.values():
+            aff = tc.affinity
+            if aff is None and myrank != 0:
+                continue
+            for locals_ in tc.iter_space(tp.globals):
+                locals_ = dict(locals_)
+                if aff is not None and tc.rank_of(locals_) != myrank:
+                    continue
+                key = tc.make_key(locals_)
+                orank = 0
+                if aff is not None:
+                    ref = aff(locals_)
+                    orank = ref.dc.rank_of(*ref.indices)
+                keymap[key] = (tc, locals_, orank)
+                if orank in dead_set:
+                    adopted.add(key)
+                elif key not in completed:
+                    pending.add(key)
+        live: Dict[Any, int] = {}
+        mat: Dict[Any, set] = {}
+
+        def tile_info(tile) -> None:
+            if tile in live:
+                return
+            dc = dcs.get(tile[0])
+            if dc is None:
+                return
+            idx = tuple(tile[1:])
+            if dc.rank_of(*idx) in dead_set:
+                return   # adopted partition: restored, not "live"
+            try:
+                d = dc.data_of(*idx)
+            except KeyError:
+                return
+            live[tile] = d.newest_version()
+            vs = set()
+            if self.ckpt is not None:
+                vs.update(self.ckpt.versions((id(dc), tile)))
+            sv = snaps.get(tile[0], {}).get(idx)
+            if sv is not None:
+                vs.add(sv[0])
+            mat[tile] = vs
+
+        for r in records:
+            for t, _v in r.reads:
+                tile_info(t)
+            for t, _v in r.writes:
+                tile_info(t)
+
+        def edges(key):
+            ent = keymap.get(key)
+            if ent is None:
+                return
+            tc, locals_, _orank = ent
+            for flow in tc._in_flows:
+                dep = flow.active_input(locals_)
+                if dep is None:
+                    continue
+                end = dep.end
+                if isinstance(end, FromTask):
+                    if dep.multiplicity(locals_) == 0:
+                        continue
+                    ptc = tp.task_classes.get(end.task_class)
+                    if ptc is None:
+                        continue
+                    for pl in end.instances(locals_):
+                        pl = ptc.complete_locals(dict(pl))
+                        pkey = ptc.make_key(pl)
+                        porig = 0
+                        paff = ptc.affinity
+                        if paff is not None:
+                            pref = paff(pl)
+                            porig = pref.dc.rank_of(*pref.indices)
+                        powner = ptc.rank_of(pl)
+                        if powner == myrank:
+                            where = "local"
+                        elif porig in dead_set:
+                            where = "dead"
+                        else:
+                            where = ("peer", powner)
+                        yield ("task", pkey, end.flow, flow.name,
+                               where, flow.is_ctl)
+                elif isinstance(end, FromDesc):
+                    from parsec_tpu.data.data import ACCESS_READ
+                    if not flow.access & ACCESS_READ:
+                        # a WRITE-only desc binding fully overwrites
+                        # the tile: no version requirement, no rewind
+                        continue
+                    ref = end.ref_fn(locals_)
+                    if ref.dc.rank_of(*ref.indices) in dead_set:
+                        continue   # restored by the adopted path
+                    idx = tuple(ref.indices)
+                    tile = ref.dc.tile_key(*idx)
+                    tile_info(tile)
+                    sv = snaps.get(ref.dc.name, {}).get(idx)
+                    yield ("desc", tile,
+                           sv[0] if sv is not None else None)
+
+        return minimal_plan(
+            records, dead_set=dead_set, pending=pending,
+            adopted=adopted, live=live, materializable=mat,
+            edges=edges, extra_seeds=extra_seeds & set(keymap))
+
+    def _materialize_plan(self, tp, spec, rplan: ReplayPlan):
+        """Capture every synthesis payload and rewind array BEFORE any
+        tile is overwritten (a rewound tile's live payload may itself
+        be a synthesis source)."""
+        dcs = {dc.name: dc for dc in spec["collections"]}
+        with self._lock:
+            snaps = {dc.name: dict(self._snaps.get(id(dc), ()))
+                     for dc in spec["collections"]}
+
+        def mater(tile, ver) -> np.ndarray:
+            dc = dcs.get(tile[0])
+            if dc is None:
+                raise RecoveryUnsupported(
+                    f"minimal replay: unknown collection for {tile!r}")
+            idx = tuple(tile[1:])
+            d = dc.data_of(*idx)
+            if d.newest_version() == ver:
+                copy = d.pull_to_host()
+                if copy is None or copy.payload is None:
+                    # a pull that cannot produce host bytes is an
+                    # infeasibility, not a crash: the caller still has
+                    # the full-replay fallback
+                    raise RecoveryUnsupported(
+                        f"minimal replay: {tile!r} v{ver} has no "
+                        "host-pullable payload")
+                return np.array(copy.payload, copy=True)
+            if self.ckpt is not None:
+                arr = self.ckpt.get((id(dc), tile), ver)
+                if arr is not None:
+                    return arr.copy()
+            sv = snaps.get(tile[0], {}).get(idx)
+            if sv is not None and sv[0] == ver:
+                return np.array(sv[1], copy=True)
+            raise RecoveryUnsupported(
+                f"minimal replay: {tile!r} v{ver} no longer "
+                "materializable")
+
+        synth = []
+        for (ckey, cflow, tile, ver, _pk) in rplan.synth:
+            arr = None if tile is None else mater(tile, ver)
+            synth.append((ckey, cflow, arr))
+        base = []
+        for tile, ver in rplan.base.items():
+            base.append((dcs[tile[0]], tuple(tile[1:]),
+                         mater(tile, ver)))
+        return synth, base
+
+    def _deliver_synth(self, tp, synth) -> List[Any]:
+        """Deliver the materialized out-of-plan-producer edges into the
+        restarted dep countdown (exactly how a remote payload lands —
+        one fresh datum per delivery; core/engine.deliver_dep returns
+        the task when the arrival completes it)."""
+        from parsec_tpu.core import engine as core_engine
+        from parsec_tpu.data.data import Coherency, Data
+        ready = []
+        for ckey, cflow, arr in synth:
+            tc = tp.task_classes.get(ckey[0])
+            if tc is None:
+                continue
+            locals_ = tc.key_to_locals(ckey)
+            copy = None
+            if arr is not None:
+                datum = Data(nb_elts=arr.nbytes)
+                copy = datum.create_copy(0, payload=arr,
+                                         coherency=Coherency.SHARED,
+                                         version=1)
+            t = core_engine.deliver_dep(tp, tc, locals_, cflow, copy,
+                                        None)
+            if t is not None:
+                ready.append(t)
+        return ready
 
     def _restore_plan(self, spec) -> List[Tuple[Any, Tuple, Any]]:
         """(dc, idx, payload) for every tile this rank serves after the
@@ -680,7 +1719,7 @@ class RecoveryCoordinator:
                 idx = tuple(idx) if isinstance(idx, (tuple, list)) \
                     else (idx,)
                 if idx in snap:
-                    plan.append((dc, idx, snap[idx]))
+                    plan.append((dc, idx, snap[idx][1]))
                 elif dc.init_fn is not None:
                     plan.append((dc, idx, dc.init_fn(*idx)))
                 else:
@@ -734,6 +1773,13 @@ class RecoveryCoordinator:
             return None
         rde.note_peer_epoch(src, epoch)
         rde.ce.peer_rejoined(src, epoch)
+        with self._ctl_cond:
+            # the agreement plane must not re-declare a rejoined rank
+            # from stale confirmations of its previous incarnation
+            self._agree_confirmed.discard(src)
+            self._agree_reports.pop(src, None)
+            for s in self._agree_reports.values():
+                s.discard(src)
         busy = False
         with self._lock:
             self._peer_epochs[src] = epoch
@@ -775,11 +1821,24 @@ class RecoveryCoordinator:
                  if r != ce.rank and r not in ce.dead_peers]
         if not peers:
             raise RuntimeError("rejoin: no live peers to rejoin")
+        from parsec_tpu.comm.engine import TAG_REJOIN
         req = {"k": "req", "rank": ce.rank, "epoch": ce.epoch}
-        for r in peers:
-            from parsec_tpu.comm.engine import TAG_REJOIN
-            ce.send_am(TAG_REJOIN, r, dict(req))
-        ack = ce.wait_rejoin_ack(timeout)
+        deadline = time.monotonic() + timeout
+        ack = None
+        while ack is None:
+            # RE-ANNOUNCE each round: a frame sent before a survivor
+            # finished re-creating its transport state for us (the shm
+            # ring re-creation race, a still-dialing socket) is lost —
+            # the request is idempotent, so retry until acked
+            for r in peers:
+                try:
+                    ce.send_am(TAG_REJOIN, r, dict(req))
+                except OSError:
+                    continue   # that survivor died meanwhile
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break
+            ack = ce.wait_rejoin_ack(min(2.0, left))
         if ack is None:
             raise TimeoutError(
                 f"rank {ce.rank}: rejoin not acknowledged within "
@@ -804,6 +1863,8 @@ class RecoveryCoordinator:
                 **self.counts,
                 "tasks_reexecuted": self.tasks_reexecuted,
                 "rejoins": self.rejoins,
+                "minimal_replays": self.minimal_replays,
+                "full_replays": self.full_replays,
                 "dead_map": dict(self._dead_map),
                 "active_pools": sorted(self._active),
             }
@@ -821,6 +1882,10 @@ class RecoveryCoordinator:
                                   self.tasks_reexecuted))
         out.append(counter_sample("parsec_rank_rejoins_total",
                                   self.rejoins))
+        out.append(counter_sample("parsec_recovery_minimal_replays_total",
+                                  self.minimal_replays))
+        out.append(counter_sample("parsec_recovery_full_replays_total",
+                                  self.full_replays))
         out.append(histogram_sample("parsec_recovery_duration_seconds",
                                     self.duration_hist))
         return out

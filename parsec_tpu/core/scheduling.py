@@ -240,10 +240,27 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
         # recovery fence (async arm): a device completer or retry path
         # finishing a pre-restart task must neither release successors
         # into the rebuilt dep structures nor decrement the re-counted
-        # termdet — the restart owns every count of the new generation
+        # termdet — the restart owns every count of the new generation.
+        # Its BODY ran, though, and may have mutated write-flow tiles
+        # in place: bump their version clocks so the payloads can
+        # never masquerade as the (unmutated) recorded version — the
+        # minimal-replay planner then sees an unrecorded writer and
+        # takes the restore-point fallback instead of synthesizing
+        # from silently-corrupted "live" bytes
+        for flow in tc._write_flows:
+            copy = task.data.get(flow.name)
+            if copy is not None and copy.data is not None \
+                    and copy.data.collection is not None:
+                copy.data.complete_write(copy.device)
         task.status = _COMPLETE
         es.pins("task_discard", task)
         return
+    # recovery lineage (core/recovery.py LineageLog; None = zero work):
+    # read versions snap BEFORE the write-flow bump below — an RW flow's
+    # bound copy still carries the version the body consumed
+    lin = tp._lineage
+    lin_reads = None if (lin is None or failed) \
+        else lin.snap_reads(task)
     if not failed:
         try:
             for flow in tc._write_flows:
@@ -262,6 +279,11 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
             engine.consume_inputs(task)
         except Exception as exc:
             es.context.record_error(exc, task)
+    if lin is not None and not failed:
+        # record AFTER release_deps: write versions are final (the
+        # writeback path may have superseded the bound copy) and
+        # flush_activations already noted this task's remote dests
+        lin.record(task, lin_reads)
     task.status = _COMPLETE
     cbs = es._pins_map.get("complete_exec")   # inlined es.pins
     if cbs:

@@ -134,6 +134,18 @@ class Taskpool:
         #: peer death keeps PR 5's containment behavior
         self.recovery_collections: list = []
         self.recovery_replay: Optional[Callable] = None
+        #: recorded lineage log (core/recovery.LineageLog), installed by
+        #: the RecoveryCoordinator at registration when the lineage
+        #: plane is on.  None keeps complete_execution's hook at one
+        #: attribute load + None check
+        self._lineage = None
+        #: minimal-replay enumeration filter (core/recovery.py): during
+        #:  a minimal restart only keys in this set re-enumerate,
+        #: re-deliver locally, and accept remote deliveries — every
+        #: other delivery of the restarted generation is a redundant
+        #: re-send of already-materialized work and drops.  None (the
+        #: pristine and full-replay states) disables the gate
+        self._replay_filter: Optional[set] = None
         #: GLOBALLY done: set once a distributed run passes global
         #: quiescence after this pool completed (Context.wait).  A pool
         #: that completed only LOCALLY stays restartable — another
@@ -224,6 +236,13 @@ class Taskpool:
         self.reshape.clear()
         self.dirty_data.clear()
         self.peer_ranks = set()
+        # the torn generation's lineage describes pre-restart state;
+        # the new generation records afresh.  The replay filter is
+        # (re)installed by the coordinator AFTER this reset when the
+        # restart is minimal — None here is the full-replay default
+        if self._lineage is not None:
+            self._lineage.clear()
+        self._replay_filter = None
 
     def wait_local(self, timeout: Optional[float] = None) -> bool:
         return self._done_event.wait(timeout)
@@ -246,6 +265,7 @@ class ParameterizedTaskpool(Taskpool):
         nb_local = 0
         ready: List[Task] = []
         append = ready.append
+        flt = self._replay_filter
         for tc in self.task_classes.values():
             aff = tc.affinity
             if aff is None and myrank != 0:
@@ -259,6 +279,11 @@ class ParameterizedTaskpool(Taskpool):
                 # survivor at re-execution (TaskClass.rank_of applies
                 # the same table on the activation-routing side)
                 if aff is not None and tc.rank_of(locals_) != myrank:
+                    continue
+                if flt is not None and tc.make_key(locals_) not in flt:
+                    # minimal-replay restart: this task's outputs are
+                    # intact and nothing in the plan consumes them —
+                    # skip the re-execution entirely
                     continue
                 nb_local += 1
                 if all_ready or tc.nb_task_inputs(locals_) == 0:

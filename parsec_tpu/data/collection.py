@@ -70,6 +70,14 @@ class DataCollection:
         this collection.  Written by the RecoveryCoordinator only."""
         self._recovery_translate = dict(table) if table else None
 
+    def tile_key(self, *indices) -> tuple:
+        """The LINEAGE identity of one tile: the key its ``Data`` is
+        created with and the key the recovery lineage log records for
+        reads/writes (core/recovery.py) — one source of truth, so the
+        minimal-replay planner can map a recorded tile back to
+        ``(collection, indices)`` without guessing the construction."""
+        return (self.name,) + tuple(indices)
+
     def vpid_of(self, *indices) -> int:
         return 0
 

@@ -132,7 +132,10 @@ class TiledMatrix(DataCollection):
             payload = self._tile_view(m, n)
         else:
             payload = np.zeros(self.tile_shape(m, n), self.dtype)
-        return new_data(payload, key=(self.name, m, n), collection=self)
+        # tile_key: the datum key IS the lineage identity the recovery
+        # log records (data/collection.py)
+        return new_data(payload, key=self.tile_key(m, n),
+                        collection=self)
 
     def data_of(self, m: int, n: int = 0) -> Data:
         with self._lock:
@@ -413,7 +416,8 @@ class VectorTwoDimCyclic(TiledMatrix):
         else:
             tm = min(self.mb, self.lm - m * self.mb)
             payload = np.zeros(tm, self.dtype)
-        return new_data(payload, key=(self.name, m, n), collection=self)
+        return new_data(payload, key=self.tile_key(m, n),
+                        collection=self)
 
     def _tile_view(self, m: int, n: int) -> np.ndarray:
         tm = min(self.mb, self.lm - m * self.mb)

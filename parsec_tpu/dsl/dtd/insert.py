@@ -323,7 +323,20 @@ class DTDTaskpool(Taskpool):
         dep/repo reset.  The pool's ``recovery_replay`` then re-inserts
         the lost task stream against restored tiles — re-created
         ``tile_of`` wrappers resolve their home through the translated
-        owner, so a single survivor replays the whole chain locally."""
+        owner, so a single survivor replays the whole chain locally.
+
+        Insert-stream lineage: with the recovery lineage plane armed,
+        every DTD completion lands in the shared ``Taskpool._lineage``
+        ring keyed by its insert tid (the task key carries the stream
+        position), with tile read/write versions — the evidence a
+        FILTERED replay needs.  The restart nevertheless always takes
+        the FULL replay today (counted in
+        ``parsec_recovery_full_replays_total``): DTD inserts are SPMD,
+        and one rank skipping a completed insert while a peer replays
+        it would diverge the lane/surrogate bookkeeping — a cross-rank
+        skip agreement (the TAG_RECOVER needs protocol generalized to
+        insert positions) is the recorded residual before multi-rank
+        DTD pools can replay minimally."""
         super().recovery_reset()
         if not self._finished:
             # the attach-time wait() hold was zeroed with the counters;

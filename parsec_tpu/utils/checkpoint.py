@@ -23,13 +23,110 @@ no rank can race ahead into mutating state another rank still saves.
 from __future__ import annotations
 
 import os
-from typing import Iterable, List
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from parsec_tpu.utils.mca import params
 from parsec_tpu.utils.output import debug_verbose
 
-FORMAT_VERSION = 1
+#: format 2 adds per-tile version stamps ("v:" keys) so a shard can
+#: serve as an exact-version replay cut for the recovery lineage
+#: planner; format-1 shards (no stamps) still restore
+FORMAT_VERSION = 2
+
+params.register("recovery_checkpoint_interval_s", 0.0,
+                "periodic incremental tile checkpoints for the recovery "
+                "lineage planner (core/recovery.py): > 0 captures a "
+                "version-stamped host copy of each dirty tile at most "
+                "once per interval, riding the write-flow version bumps "
+                "the lineage log already observes — the minimal-replay "
+                "cut then lands on the most recent captured version "
+                "instead of walking back to the pool-attach snapshot.  "
+                "0 (default) disables the capture plane")
+params.register("recovery_checkpoint_keep", 2,
+                "captured versions retained per tile by the incremental "
+                "checkpoint store (older captures evict; memory bound = "
+                "keep x tile bytes per dirty tile)")
+
+
+class TileCheckpointStore:
+    """In-memory incremental tile checkpoints: version-stamped host
+    copies captured on the write-flow completion path (the recovery
+    lineage hook calls :meth:`note_write`), at most one capture per
+    tile per ``recovery_checkpoint_interval_s``.
+
+    This is the checkpoint-as-lineage tier: the minimal-replay planner
+    (core/recovery.py) treats every captured ``(tile, version)`` as a
+    MATERIALIZABLE cut, so a backward walk stops at the newest capture
+    at-or-below the needed version instead of replaying from the
+    pool-attach snapshot — bounded replay depth for long version
+    chains.  Captures are torn-free by construction: they run after
+    ``complete_write`` bumped the version and before any later writer
+    of the same tile can start (the DAG serializes writers).
+    """
+
+    def __init__(self, interval_s: float, keep: int = 2):
+        self.interval = float(interval_s)
+        self.keep = max(1, int(keep))
+        #: tile key -> [(version, ndarray)] newest-last
+        #: (guarded-by: _lock)
+        self._tiles: Dict[Tuple, List[Tuple[int, np.ndarray]]] = {}
+        self._last: Dict[Tuple, float] = {}      # guarded-by: _lock
+        self._lock = threading.Lock()
+        self.captures = 0
+
+    def note_write(self, key: Tuple, version: int, payload) -> None:
+        """Capture ``payload`` (already host-resident) at ``version``
+        when the tile's interval elapsed; cheap no-op otherwise."""
+        now = time.monotonic()
+        last = self._last.get(key)
+        if last is not None and now - last < self.interval:
+            return
+        if not isinstance(payload, np.ndarray):
+            return
+        arr = payload.copy()
+        with self._lock:
+            self._last[key] = now
+            lst = self._tiles.setdefault(key, [])
+            lst.append((int(version), arr))
+            del lst[:-self.keep]
+            self.captures += 1
+
+    def versions(self, key: Tuple) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(v for v, _ in self._tiles.get(key, ()))
+
+    def get(self, key: Tuple, version: int) -> Optional[np.ndarray]:
+        with self._lock:
+            for v, arr in self._tiles.get(key, ()):
+                if v == version:
+                    return arr
+        return None
+
+    def drop(self, key: Tuple) -> None:
+        with self._lock:
+            self._tiles.pop(key, None)
+            self._last.pop(key, None)
+
+    def drop_owner(self, owner) -> None:
+        """Evict every capture of one owning collection (keys are
+        ``(owner, tile_key)`` — the recovery sweep calls this when a
+        collection's recovery spec retires, so a later job's
+        same-named tiles can never be served a previous job's bytes
+        and a resident service does not accumulate captures forever)."""
+        with self._lock:
+            for k in [k for k in self._tiles if k[0] == owner]:
+                del self._tiles[k]
+            for k in [k for k in self._last if k[0] == owner]:
+                del self._last[k]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._tiles.clear()
+            self._last.clear()
 
 
 def _rank_path(context, path: str) -> str:
@@ -81,6 +178,9 @@ def checkpoint(context, collections: Iterable, path: str) -> str:
             copy = datum.pull_to_host()
             key = ":".join([dc.name] + [str(i) for i in idx])
             arrays[key] = np.asarray(copy.payload)
+            # per-tile version stamp (format 2): the shard doubles as
+            # an exact-version replay cut for the lineage planner
+            arrays["v:" + key] = np.int64(datum.newest_version())
     out = _rank_path(context, path)
     os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
     # excused dead ranks write no shard of their own; their adopted
@@ -122,7 +222,7 @@ def restore(context, collections: Iterable, path: str) -> int:
     try:
         with np.load(src, allow_pickle=False) as zf:
             meta = zf["__meta__"]
-            if int(meta[0]) != FORMAT_VERSION:
+            if int(meta[0]) not in (1, FORMAT_VERSION):
                 raise ValueError(f"{src}: unsupported checkpoint format "
                                  f"{int(meta[0])}")
             if int(meta[2]) != context.nranks:
@@ -157,3 +257,16 @@ def restore(context, collections: Iterable, path: str) -> int:
         context.comm.ce.barrier()
     debug_verbose(3, "restore: %d tiles <- %s", n, src)
     return n
+
+
+def shard_versions(path: str, rank: int) -> Dict[str, int]:
+    """The per-tile version stamps of one rank's shard (format 2;
+    empty for format-1 shards) — the replay-cut metadata the recovery
+    cookbook reads when bounding replay depth against a collective
+    checkpoint."""
+    out: Dict[str, int] = {}
+    with np.load(f"{path}.r{rank}.npz", allow_pickle=False) as zf:
+        for key in zf.files:
+            if key.startswith("v:"):
+                out[key[2:]] = int(zf[key])
+    return out

@@ -86,6 +86,7 @@ TAG_NAMES: Dict[str, int] = {
     "ACT": 1, "ACTIVATE": 1, "GET_REQ": 2, "GET_REP": 3, "TERMDET": 4,
     "BARRIER": 5, "DTD": 6, "BATCH": 7, "UTRIG": 8, "PUT": 9,
     "GET1": 10, "GET1_REP": 11, "CLOCK": 12, "HB": 13, "REJOIN": 16,
+    "RECOVER": 17,
 }
 
 #: application tags a tag-less frame matcher applies to (dropping the

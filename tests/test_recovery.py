@@ -13,11 +13,9 @@ rejoining over TAG_REJOIN and serving its partition again; and the
 slow 3-rank mid-run-kill acceptance run with the makespan bound.
 """
 
-import multiprocessing as mp
 import os
 import sys
 import time
-import traceback
 
 import numpy as np
 import pytest
@@ -25,7 +23,7 @@ import pytest
 from parsec_tpu.core.errors import (CheckpointDegradedError,
                                     PeerFailedError)
 from parsec_tpu.core.recovery import (LineageRecord, RecoveryUnsupported,
-                                      lineage_plan)
+                                      lineage_plan, minimal_plan)
 from parsec_tpu.utils.mca import params
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -84,6 +82,146 @@ def test_lineage_plan_walks_back_to_source():
 def test_lineage_plan_broken_lineage_raises():
     with pytest.raises(RecoveryUnsupported):
         lineage_plan([], {}, {"ghost": 3})
+
+
+# ---------------------------------------------------------------------------
+# minimal_plan: the RECORDED-lineage replay set on hand-built DAGs
+# (recorded plan == analytic plan; checkpoint-bounded cut; ring-evicted
+# fallback)
+# ---------------------------------------------------------------------------
+
+def _chain_records(sent_to_dead=("T0",)):
+    """Three-step in-place chain over tile a (v0 -> v3) plus an
+    independent tile b task; T0's activations reached rank 1."""
+    return [
+        LineageRecord("T0", rmap={"C": ("a", 0)}, wmap={"C": ("a", 1)},
+                      reads=[("a", 0)], writes=[("a", 1)],
+                      dests={1} if "T0" in sent_to_dead else ()),
+        LineageRecord("T1", rmap={"C": ("a", 1)}, wmap={"C": ("a", 2)},
+                      reads=[("a", 1)], writes=[("a", 2)]),
+        LineageRecord("T2", rmap={"C": ("a", 2)}, wmap={"C": ("a", 3)},
+                      reads=[("a", 2)], writes=[("a", 3)]),
+        LineageRecord("U0", rmap={"B": ("b", 0)}, wmap={"B": ("b", 1)},
+                      reads=[("b", 0)], writes=[("b", 1)]),
+    ]
+
+
+_CHAIN_EDGES = {
+    "T0": [("desc", "a", 0)],
+    "T1": [("task", "T0", "C", "C", "local", False)],
+    "T2": [("task", "T1", "C", "C", "local", False)],
+    "U0": [("desc", "b", 0)],
+}
+
+
+def test_minimal_plan_matches_analytic_set():
+    """Recorded plan == analytic plan: T0 fed the dead rank, so the
+    whole a-chain re-runs (re-running T0 regresses tile a below its
+    live version — every recorded later writer rejoins); the untouched
+    b task stays OUT of the plan."""
+    plan = minimal_plan(_chain_records(), dead_set={1},
+                        live={"a": 3, "b": 1},
+                        materializable={"a": {0}, "b": {0}},
+                        edges=lambda k: _CHAIN_EDGES.get(k, ()))
+    assert plan.tasks == {"T0", "T1", "T2"}     # analytic closure
+    assert plan.base == {"a": 0}                # desc cut at snapshot
+    assert not plan.needs and not plan.synth
+
+
+def test_minimal_plan_synthesizes_materialized_edges():
+    """A pending consumer of a SKIPPED producer gets its delivery
+    synthesized from the live-intact version instead of re-running the
+    producer."""
+    edges = dict(_CHAIN_EDGES)
+    edges["P0"] = [("task", "U0", "B", "X", "local", False)]
+    plan = minimal_plan(_chain_records(), dead_set={1}, pending=["P0"],
+                        live={"a": 3, "b": 1},
+                        materializable={"a": {0}, "b": {0}},
+                        edges=lambda k: edges.get(k, ()))
+    assert "U0" not in plan.tasks and "P0" in plan.tasks
+    assert ("P0", "X", "b", 1, "U0") in plan.synth
+
+
+def test_minimal_plan_checkpoint_bounds_replay_depth():
+    """Checkpoint-bounded cut: with tile a's v2 captured by the
+    incremental checkpoint store, a consumer needing v2 synthesizes
+    from the capture — the walk stops there instead of rewinding to
+    the snapshot and re-running the whole chain."""
+    edges = dict(_CHAIN_EDGES)
+    edges["P1"] = [("task", "T1", "C", "X", "local", False)]
+    # without the checkpoint: T1 must re-run, dragging T0 and T2 in
+    deep = minimal_plan(_chain_records(sent_to_dead=()), dead_set={1},
+                        pending=["P1"], live={"a": 3, "b": 1},
+                        materializable={"a": {0}, "b": {0}},
+                        edges=lambda k: edges.get(k, ()))
+    assert {"T0", "T1", "T2"} <= deep.tasks
+    # with (a, 2) checkpointed the plan is ONE pending task + a synth
+    shallow = minimal_plan(_chain_records(sent_to_dead=()),
+                           dead_set={1}, pending=["P1"],
+                           live={"a": 3, "b": 1},
+                           materializable={"a": {0, 2}, "b": {0}},
+                           edges=lambda k: edges.get(k, ()))
+    assert shallow.tasks == {"P1"}
+    assert ("P1", "X", "a", 2, "T1") in shallow.synth
+
+
+def test_minimal_plan_ring_evicted_falls_back():
+    """A producer whose record the ring evicted cannot be planned
+    around: RecoveryUnsupported — the caller takes the full
+    restore-point replay (counted in full_replays)."""
+    recs = _chain_records()[1:]    # T0's record evicted
+    with pytest.raises(RecoveryUnsupported):
+        minimal_plan(recs, dead_set={1}, pending=["P2"],
+                     live={"a": 3, "b": 1},
+                     materializable={"a": {0}, "b": {0}},
+                     edges=lambda k:
+                     {"P2": [("task", "T0", "C", "X", "local",
+                              False)]}.get(k, ()))
+
+
+def test_minimal_plan_unrecorded_later_writer_falls_back():
+    """Rewinding a tile whose LIVE version has no recorded writer
+    (the ring rolled past it) is unsound — the plan refuses."""
+    recs = _chain_records()
+    with pytest.raises(RecoveryUnsupported):
+        minimal_plan(recs, dead_set={1}, live={"a": 9, "b": 1},
+                     materializable={"a": {0}, "b": {0}},
+                     edges=lambda k: _CHAIN_EDGES.get(k, ()))
+
+
+def test_minimal_plan_remote_edges_become_needs():
+    """A task-fed input produced on a LIVE survivor is a negotiation
+    need, never a silent assumption."""
+    edges = dict(_CHAIN_EDGES)
+    edges["P3"] = [("task", "Q", "C", "Y", ("peer", 2), False)]
+    plan = minimal_plan(_chain_records(sent_to_dead=()), dead_set={1},
+                        pending=["P3"], live={"a": 3, "b": 1},
+                        materializable={"a": {0}, "b": {0}},
+                        edges=lambda k: edges.get(k, ()))
+    assert (2, "P3", "Y") in plan.needs
+
+
+def test_minimal_plan_synth_drops_when_producer_joins():
+    """An edge that first chose synthesis must lose its synth twin if
+    the producer later joins the plan (the natural re-delivery would
+    otherwise double-arrive)."""
+    recs = _chain_records(sent_to_dead=())
+    recs.append(LineageRecord("D0", rmap={"B": ("b", 1)},
+                              wmap={}, reads=[("b", 1)], dests={1}))
+    edges = dict(_CHAIN_EDGES)
+    edges["D0"] = [("task", "U0", "B", "X", "local", False)]
+    # P4 needs b@0 which is NOT materializable as a synth-only story:
+    # force U0 to rejoin via a desc rewind of b
+    edges["P4"] = [("task", "U0", "B", "X", "local", False),
+                   ("desc", "b", 0)]
+    plan = minimal_plan(recs, dead_set={1}, pending=["P4"],
+                        live={"a": 3, "b": 1},
+                        materializable={"a": {0}, "b": {0}},
+                        edges=lambda k: edges.get(k, ()))
+    # rewinding b to 0 pulls writer U0 in; every synth against U0 is
+    # dropped in favor of the natural delivery
+    assert "U0" in plan.tasks
+    assert not any(s[4] == "U0" for s in plan.synth)
 
 
 # ---------------------------------------------------------------------------
@@ -200,6 +338,70 @@ def test_run_epoch_fence_discards_stale_tasks():
         assert tp.nb_tasks == before       # no decrement
         scheduling.complete_execution(ctx.streams[0], stale)
         assert tp.nb_tasks == before
+        tp.cancel()
+        ctx.wait(timeout=10)
+    finally:
+        ctx.fini()
+
+
+def test_recovery_busy_blocks_quiescence_idle():
+    """A queued/active recovery restart must hold global quiescence
+    open: _local_idle stays False and the sole-survivor short-circuit
+    waits — otherwise Context.wait hands tiles to the application
+    while the restore rewinds them (the completed-pool-grace race)."""
+    from parsec_tpu.comm.engine import SocketCE
+    from parsec_tpu.comm.launch import _probe_port_base
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    params.set("recovery_enable", 1)
+    ce = SocketCE(0, 2, _probe_port_base(2))
+    ctx = Context(nb_cores=1, rank=0, nranks=2)
+    rde = RemoteDepEngine(ce, ctx)
+    try:
+        rec = ctx.recovery
+        assert not rec.busy() and rde._local_idle()
+        with rec._lock:
+            rec._pending_dead.add(1)     # death accepted, not processed
+        assert rec.busy()
+        assert not rde._local_idle()     # quiescence must not pass
+        with pytest.raises(TimeoutError):
+            rde._wait_recovery_idle(time.monotonic() + 0.1)
+        with rec._lock:
+            rec._pending_dead.clear()
+        assert rde._local_idle()
+    finally:
+        ce._stop = True
+        rde.fini()
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_stale_body_discard_taints_tile_versions():
+    """A stale-generation body that RAN may have mutated its write-flow
+    tiles in place without a version bump (complete_write is skipped by
+    the discard).  The epoch-fence discard must advance those version
+    clocks, or minimal replay would synthesize from a 'live-intact'
+    payload that is neither — the silent-corruption class the chaos
+    smoke caught under load."""
+    from parsec_tpu.core import scheduling
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.task import RW, Task, TaskClass
+    from parsec_tpu.core.taskpool import Taskpool
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    ctx = Context(nb_cores=1)
+    try:
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4, name="At")
+        d = A.data_of(0, 0)
+        tp = Taskpool("taint")
+        tc = TaskClass("X", flows=[RW("T")], body=lambda T: None)
+        tp.add_task_class(tc)
+        ctx.add_taskpool(tp)
+        stale = Task(tc, tp, {})
+        stale.data["T"] = d.copy_on(0)
+        before = d.newest_version()
+        tp.run_epoch += 1            # a restart fenced the generation
+        scheduling.complete_execution(ctx.streams[0], stale)
+        assert d.newest_version() > before   # the mutation is visible
         tp.cancel()
         ctx.wait(timeout=10)
     finally:
@@ -440,116 +642,194 @@ def test_recovery_disabled_reproduces_containment():
 
 
 # ---------------------------------------------------------------------------
-# elastic rejoin: killed -> restarted -> serving its partition again
+# elastic rejoin: killed -> restarted -> serving its partition again.
+# Parametrized over transports: shm exercises the ring RE-CREATION in
+# the TAG_REJOIN handshake (previously the one transport that could
+# not rejoin — the receiver's unlink left no ring to come back to).
 # ---------------------------------------------------------------------------
 
-def _rejoin_potrf_phase(ctx, rank, nranks, name):
-    from parsec_tpu.apps.potrf import potrf_taskpool
+@pytest.mark.parametrize("transport", ["evloop", "shm"])
+def test_killed_rank_rejoins_and_serves(transport):
+    import chaos
+    ok, detail = chaos.rejoin_scenario(transport, timeout=150.0)
+    assert ok, detail
+
+
+# ---------------------------------------------------------------------------
+# lineage recording + the incremental checkpoint plane
+# ---------------------------------------------------------------------------
+
+def test_lineage_log_records_completed_tasks():
+    """With recovery armed, every completed task of a registered pool
+    lands in the ring with flow-keyed, version-stamped reads/writes —
+    and the write versions march the datum version clock upward (the
+    chain the minimal planner walks)."""
+    from parsec_tpu.core.context import Context
     from parsec_tpu.data.matrix import TwoDimBlockCyclic
-    n, mb = 64, 16
-    rng = np.random.default_rng(5)
-    a = rng.standard_normal((n, n)).astype(np.float32)
-    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
-    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, nodes=nranks,
-                          myrank=rank, name=name)
-    for m, nn in A.local_tiles():
-        np.asarray(A.data_of(m, nn).copy_on(0).payload)[:] = \
-            spd[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
-    ctx.add_taskpool(potrf_taskpool(A, device="cpu"))
-    ctx.wait(timeout=60)
-    Lref = np.linalg.cholesky(spd.astype(np.float64))
-    for m, nn in A.local_tiles():
-        if nn > m:
-            continue
-        got = np.asarray(A.data_of(m, nn).pull_to_host().payload,
-                         dtype=np.float64)
-        ref = Lref[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
-        if m == nn:
-            got, ref = np.tril(got), np.tril(ref)
-        np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
-
-
-def _rejoin_worker(rank, nranks, port_base, outq):
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    params.set("recovery_enable", 1)
     try:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
-    try:
-        from parsec_tpu.comm.engine import make_ce
-        from parsec_tpu.comm.remote_dep import RemoteDepEngine
-        from parsec_tpu.core.context import Context
-
-        ce = make_ce(rank, nranks, port_base)
-        ctx = Context(nb_cores=2, rank=rank, nranks=nranks)
-        rde = RemoteDepEngine(ce, ctx)
-        ce.barrier()
-        # phase 1: the gang works; rank 1 then dies and restarts
-        _rejoin_potrf_phase(ctx, rank, nranks, "A")
-        ce.barrier()
-        if rank == 1:
-            rde.fini()                    # the rank goes down
-            time.sleep(1.0)
-            params.set("comm_epoch", 1)   # restarted incarnation
-            ce = make_ce(rank, nranks, port_base)
-            rde = RemoteDepEngine(ce, ctx)
-            table = ctx.recovery.rejoin(timeout=30.0)
-            assert isinstance(table, dict)
-        else:
-            deadline = time.monotonic() + 25
-            while 1 not in ce.dead_peers:
-                if time.monotonic() > deadline:
-                    raise RuntimeError("rank 1 death never detected")
-                time.sleep(0.02)
-            while 1 in ce.dead_peers:     # cleared by peer_rejoined
-                if time.monotonic() > deadline + 35:
-                    raise RuntimeError("rank 1 never rejoined")
-                time.sleep(0.02)
-            assert 1 not in ce.excused_peers
-            assert ctx.recovery.rejoins == 1
-        ce.barrier(timeout=30)
-        # phase 2: the REJOINED rank serves its partition again
-        _rejoin_potrf_phase(ctx, rank, nranks, "B")
-        ce.barrier(timeout=30)
-        ce._stop = True
-        outq.put((rank, None, "ok"))
-        ctx.fini()
-        rde.fini()
-    except Exception:
-        outq.put((rank, traceback.format_exc(), None))
-
-
-def test_killed_rank_rejoins_and_serves():
-    from parsec_tpu.comm.launch import _probe_port_base
-    saved = os.environ.get("PARSEC_MCA_RECOVERY_ENABLE")
-    os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
-    try:
-        base = _probe_port_base(2)
-        mpctx = mp.get_context("spawn")
-        outq = mpctx.Queue()
-        procs = [mpctx.Process(target=_rejoin_worker,
-                               args=(r, 2, base, outq), daemon=True)
-                 for r in range(2)]
-        for p in procs:
-            p.start()
-        results = {}
+        ctx = Context(nb_cores=1)
         try:
-            for _ in range(2):
-                rank, err, res = outq.get(timeout=150)
-                assert err is None, f"rank {rank} failed:\n{err}"
-                results[rank] = res
+            from parsec_tpu.apps.potrf import potrf_taskpool
+            n, mb = 32, 16
+            rng = np.random.default_rng(2)
+            a = rng.standard_normal((n, n)).astype(np.float32)
+            spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+            A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n,
+                                  name="Alin").from_array(spd.copy())
+            tp = potrf_taskpool(A, device="cpu")
+            ctx.add_taskpool(tp)
+            assert tp._lineage is not None     # armed at registration
+            ctx.wait(timeout=30)
+            lin = tp._lineage
+            assert not lin.overflow
+            assert len(lin.records) == len(lin.completed) > 0
+            by_key = {r.key: r for r in lin.records}
+            # every task class completed and recorded tile writes
+            names = {k[0] for k in by_key}
+            assert {"POTRF", "TRSM", "SYRK", "POTRFL"} <= names
+            # the diagonal chain: SYRK(1, 0)'s T write supersedes its
+            # T read of the same tile (in-place version discipline)
+            rec = by_key[("SYRK", 1, 0)]
+            rt, rv = rec.rmap["T"]
+            wt, wv = rec.wmap["T"]
+            assert rt == wt == ("Alin", 1, 1)
+            assert wv > rv
         finally:
-            for p in procs:
-                p.join(timeout=10)
-                if p.is_alive():
-                    p.terminate()
-        assert results == {0: "ok", 1: "ok"}
+            ctx.fini()
     finally:
-        if saved is None:
-            os.environ.pop("PARSEC_MCA_RECOVERY_ENABLE", None)
-        else:
-            os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = saved
+        params.set("recovery_enable", 0)
+
+
+def test_tile_checkpoint_store_interval_and_keep():
+    from parsec_tpu.utils.checkpoint import TileCheckpointStore
+    st = TileCheckpointStore(3600.0, keep=2)    # huge interval
+    st.note_write(("a", 0, 0), 1, np.ones(4))
+    st.note_write(("a", 0, 0), 2, np.full(4, 2.0))   # inside interval
+    assert st.versions(("a", 0, 0)) == (1,)          # rate-bounded
+    st2 = TileCheckpointStore(0.0, keep=2)      # capture every write
+    for v in (1, 2, 3):
+        st2.note_write(("a", 0, 0), v, np.full(4, float(v)))
+    assert st2.versions(("a", 0, 0)) == (2, 3)  # keep bound evicts v1
+    np.testing.assert_allclose(st2.get(("a", 0, 0), 3), 3.0)
+    assert st2.get(("a", 0, 0), 1) is None
+
+
+def test_lineage_hook_feeds_checkpoint_store():
+    """recovery_checkpoint_interval_s > 0 arms the capture plane: the
+    complete_execution lineage hook snapshots version-stamped dirty
+    tiles into the store (the replay cut of long version chains)."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    params.set("recovery_enable", 1)
+    params.set("recovery_checkpoint_interval_s", 0.0001)
+    try:
+        ctx = Context(nb_cores=1)
+        try:
+            from parsec_tpu.apps.potrf import potrf_taskpool
+            n, mb = 32, 16
+            rng = np.random.default_rng(2)
+            a = rng.standard_normal((n, n)).astype(np.float32)
+            spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+            A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n,
+                                  name="Ack").from_array(spd.copy())
+            ctx.add_taskpool(potrf_taskpool(A, device="cpu"))
+            ctx.wait(timeout=30)
+            st = ctx.recovery.ckpt
+            assert st is not None and st.captures > 0
+            # a captured version is retrievable at its exact stamp;
+            # keys scope by COLLECTION IDENTITY so a later job's
+            # same-named tiles can never read this job's bytes
+            key = (id(A), ("Ack", 0, 0))
+            vs = st.versions(key)
+            assert vs
+            assert st.get(key, vs[-1]) is not None
+            # spec retirement evicts the captures with it
+            st.drop_owner(id(A))
+            assert st.versions(key) == ()
+        finally:
+            ctx.fini()
+    finally:
+        params.set("recovery_checkpoint_interval_s", 0.0)
+        params.set("recovery_enable", 0)
+
+
+def test_checkpoint_shards_carry_version_stamps(tmp_path):
+    """Format-2 collective shards stamp each tile's version — the
+    replay-cut metadata shard_versions reads back."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.utils.checkpoint import (checkpoint, restore,
+                                             shard_versions)
+    ctx = Context(nb_cores=1)
+    try:
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=8, ln=8, name="Avz")
+        d = A.data_of(0, 0)
+        d.overwrite_host(np.ones((4, 4), np.float32))
+        A.data_of(1, 1)
+        path = str(tmp_path / "ck")
+        checkpoint(ctx, [A], path)
+        vs = shard_versions(path, 0)
+        assert vs["Avz:0:0"] == d.newest_version()
+        assert "Avz:1:1" in vs
+        # and the stamped shard still restores
+        d.overwrite_host(np.zeros((4, 4), np.float32))
+        restore(ctx, [A], path)
+        np.testing.assert_allclose(
+            np.asarray(A.data_of(0, 0).pull_to_host().payload), 1.0)
+    finally:
+        ctx.fini()
+
+
+# ---------------------------------------------------------------------------
+# end to end: minimal replay, dyn-hold recovery, multi-death agreement
+# ---------------------------------------------------------------------------
+
+def test_minimal_replay_reexecutes_strictly_fewer():
+    """The headline A/B: on the SAME mid-run kill, recorded-lineage
+    minimal replay re-executes strictly fewer tasks than
+    replay-from-restore-point, and each leg provably took its path
+    (minimal_replays / full_replays counters)."""
+    import chaos
+    ab = chaos.run_ab_pair(timeout=120.0)
+    assert ab["minimal"]["minimal"] >= 1 and ab["minimal"]["full"] == 0
+    assert ab["full"]["full"] >= 1
+    assert ab["minimal"]["reexec"] < ab["full"]["reexec"], ab
+
+
+def test_kill_recovers_dynamic_taskpool_with_hold():
+    """A DynamicTaskpool killed while its distributed termination hold
+    is outstanding restarts on the survivor with the hold RE-ARMED
+    (previously stranded) and finishes with exact values."""
+    import chaos
+    res = _run_distributed_with_env(
+        chaos.dyn_chain_recover_workload, 2,
+        {"PARSEC_MCA_FAULT_PLAN":
+         "seed=1;kill_rank=1@t+0.8s,mode=close;"
+         "delay_frame=tag:ACT,p=1,ms=150;delay_frame=tag:BATCH,p=1,ms=150",
+         "PARSEC_MCA_RECOVERY_ENABLE": "1",
+         "PARSEC_CHAOS_WAIT_S": "40"},
+        timeout=90, tolerate_ranks=(1,))
+    assert res[0] == "ok" and res[1] is None
+
+
+def test_multi_death_agreement_converges_survivors():
+    """Two near-simultaneous deaths on a 4-rank gang: the TAG_RECOVER
+    agreement round lands both survivors on the SAME confirmed dead
+    set and the run completes with validated numerics."""
+    import chaos
+    res = _run_distributed_with_env(
+        chaos.potrf_recover_workload, 4,
+        {"PARSEC_MCA_FAULT_PLAN":
+         "seed=2;kill_rank=2@t+1.0s,mode=close;"
+         "kill_rank=3@t+1.05s,mode=close;"
+         "delay_frame=tag:ACT,p=1,ms=120;delay_frame=tag:BATCH,p=1,ms=120",
+         "PARSEC_MCA_RECOVERY_ENABLE": "1",
+         "PARSEC_MCA_RECOVERY_MAX_ATTEMPTS": "3",
+         "PARSEC_CHAOS_WAIT_S": "60"},
+        timeout=120, tolerate_ranks=(2, 3))
+    assert res[0] == "ok" and res[1] == "ok"
+    assert res[2] is None and res[3] is None   # both kills fired
 
 
 # ---------------------------------------------------------------------------
@@ -568,6 +848,8 @@ def test_recovery_metrics_families_scrape():
             assert "parsec_tasks_reexecuted_total" in names
             assert "parsec_rank_rejoins_total" in names
             assert "parsec_recovery_duration_seconds" in names
+            assert "parsec_recovery_minimal_replays_total" in names
+            assert "parsec_recovery_full_replays_total" in names
             stages = {s["l"].get("stage")
                       for s in ctx.metrics.samples()
                       if s["n"] == "parsec_recoveries_total"}
@@ -619,11 +901,13 @@ def test_three_rank_potrf_survives_midrun_kill():
 @pytest.mark.slow
 def test_chaos_recover_catalog():
     """The full recovery catalog (close/hang x evloop/shm/threads +
-    DTD + survivor exhaustion) through the chaos harness."""
+    DTD + minimal replay + dyn holds + multi-death agreement +
+    survivor exhaustion, plus the shm kill->restart->rejoin leg)
+    through the chaos harness."""
     import subprocess
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
-         "--recover", "--seeds", "8", "--timeout", "120"],
-        capture_output=True, text=True, timeout=1200,
+         "--recover", "--seeds", "11", "--timeout", "120"],
+        capture_output=True, text=True, timeout=1500,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr

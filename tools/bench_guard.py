@@ -44,7 +44,7 @@ ABSOLUTE_DELTA = ("telemetry_overhead",)
 
 #: metrics where SMALLER is better (everything else: bigger is better)
 LOWER_IS_BETTER = ("task_rtt", "tracer_overhead", "telemetry_overhead",
-                   "backward_error",
+                   "backward_error", "recovery_makespan_ratio",
                    "factorization_residual",
                    # bw/rtt protocol-mix guards (the r6 event-loop
                    # transport): more wire frames or more syscalls per
@@ -83,7 +83,13 @@ SKIP_KEYS = {"metric", "unit", "storage", "note", "ib",
              # host core inventory on bw/rtt lines (where the number
              # was measured, not what was measured) and the telemetry
              # mode's raw side readings (the gated value is the ratio)
-             "host", "tasks_off", "tasks_on"}
+             "host", "tasks_off", "tasks_on",
+             # recovery A/B side readings (r13): host-load-sensitive
+             # makespans and exact re-execution counts are evidence,
+             # not rate metrics — the gated value is the headline
+             # minimal-makespan ratio, and the minimal<full invariant
+             # is asserted by chaos --ab-minimal in premerge
+             "recovery"}
 
 
 def _load(path: str) -> dict:

@@ -150,9 +150,341 @@ def dtd_chain_recover_workload(ctx, rank, nranks):
     return "ok"
 
 
+def _chain_hook(es, task):
+    """Shared CPU incarnation of the dyn and A/B chains' W(i): own
+    tile T := predecessor P + 1 (P is READ — never mutated, so sharing
+    the producer's copy on local edges is safe)."""
+    import numpy as np
+    p = task.data.get("P")
+    base = 0.0 if p is None else float(np.asarray(p.payload).flat[0])
+    t = task.data["T"]
+    arr = np.asarray(t.payload, dtype=np.float32)
+    t.payload = np.full_like(arr, base + 1.0)
+    return None
+
+
+def dyn_chain_recover_workload(ctx, rank, nranks):
+    """Distributed DynamicTaskpool chain (runtime task discovery, the
+    dyn-hold pool-scoped quiescence round) with a recovery spec: a
+    mid-chain kill must restart the pool on the survivor, RE-ARM the
+    distributed termination hold across the restart (previously a kill
+    with the hold outstanding stranded it), and end with the exact
+    final values on every surviving rank."""
+    import numpy as np
+    from parsec_tpu.core.task import (Dep, FromDesc, FromTask, READ,
+                                      RW, TaskClass, ToDesc, ToTask)
+    from parsec_tpu.core.taskpool import DynamicTaskpool
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+
+    steps = 12
+    V = VectorTwoDimCyclic(mb=2, lm=2 * steps, nodes=nranks,
+                           myrank=rank, name="Vdyn")
+    V.set_init(lambda m, n=0: np.zeros(2, np.float32))
+    # each W(i) reads its predecessor's T (task-fed READ, discovered
+    # at delivery — never enumerated) and writes its OWN tile
+    # V(i) = i + 1, handing T on across the 1D-cyclic owners
+    tc = TaskClass(
+        "W", params=[("i", lambda g, l: range(steps))],
+        affinity=lambda loc, V=V: V(loc["i"]),
+        flows=[READ("P",
+                    inputs=[Dep(FromTask("W", "T",
+                                         lambda loc:
+                                         {"i": loc["i"] - 1}),
+                                guard=lambda loc: loc["i"] > 0)]),
+               RW("T",
+                  inputs=[Dep(FromDesc(lambda loc, V=V: V(loc["i"])))],
+                  outputs=[Dep(ToTask("W", "P",
+                                      lambda loc: {"i": loc["i"] + 1}),
+                               guard=lambda loc, s=steps:
+                               loc["i"] < s - 1),
+                           Dep(ToDesc(lambda loc, V=V: V(loc["i"])))])],
+        incarnations=[("cpu", _chain_hook)],
+        properties={"startup_fn":
+                    lambda g, r: [{"i": 0}] if r == 0 else []})
+    tp = DynamicTaskpool("dyn-chain")
+    tp.add_task_class(tc)
+    tp.recovery_collections = [V]
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=_wait_s())
+    for m, _n in V.local_tiles():
+        got = np.asarray(V.data_of(m).pull_to_host().payload)
+        np.testing.assert_allclose(got, float(m + 1))
+    return "ok"
+
+
+def potrf_recover_count_workload(ctx, rank, nranks):
+    """The recover potrf plus this rank's replay accounting — the
+    minimal-vs-full A/B leg reads the survivor's re-execution count."""
+    r = potrf_workload(ctx, rank, nranks, recover=True)
+    rec = ctx.recovery
+    st = rec.stats() if rec is not None else {}
+    return (r, st.get("tasks_reexecuted", 0),
+            st.get("minimal_replays", 0), st.get("full_replays", 0))
+
+
+def ab_chain_minimal_workload(ctx, rank, nranks):
+    """The A/B chain under a kill, with the MINIMAL path asserted: on
+    this DAG a survivor that fell back to full replay is a regression,
+    not a pass (the fallback counters prove which path ran)."""
+    r = ab_chain_recover_workload(ctx, rank, nranks)
+    if r[2] < 1 or r[3] > 0:
+        raise AssertionError(
+            f"minimal replay did not engage (minimal={r[2]}, "
+            f"full={r[3]}) — silent fallback to restore-point replay")
+    return r
+
+
 WORKLOADS = {"potrf": potrf_workload, "dtd": dtd_chain_workload,
              "potrf-recover": potrf_recover_workload,
-             "dtd-recover": dtd_chain_recover_workload}
+             "dtd-recover": dtd_chain_recover_workload,
+             "dyn-recover": dyn_chain_recover_workload,
+             "potrf-recover-count": potrf_recover_count_workload,
+             "ab-chain-minimal": ab_chain_minimal_workload}
+
+
+# ---------------------------------------------------------------------------
+# kill -> restart -> rejoin scenario (all transports, incl. shm ring
+# re-creation) — not a fault-plan case: the victim RESTARTS in-process
+# with a bumped incarnation epoch and must serve its partition again
+# ---------------------------------------------------------------------------
+
+def _rejoin_phase(ctx, rank, nranks, name):
+    """One full 2-rank potrf with per-rank numeric validation."""
+    from parsec_tpu.apps.potrf import potrf_taskpool
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    n, mb = 64, 16
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n, nodes=nranks,
+                          myrank=rank, name=name)
+    for m, nn in A.local_tiles():
+        np.asarray(A.data_of(m, nn).copy_on(0).payload)[:] = \
+            spd[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
+    ctx.add_taskpool(potrf_taskpool(A, device="cpu"))
+    ctx.wait(timeout=60)
+    Lref = np.linalg.cholesky(spd.astype(np.float64))
+    for m, nn in A.local_tiles():
+        if nn > m:
+            continue
+        got = np.asarray(A.data_of(m, nn).pull_to_host().payload,
+                         dtype=np.float64)
+        ref = Lref[m * mb:(m + 1) * mb, nn * mb:(nn + 1) * mb]
+        if m == nn:
+            got, ref = np.tril(got), np.tril(ref)
+        np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+
+
+def _rejoin_proc(rank, nranks, port_base, transport, outq):
+    import time as _time
+    import traceback
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PARSEC_MCA_COMM_TRANSPORT"] = transport
+    os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
+    try:
+        from parsec_tpu.comm.engine import make_ce
+        from parsec_tpu.comm.remote_dep import RemoteDepEngine
+        from parsec_tpu.core.context import Context
+        from parsec_tpu.utils.mca import params
+
+        ce = make_ce(rank, nranks, port_base)
+        ctx = Context(nb_cores=2, rank=rank, nranks=nranks)
+        rde = RemoteDepEngine(ce, ctx)
+        ce.barrier()
+        _rejoin_phase(ctx, rank, nranks, "A")
+        ce.barrier()
+        if rank == 1:
+            rde.fini()                    # the rank goes down
+            _time.sleep(1.0)
+            params.set("comm_epoch", 1)   # restarted incarnation
+            ce = make_ce(rank, nranks, port_base)
+            rde = RemoteDepEngine(ce, ctx)
+            table = ctx.recovery.rejoin(timeout=30.0)
+            assert isinstance(table, dict)
+        else:
+            deadline = _time.monotonic() + 25
+            while 1 not in ce.dead_peers:
+                if _time.monotonic() > deadline:
+                    raise RuntimeError("rank 1 death never detected")
+                _time.sleep(0.02)
+            while 1 in ce.dead_peers:     # cleared by peer_rejoined
+                if _time.monotonic() > deadline + 35:
+                    raise RuntimeError("rank 1 never rejoined")
+                _time.sleep(0.02)
+            assert ctx.recovery.rejoins == 1
+        ce.barrier(timeout=30)
+        # the REJOINED rank serves its partition again, over the
+        # RE-CREATED transport state (fresh shm rings on shm)
+        _rejoin_phase(ctx, rank, nranks, "B")
+        ce.barrier(timeout=30)
+        ce._stop = True
+        outq.put((rank, None, "ok"))
+        ctx.fini()
+        rde.fini()
+    except Exception:
+        outq.put((rank, traceback.format_exc(), None))
+
+
+def rejoin_scenario(transport="shm", timeout=150.0):
+    """Run the kill -> restart -> TAG_REJOIN -> serves-again scenario
+    on one transport; returns (ok, detail)."""
+    import multiprocessing as mp
+    from parsec_tpu.comm.launch import _probe_port_base
+    base = _probe_port_base(2)
+    mpctx = mp.get_context("spawn")
+    outq = mpctx.Queue()
+    procs = [mpctx.Process(target=_rejoin_proc,
+                           args=(r, 2, base, transport, outq),
+                           daemon=True)
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results, errs = {}, []
+    try:
+        for _ in range(2):
+            rank, err, res = outq.get(timeout=timeout)
+            if err is not None:
+                errs.append(f"rank {rank}: {err}")
+            results[rank] = res
+    except Exception as exc:
+        errs.append(f"harness: {exc!r}")
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    ok = not errs and results == {0: "ok", 1: "ok"}
+    return ok, "; ".join(errs) if errs else repr(results)
+
+
+# ---------------------------------------------------------------------------
+# minimal-vs-full replay A/B (the premerge --ab-minimal leg and the
+# bench recovery mode both drive this)
+# ---------------------------------------------------------------------------
+
+def ab_chain_recover_workload(ctx, rank, nranks):
+    """The minimal-vs-full A/B DAG: a 20-step chain whose FIRST half
+    lives entirely on rank 0 and second half on rank 1 (tabular
+    placement), each body stalled 100 ms by a keyed delay_dispatch.
+    Built so the survivor PROVABLY holds completed-and-not-needed work
+    at ANY mid-run kill point: a kill during rank 0's half leaves its
+    completed prefix skippable (no remote send happened yet), and a
+    kill during rank 1's half leaves everything before the one
+    cross-rank edge skippable (the re-feed closure stops at the
+    boundary producer, whose output synthesizes from the live tile).
+    Replay-from-restore-point re-runs the WHOLE local partition either
+    way, so minimal < full deterministically."""
+    from parsec_tpu.core.task import (Dep, FromDesc, FromTask, READ,
+                                      WRITE, TaskClass, ToDesc, ToTask)
+    from parsec_tpu.core.taskpool import ParameterizedTaskpool
+    from parsec_tpu.data.matrix import TwoDimTabular
+
+    steps = 20
+    half = steps // 2
+    V = TwoDimTabular(2, 1, 2 * steps, 1,
+                      table=[0] * half + [1] * (steps - half),
+                      nodes=nranks, myrank=rank, name="Vab")
+    V.set_init(lambda m, n=0: np.zeros((2, 1), np.float32))
+    tc = TaskClass(
+        "W", params=[("i", lambda g, l: range(steps))],
+        affinity=lambda loc, V=V: V(loc["i"], 0),
+        flows=[READ("P",
+                    inputs=[Dep(FromTask("W", "T",
+                                         lambda loc:
+                                         {"i": loc["i"] - 1}),
+                                guard=lambda loc: loc["i"] > 0)]),
+               # WRITE access (full overwrite): a mid-body kill's
+               # stale-mutation taint on this tile must not force the
+               # minimal path into its fallback — the re-run rewrites
+               # the tile from P alone
+               WRITE("T",
+                     inputs=[Dep(FromDesc(lambda loc, V=V:
+                                          V(loc["i"], 0)))],
+                     outputs=[Dep(ToTask("W", "P",
+                                         lambda loc:
+                                         {"i": loc["i"] + 1}),
+                                  guard=lambda loc, s=steps:
+                                  loc["i"] < s - 1),
+                              Dep(ToDesc(lambda loc, V=V:
+                                         V(loc["i"], 0)))])],
+        incarnations=[("cpu", _chain_hook)])
+    p = ParameterizedTaskpool("ab-chain")
+    p.add_task_class(tc)
+    p.recovery_collections = [V]
+    ctx.add_taskpool(p)
+    ctx.wait(timeout=_wait_s())
+    for m, nn in V.local_tiles():
+        got = np.asarray(V.data_of(m, nn).pull_to_host().payload)
+        np.testing.assert_allclose(got, float(m + 1))
+    rec = ctx.recovery
+    st = rec.stats() if rec is not None else {}
+    return ("ok", st.get("tasks_reexecuted", 0),
+            st.get("minimal_replays", 0), st.get("full_replays", 0))
+
+
+_AB_PLAN = ("seed=11;kill_rank=1@t+1.0s,mode=close;"
+            "delay_dispatch=key~W(,ms=100")
+
+
+def run_ab_pair(timeout=120.0):
+    """Run the A/B kill twice — recorded-lineage minimal replay vs
+    forced replay-from-restore-point — and return
+    ``{mode: {"reexec", "minimal", "full", "makespan_s"}}``.  Raises
+    RuntimeError when either leg fails or the kill never fired (a run
+    that outpaced its trigger exercised no recovery)."""
+    from parsec_tpu.comm.launch import run_distributed
+    keys = _CHAOS_ENV + ("PARSEC_MCA_RECOVERY_MINIMAL",)
+    out = {}
+    for mode, knob in (("minimal", "1"), ("full", "0")):
+        saved = {k: os.environ.get(k) for k in keys}
+        os.environ["PARSEC_MCA_FAULT_PLAN"] = _AB_PLAN
+        os.environ["PARSEC_CHAOS_WAIT_S"] = "45"
+        os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
+        os.environ["PARSEC_MCA_RECOVERY_MINIMAL"] = knob
+        t0 = time.monotonic()
+        try:
+            res = run_distributed(ab_chain_recover_workload, 2,
+                                  timeout=timeout, tolerate_ranks=[1])
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        dt = time.monotonic() - t0
+        surv = res[0]
+        if surv is None or surv[0] != "ok":
+            raise RuntimeError(f"{mode} leg failed: {res!r}")
+        if res[1] is not None:
+            raise RuntimeError(
+                f"{mode} leg outpaced its kill trigger (victim "
+                "completed) — no recovery was exercised")
+        out[mode] = {"reexec": surv[1], "minimal": surv[2],
+                     "full": surv[3], "makespan_s": round(dt, 2)}
+    return out
+
+
+def run_ab_minimal(timeout=120.0) -> int:
+    """CI leg: assert tasks_reexecuted(minimal) < tasks_reexecuted(full)
+    on the acceptance DAG and that each leg took its intended path."""
+    try:
+        ab = run_ab_pair(timeout=timeout)
+    except RuntimeError as exc:
+        print(f"[FAIL] ab-minimal: {exc}")
+        return 1
+    ok = (ab["minimal"]["minimal"] >= 1 and ab["minimal"]["full"] == 0
+          and ab["full"]["full"] >= 1
+          and ab["minimal"]["reexec"] < ab["full"]["reexec"])
+    status = "PASS" if ok else "FAIL"
+    print(f"[{status}] ab-minimal: minimal re-executed "
+          f"{ab['minimal']['reexec']} vs full {ab['full']['reexec']} "
+          f"task(s) on the same kill "
+          f"(paths: minimal={ab['minimal']['minimal']}/"
+          f"{ab['minimal']['full']}, full={ab['full']['minimal']}/"
+          f"{ab['full']['full']}; makespans "
+          f"{ab['minimal']['makespan_s']}s vs "
+          f"{ab['full']['makespan_s']}s)")
+    return 0 if ok else 1
 
 #: (name, plan template, workload, expected outcome, extra env).
 #: {s} is the seed.  Expected outcomes:
@@ -282,6 +614,37 @@ CATALOG = [
       "PARSEC_MCA_COMM_PEER_TIMEOUT_S": "2",
       "PARSEC_MCA_COMM_TRANSPORT": "threads",
       "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    # minimal replay (r13): the deterministic A/B chain DAG, where the
+    # survivor MUST take the recorded-lineage minimal path — the
+    # workload raises if recovery silently fell back to full replay
+    # (the quantitative minimal<full check is chaos --ab-minimal)
+    ("kill-minimal-recover",
+     "seed={s};kill_rank=1@t+1.0s,mode=close;"
+     "delay_dispatch=key~W(,ms=100",
+     "ab-chain-minimal", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "45",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    # dyn-hold recovery (r13): a DynamicTaskpool killed with its
+    # distributed termination hold outstanding restarts on the survivor
+    # with the hold RE-ARMED (previously stranded across the restart)
+    ("kill-dyn-recover",
+     "seed={s};kill_rank=1@t+0.8s,mode=close;"
+     "delay_frame=tag:ACT,p=1,ms=150;delay_frame=tag:BATCH,p=1,ms=150",
+     "dyn-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "40",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    # dead-set agreement (r13): two NEAR-SIMULTANEOUS deaths on a
+    # 4-rank gang — the two survivors must converge on one confirmed
+    # dead set (coordinator broadcast) and complete with validated
+    # numerics instead of transiently divergent translation tables
+    ("multi-death-agreement",
+     "seed={s};kill_rank=2@t+1.0s,mode=close;"
+     "kill_rank=3@t+1.05s,mode=close;"
+     "delay_frame=tag:ACT,p=1,ms=120;delay_frame=tag:BATCH,p=1,ms=120",
+     "potrf-recover", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "60", "_NRANKS": "4", "_TOLERATE": "2,3",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1",
+      "PARSEC_MCA_RECOVERY_MAX_ATTEMPTS": "3"}),
     # survivor exhaustion: a second kill past the recovery budget must
     # end in a CLEAN structured failure, never a loop or a hang
     ("double-kill",
@@ -301,7 +664,9 @@ _QUICK = ("delay-v0", "delay-recv", "kill-close", "fail-task-retry",
 _RECOVER = ("kill-close-recover", "kill-hang-recover",
             "kill-dtd-recover", "kill-close-recover-shm",
             "kill-close-recover-threads", "kill-hang-recover-shm",
-            "kill-hang-recover-threads", "double-kill")
+            "kill-hang-recover-threads", "double-kill",
+            "kill-minimal-recover", "kill-dyn-recover",
+            "multi-death-agreement")
 
 _CHAOS_ENV = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
               "PARSEC_MCA_COMM_PEER_TIMEOUT_S",
@@ -387,6 +752,13 @@ def main(argv=None):
                          "numerics (plus survivor exhaustion)")
     ap.add_argument("--timeout", type=float, default=90.0,
                     help="per-run harness deadline (hang detector)")
+    ap.add_argument("--ab-minimal", action="store_true",
+                    help="minimal-vs-full replay A/B on the acceptance "
+                         "kill: asserts tasks_reexecuted(minimal) < "
+                         "tasks_reexecuted(full) (the premerge leg)")
+    ap.add_argument("--rejoin", default="",
+                    help="run the kill->restart->TAG_REJOIN scenario "
+                         "on one transport (threads/evloop/shm)")
     ap.add_argument("--only", default="",
                     help="comma-separated catalog entry names")
     ap.add_argument("--transport", default="",
@@ -395,6 +767,15 @@ def main(argv=None):
                          "catalog against it")
     ap.add_argument("--list", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.ab_minimal:
+        return run_ab_minimal(timeout=args.timeout)
+    if args.rejoin:
+        ok, detail = rejoin_scenario(args.rejoin,
+                                     timeout=max(args.timeout, 150.0))
+        print(f"[{'PASS' if ok else 'FAIL'}] rejoin-{args.rejoin}: "
+              f"{detail}")
+        return 0 if ok else 1
 
     catalog = CATALOG
     if args.quick:
@@ -427,7 +808,22 @@ def main(argv=None):
         if not ok:
             failures += 1
             print(f"       {detail}", flush=True)
-    print(f"chaos: {args.seeds - failures}/{args.seeds} plans held the "
+    total = args.seeds
+    if args.recover:
+        # the rejoin leg rides the recover acceptance run: shm was the
+        # one transport that could not rejoin before the ring
+        # re-creation landed (comm/shm.py)
+        total += 1
+        t0 = time.monotonic()
+        ok, detail = rejoin_scenario("shm",
+                                     timeout=max(args.timeout, 150.0))
+        dt = time.monotonic() - t0
+        print(f"[{'PASS' if ok else 'FAIL'}] rejoin-shm ({dt:.1f}s)",
+              flush=True)
+        if not ok:
+            failures += 1
+            print(f"       {detail[:400]}", flush=True)
+    print(f"chaos: {total - failures}/{total} plans held the "
           "no-hang invariant")
     return 1 if failures else 0
 

@@ -41,9 +41,12 @@ from tools.parseclint import FileCtx, Finding
 PASS_ID = "PCL-PROM"
 
 #: the modules whose ``parsec_*`` string literals ARE the scrape
-#: surface (prof/metrics.py collectors + prof/liveattr.py stragglers)
+#: surface (prof/metrics.py collectors, prof/liveattr.py stragglers,
+#: and the recovery coordinator's scrape-time collector — r13 brought
+#: its families into the documented README/COMPONENTS contract)
 EXPORT_FILES = ("parsec_tpu/prof/metrics.py",
-                "parsec_tpu/prof/liveattr.py")
+                "parsec_tpu/prof/liveattr.py",
+                "parsec_tpu/core/recovery.py")
 
 DOC_FILES = ("README.md", "COMPONENTS.md")
 

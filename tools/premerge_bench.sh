@@ -208,4 +208,12 @@ echo "== premerge probe: chaos (seeded fault plans, no-hang invariant) =="
 if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 8 --quick; then
     rc=1
 fi
+echo "== premerge probe: recovery minimal-vs-full replay A/B =="
+# r13: the recorded-lineage minimal replay must re-execute STRICTLY
+# FEWER tasks than replay-from-restore-point on the acceptance kill,
+# with each leg provably taking its intended path (a silent fallback
+# to full replay fails the gate)
+if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --ab-minimal; then
+    rc=1
+fi
 exit $rc
