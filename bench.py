@@ -702,6 +702,66 @@ def _empty_pool(n):
     return p.build()
 
 
+def _tasks_budget(ctx, total_us: float, k: int = 4000):
+    """Staged per-task budget breakdown (µs/task), so a future tasks/s
+    regression localizes to a stage instead of showing up as one opaque
+    headline drop:
+
+      construction  task-object build (C build_range or Task.__init__)
+      termdet       one LOCKED counter move — the cost the per-worker
+                    batching amortizes away (termdet_batch)
+      dispatch      one complete_exec PINS fan-out (metrics et al.)
+      progress      everything else: end-to-end per-task budget minus
+                    the measured construction share (scheduling +
+                    prepare/execute/complete chain, incl. the termdet
+                    and dispatch shares above)
+
+    Micro-measured in-process on the bench context; informational
+    (bench_guard skips ``budget``)."""
+    from parsec_tpu.core.task import Task, TaskClass
+    from parsec_tpu.core.taskpool import ParameterizedTaskpool
+    from parsec_tpu.core.termdet import LocalTermdet
+    tp = ParameterizedTaskpool("budget-probe")
+    tc = tp.add_task_class(TaskClass(
+        "Bgt", params=[("i", lambda g, l: range(k))],
+        body=lambda es, task: None))
+    vt = tc.native_vt()
+    t0 = time.perf_counter()
+    if vt is not None:
+        tasks = vt.build_range("i", 0, k, 1)
+    else:
+        tasks = [Task(tc, tp, {"i": j}) for j in range(k)]
+    construction = (time.perf_counter() - t0) / k * 1e6
+    td = LocalTermdet()
+    td.monitor(tp, lambda: None)   # NOT_READY: counters move, no fire
+    t0 = time.perf_counter()
+    for _ in range(k):
+        td.taskpool_addto_nb_tasks(tp, 1)
+        td.taskpool_addto_nb_tasks(tp, -1)
+    termdet = (time.perf_counter() - t0) / (2 * k) * 1e6
+    td.unmonitor(tp)
+    cbs = ctx._pins.get("complete_exec") or []
+    es = ctx.streams[0]
+    task = tasks[0]
+    # advance the stream's retired count per iteration (restored
+    # after): the metrics handler samples on nb_tasks_done % stride,
+    # and a FROZEN count makes the probe bimodal — all-sampled when
+    # the bench happened to end on a stride point, all-unsampled
+    # otherwise.  Walking it measures the production-amortized cost.
+    saved_nb = es.nb_tasks_done
+    t0 = time.perf_counter()
+    for _ in range(k):
+        for cb in cbs:
+            cb(es, "complete_exec", task)
+        es.nb_tasks_done += 1
+    dispatch = (time.perf_counter() - t0) / k * 1e6
+    es.nb_tasks_done = saved_nb
+    return {"construction_us": round(construction, 3),
+            "termdet_us": round(termdet, 3),
+            "dispatch_us": round(dispatch, 3),
+            "progress_us": round(max(0.0, total_us - construction), 3)}
+
+
 def run_tasks_bench(n: int = 20000):
     """Empty-body task throughput, tasks/s — the DAG-scheduling
     efficiency proxy (insert+wait over n no-op tasks; every runtime
@@ -729,12 +789,15 @@ def run_tasks_bench(n: int = 20000):
         ctx.add_taskpool(_empty_pool(n))
         ctx.wait()
         dt = time.perf_counter() - t0
+        budget = _tasks_budget(ctx, dt / n * 1e6)
         if mod is not None:
             mod.uninstall(ctx)
             tr.uninstall(ctx)
         native = {"sched_native":
                   1 if ctx.scheduler.name == "native" else 0}
-    return n / dt, {"native": native}
+        doorbell = {"suppressed": ctx._db_suppressed}
+    return n / dt, {"native": native, "budget": budget,
+                    "doorbell": doorbell}
 
 
 def run_telemetry_bench(n: int = 20000):
@@ -779,18 +842,26 @@ def run_telemetry_bench(n: int = 20000):
     # cleanest pair bounds the true overhead from below while staying
     # immune to one loaded window faking a gate failure
     pairs = []
+    us_pairs = []
     off = on = 0.0
     for _ in range(4):
         o, a = rate(0), rate(1)
         off, on = max(off, o), max(on, a)
-        if a:
+        if a and o:
             pairs.append(max(0.0, o / a - 1.0))
+            # the ABSOLUTE armed cost in us/task: the gate that stays
+            # meaningful as the base gets faster (at the r14 ~1us/task
+            # headline a constant 0.5us plane reads as +50% ratio —
+            # the ratio stopped measuring the telemetry code)
+            us_pairs.append(max(0.0, (1.0 / a - 1.0 / o) * 1e6))
     overhead = min(pairs) if pairs else 1.0
-    log(f"telemetry overhead: {overhead:+.1%} (min of "
-        f"{['%+.1f%%' % (p * 100) for p in pairs]}; best off "
-        f"{off:.0f} -> armed {on:.0f} tasks/s)")
+    overhead_us = min(us_pairs) if us_pairs else 10.0
+    log(f"telemetry overhead: {overhead:+.1%} / {overhead_us:.3f} "
+        f"us/task (min of {['%+.1f%%' % (p * 100) for p in pairs]}; "
+        f"best off {off:.0f} -> armed {on:.0f} tasks/s)")
     return overhead, {"tasks_off": round(off, 1),
-                      "tasks_on": round(on, 1)}
+                      "tasks_on": round(on, 1),
+                      "overhead_us": round(overhead_us, 3)}
 
 
 def run_stencil_bench(mb: int = 0, nt: int = 8, steps: int = 0):

@@ -43,6 +43,32 @@ params.register("task_retry_max", 0,
                 "TaskRetryExhausted (datarepo-versioned inputs plus a "
                 "pre-execution write-flow snapshot make re-execution "
                 "safe; 0 = off; read at Context construction)")
+params.register("termdet_batch", 64,
+                "per-worker termdet decrement batch: completion "
+                "decrements accumulate on the worker and flush to the "
+                "locked counter every N tasks and at every idle moment "
+                "(also the native run_quantum size).  1 = the pre-r14 "
+                "lock round-trip per task (the A/B knob); recovery "
+                "rewinds drop torn-generation batches under the "
+                "termdet lock, so the generation fence holds")
+params.register("comm_inline_poll", 1,
+                "idle workers briefly re-poll the ready queue (GIL-"
+                "yield spin) before blocking on the doorbell when a "
+                "comm engine is attached — an activation landing in "
+                "the window is picked up at GIL-handoff latency "
+                "instead of a condvar wakeup (the rtt queue-wait "
+                "lever).  0 = always block immediately; 1 = auto "
+                "(spin only when the host has a spare core — on 1 "
+                "core the spin steals the GIL from the comm loop it "
+                "waits on, measured +44% rtt); 2 = force on")
+params.register("doorbell_coalesce_us", 150,
+                "the worker-inlined poll window in microseconds (see "
+                "comm_inline_poll), which is also the window within "
+                "which producer doorbells coalesce: ring_doorbell "
+                "skips the condvar lock entirely while no worker has "
+                "raised its waiting flag — the shm doorbell's "
+                "waiting-flag suppression generalized to the worker "
+                "doorbell")
 params.register("runtime_gc_freeze", 1,
                 "freeze the already-imported object graph out of cyclic "
                 "GC's full-collection scans at first Context bring-up "
@@ -94,6 +120,12 @@ class ExecutionStream:
         #: None — recovery's in-flight drain polls it so tile restore
         #: never races a stale-generation body's in-place writes
         self.running_task = None
+        #: per-worker batched termdet accumulator ({taskpool: [epoch,
+        #: count]}) and its owning thread id — installed by worker_loop
+        #: (None = unbatched); single-writer: only the owning worker
+        #: thread mutates it, off-thread completers take the locked path
+        self._td_acc = None
+        self._td_tid = 0
         self._pins_cbs = {}
         #: the context's event->callbacks dict, aliased so the per-task
         #: dispatch reads one attribute (pins_register mutates the dict
@@ -148,6 +180,27 @@ class Context:
         #: transient-task retry budget, cached off the worker hot path
         #: (core/scheduling.task_progress probes it per task)
         self._retry_max = int(params.get("task_retry_max", 0))
+        #: worker-doorbell discipline (cached off the hot path):
+        #: per-worker termdet batch, the inlined-poll window, and the
+        #: waiting-flag counter ring_doorbell suppresses against
+        self._termdet_batch = max(1, int(params.get("termdet_batch", 64)))
+        try:
+            import os as _os
+            ncores = len(_os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            import os as _os
+            ncores = _os.cpu_count() or 1
+        # the spin needs a spare core: on a 1-core host a polling
+        # worker steals the GIL/CPU from the very comm loop whose
+        # delivery it is waiting for (measured: shm rtt 694 -> 1000
+        # us/hop with the spin forced on 1 core — BENCH.md r14);
+        # auto mode (1) arms it only with a spare core, 2 forces
+        ip = int(params.get("comm_inline_poll", 1))
+        self._db_spin_s = (
+            max(0, int(params.get("doorbell_coalesce_us", 150))) * 1e-6
+            if ip == 2 or (ip == 1 and ncores > 1) else 0.0)
+        self._db_waiters = 0          # GIL-atomic int (plain reads)
+        self._db_suppressed = 0       # doorbells coalesced away (stats)
 
         # device layer (reference: parsec_mca_device_init, parsec.c:823)
         from parsec_tpu.devices import init_devices
@@ -300,13 +353,39 @@ class Context:
 
     # -- doorbell ----------------------------------------------------------
     def ring_doorbell(self, n: int = 1) -> None:
-        with self._cond:
-            self._cond.notify(n)
+        """Wake up to ``n`` idle workers.  Coalesced: while no worker
+        has raised its waiting flag (busy or inside the inlined poll
+        window) the condvar lock is skipped entirely — the shm
+        transport's consumer-side waiting-flag suppression, applied to
+        the worker doorbell.  No lost wakeups: doorbell_wait raises
+        the flag and re-probes the queue under the lock, so a push
+        that raced the flag is observed by the probe."""
+        if self._db_waiters:
+            with self._cond:
+                self._cond.notify(n)
+        else:
+            self._db_suppressed += 1
 
-    def doorbell_wait(self, timeout: float) -> None:
+    def doorbell_wait(self, timeout: float, probe=None):
+        """Park until a doorbell or ``timeout``.  ``probe`` (the ready
+        queue's pop) re-checks for work under the lock AFTER the
+        waiting flag went up: a producer that pushed before reading
+        the flag is caught by the probe, one that read the flag after
+        our raise takes the notify path — either way no lost wakeup.
+        Returns the probed task, or None."""
         with self._cond:
-            if not self.finished:
+            if self.finished:
+                return None
+            self._db_waiters += 1
+            try:
+                if probe is not None:
+                    t = probe()
+                    if t is not None:
+                        return t
                 self._cond.wait(timeout)
+            finally:
+                self._db_waiters -= 1
+        return None
 
     # -- taskpool lifecycle ------------------------------------------------
     def add_taskpool(self, tp: Taskpool, start: bool = False) -> None:

@@ -90,7 +90,12 @@ def deliver_dep(taskpool, succ_tc: TaskClass, succ_locals: Dict[str, int],
         if res is None:
             return None
         locals_, inputs, sources = res
-        task = Task(succ_tc, taskpool, locals_)
+        # C task construction when the vtable exists: the record's
+        # locals dict is exclusively owned (created at nd.create,
+        # dropped with the record), so the constructor may alias it
+        vt = succ_tc.native_vt()
+        task = vt.build_one(locals_) if vt is not None \
+            else Task(succ_tc, taskpool, locals_)
         if taskpool.dynamic:
             # see the non-native branch below for the ordering contract
             taskpool.termdet.taskpool_addto_nb_tasks(taskpool, 1)

@@ -11,6 +11,7 @@ entering tasks through the pluggable scheduler and ringing the doorbell.
 from __future__ import annotations
 
 import time
+from threading import get_ident
 from typing import List, Optional
 
 from parsec_tpu.core import engine
@@ -135,6 +136,7 @@ def task_progress(es, task: Task, distance: int = 0) -> None:
             except Exception as exc:
                 debug_verbose(2, "discard %s: consume_inputs: %s",
                               task, exc)
+            # lint: ignore[PCL-HOT] cancelled-pool discard: cold path
             tp.termdet.taskpool_addto_nb_tasks(tp, -1)
             return
         cbs = es._pins_map.get("exec_begin")   # inlined es.pins (hot path)
@@ -290,32 +292,210 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
         for cb in cbs:
             cb(es, "complete_exec", task)
     es.nb_tasks_done += 1
-    tp.termdet.taskpool_addto_nb_tasks(tp, -1)
+    # batched termdet: decrements accumulate per WORKER and flush at
+    # batch boundaries/idle (worker_loop) instead of paying a
+    # threading.Lock round-trip per task.  Only the stream's OWNING
+    # worker thread may touch the accumulator — an ASYNC device
+    # completer finishing a task on its own thread with a borrowed es
+    # takes the locked path (no flush guarantee there, and the dict is
+    # single-writer by contract)
+    acc = es._td_acc
+    if acc is not None and get_ident() == es._td_tid:
+        ent = acc.get(tp)
+        if ent is not None and ent[0] == task.pool_epoch:
+            ent[1] += 1
+        else:
+            acc[tp] = [task.pool_epoch, 1]
+    else:
+        # lint: ignore[PCL-HOT] off-worker/batch=1 path: no accumulator
+        tp.termdet.taskpool_addto_nb_tasks(tp, -1)
+
+
+def _native_body_failed(es, task, exc, distance: int = 0) -> None:
+    """C-chain twin of ``task_progress``'s except branch: the trivial
+    hook raised (called from schedext's fast path so retry/containment
+    semantics stay byte-identical to the Python chain)."""
+    if _maybe_retry(es, task, exc, distance):
+        return
+    if task.retries:
+        exc = TaskRetryExhausted(
+            f"{task}: still failing after {task.retries + 1} "
+            "attempts", attempts=task.retries + 1, last=exc)
+    es.context.record_error(exc, task)
+    complete_execution(es, task, failed=True)
+
+
+def _native_hook_return(es, task, ret, distance: int = 0) -> None:
+    """C-chain twin of ``execute``'s return normalization plus
+    ``task_progress``'s dispatch, for a non-None return from a trivial
+    single-incarnation hook (AGAIN/ASYNC/DISABLE and raw values)."""
+    tc = task.task_class
+    if not isinstance(ret, HookReturn):
+        if isinstance(ret, int) and not isinstance(ret, bool):
+            try:
+                ret = HookReturn(ret)
+            except ValueError as exc:
+                # Python-chain parity: execute()'s HookReturn(ret) of
+                # an invalid code raises under task_progress's try and
+                # becomes a contained task failure — NOT an exception
+                # out of the worker loop (which would kill the thread
+                # and hang the run with zero recorded errors)
+                _native_body_failed(es, task, exc, distance)
+                return
+        else:
+            ret = _DONE
+    if ret == _NEXT or ret == _DISABLE:
+        # single-incarnation class: declining it leaves no taker
+        if ret == _DISABLE:
+            tc.chore_disabled_mask |= 1
+        else:
+            task.chore_mask &= ~1
+        warning("%s: no incarnation accepted the task", task)
+        ret = HookReturn.ERROR
+    if ret == _DONE:
+        cbs = es._pins_map.get("exec_end")   # inlined es.pins
+        if cbs:
+            for cb in cbs:
+                cb(es, "exec_end", task)
+        complete_execution(es, task)
+    elif ret == _ASYNC:
+        es.pins("exec_async", task)
+    elif ret == _AGAIN:
+        task.status = _READY
+        schedule(es, [task], distance + 1)
+    else:
+        es.context.record_error(
+            RuntimeError(f"{task} failed with {ret!r}"), task)
+        complete_execution(es, task, failed=True)
+
+
+def _td_flush(es) -> None:
+    """Apply the worker's batched termdet decrements — the batch
+    boundary (quantum end / idle / worker exit).  Each entry carries
+    the generation it accumulated under; the termdet drops
+    torn-generation deltas under its own lock (recovery rewind).
+
+    RE-ENTRANT: a flushed decrement can fire a pool termination whose
+    completion callback synchronously completes an ASYNC parent task
+    on THIS thread (core/recursive.py `_done`), appending to the
+    accumulator mid-flush — so the accumulator is snapshotted and
+    cleared FIRST, and re-entrant appends land in the fresh dict for
+    the next boundary (worker_loop's idle branch flushes whenever the
+    accumulator is non-empty, so they cannot strand)."""
+    acc = es._td_acc
+    if not acc:
+        return
+    items = list(acc.items())
+    acc.clear()
+    for tp, ent in items:
+        # the amortized lock round-trip the per-task path no longer pays
+        tp.termdet.taskpool_addto_nb_tasks(  # lint: ignore[PCL-HOT]
+            tp, -ent[1], epoch=ent[0])
+
+
+def _spin_poll(probe, window_s: float,
+               _perf=time.perf_counter, _sleep=time.sleep):
+    """Worker-inlined poll: briefly re-poll the ready queue, yielding
+    the GIL each round so the comm loop can park its deliveries —
+    an activation landing inside the window is picked up at
+    GIL-handoff latency instead of a condvar wakeup (the shm
+    doorbell's waiting-flag discipline, generalized to the worker
+    doorbell)."""
+    end = _perf() + window_s
+    while _perf() < end:
+        t = probe()
+        if t is not None:
+            return t
+        _sleep(0)   # lint: allow-blocking (GIL yield, not a wait)
+    return None
 
 
 def worker_loop(es) -> None:
-    """Steady-state worker (reference: __parsec_context_wait hot loop)."""
+    """Steady-state worker (reference: __parsec_context_wait hot loop).
+
+    Native path: ``schedext.run_quantum`` runs pop + select-PINS + the
+    whole trivial prepare/execute/complete chain for up to
+    ``termdet_batch`` tasks in ONE GIL crossing; tasks the C chain
+    cannot take pop out (select already fired) for ``task_progress``.
+    Termdet decrements accumulate per worker and flush at quantum
+    boundaries and idle moments instead of locking per task."""
     ctx = es.context
     sched = ctx.scheduler
+    native = sched.NATIVE_BATCH
     # native hot path: pop straight off the C ready queue, skipping the
     # select() frame (one Python call per task at 100k+ tasks/s)
-    pop = sched._q.pop if sched.NATIVE_BATCH else None
+    pop = sched._q.pop if native else None
+    quantum = q = None
+    if native:
+        from parsec_tpu.native import load_schedext
+        se = load_schedext()
+        if se is not None and hasattr(se, "run_quantum"):
+            quantum, q = se.run_quantum, sched._q
+    batch = ctx._termdet_batch
+    es._td_tid = get_ident()
+    es._td_acc = {} if batch > 1 else None
+    probe = pop if pop is not None else (lambda: sched.select(es))
     pins_map = es._pins_map
     misses = 0
+    done_since = 0
+    n = 0
+    spin_s = ctx._db_spin_s
     while not ctx.finished:
-        task = pop() if pop is not None else sched.select(es)
+        sel_fired = False
+        if quantum is not None:
+            n, task = quantum(es, q, batch)
+            # the C quantum fires select before handing a task back
+            sel_fired = task is not None
+            if n:
+                misses = 0
+                done_since += n
+                if done_since >= batch:
+                    _td_flush(es)
+                    done_since = 0
+        else:
+            task = probe()
+        if task is None and quantum is not None and n:
+            continue   # progressed this quantum; go straight back
         if task is None:
+            # idle moment: flush batched termdet (termination needs the
+            # final decrements) — unconditionally on a non-empty
+            # accumulator: a flush-fired completion callback may have
+            # re-entered complete_execution and deposited a decrement
+            # AFTER the counting reset — then drain deferred wavefront
+            # placements (comm/ici.py defer_place) and wait
+            if es._td_acc:
+                _td_flush(es)
+                done_since = 0
             misses += 1
-            # idle moment: drain any deferred wavefront placements whose
-            # batching window expired (comm/ici.py defer_place)
             ctx.flush_ici()
-            # exponential backoff on miss (reference: scheduling.c:596-635)
-            ctx.doorbell_wait(min(0.0002 * (1 << min(misses, 8)), 0.05))
-            continue
+            if misses <= 2 and spin_s > 0 and ctx.comm is not None:
+                # worker-inlined comm poll (comm_inline_poll): cover
+                # the just-went-idle window before paying a condvar
+                # round-trip — the rtt wakeup-latency lever
+                task = _spin_poll(probe, spin_s)
+            if task is None:
+                # exponential backoff on miss (reference:
+                # scheduling.c:596-635); the probe re-checks the queue
+                # under the doorbell lock so a push racing the
+                # waiting-flag cannot be lost
+                task = ctx.doorbell_wait(
+                    min(0.0002 * (1 << min(misses, 8)), 0.05), probe)
+            if task is None:
+                continue
         misses = 0
-        cbs = pins_map.get("select")   # inlined es.pins
-        if cbs:
-            for cb in cbs:
-                cb(es, "select", task)
+        # select fires exactly once per task: the C quantum already
+        # fired it for tasks IT hands back; spin/doorbell tasks and
+        # the whole Python path arrive unfired
+        if not sel_fired:
+            cbs = pins_map.get("select")   # inlined es.pins
+            if cbs:
+                for cb in cbs:
+                    cb(es, "select", task)
         task_progress(es, task)
+        done_since += 1
+        if done_since >= batch:
+            _td_flush(es)
+            done_since = 0
+    while es._td_acc:   # worker exit: drain re-entrant deposits too
+        _td_flush(es)
     debug_verbose(9, "worker %d: %d tasks", es.th_id, es.nb_tasks_done)

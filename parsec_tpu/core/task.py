@@ -267,6 +267,9 @@ class TaskClass:
         self.task_class_id = -1    # assigned by the taskpool
         self.repo = None           # DataRepo, created by the taskpool
         self.taskpool = None
+        #: native per-class vtable (schedext.TaskVT): False = not yet
+        #: resolved, None = native path off / extension missing
+        self._vt = False
 
     def flow(self, name: str) -> Flow:
         return self._flow_by_name[name]
@@ -341,6 +344,48 @@ class TaskClass:
             if dep.applies(locals_):
                 n += dep.multiplicity(locals_)
         return n
+
+    def native_vt(self):
+        """The native per-class vtable (reference: the
+        ``parsec_task_class_t`` vtable — schedext.TaskVT): C-side task
+        construction for every class, plus the one-crossing trivial
+        progress chain for classes with no data flows and a single cpu
+        incarnation.  None when the native hot path is off or the
+        extension did not build; resolved once per class (a class
+        belongs to exactly one taskpool)."""
+        vt = self._vt
+        if vt is not False:
+            return vt
+        self._vt = None
+        if self.taskpool is None:
+            self._vt = False    # not attached yet: retry at next ask
+            return None
+        from parsec_tpu.utils.mca import params
+        if not int(params.get("sched_native", 1)):
+            return None
+        from parsec_tpu.native import load_schedext
+        se = load_schedext()
+        if se is None or not hasattr(se, "TaskVT"):
+            return None
+        # drift guard: the C chain hardcodes the TaskStatus values
+        if (int(TaskStatus.PENDING), int(TaskStatus.PREPARED),
+                int(TaskStatus.RUNNING),
+                int(TaskStatus.COMPLETE)) != (0, 2, 3, 4):
+            raise RuntimeError(
+                "TaskStatus drifted from schedext's hardcoded values")
+        trivial = (not self._in_flows and not self._out_flows
+                   and not self._write_flows
+                   and len(self.incarnations) == 1
+                   and self.incarnations[0][0] == "cpu"
+                   and getattr(self.taskpool, "dynamic_release",
+                               None) is None)
+        hook = self.incarnations[0][1] if trivial else None
+        self._vt = se.TaskVT(self, self.taskpool, self.name,
+                             self._param_names,
+                             tuple(f.name for f in self.flows),
+                             self.priority, self.key_fn, hook,
+                             bool(trivial))
+        return self._vt
 
     def rank_of(self, locals_: Dict[str, int]) -> int:
         if self.affinity is None:

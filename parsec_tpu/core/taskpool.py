@@ -273,6 +273,26 @@ class ParameterizedTaskpool(Taskpool):
             # classes with no task-fed inputs skip the per-instance
             # countdown probe entirely (class-level partition, task.py)
             all_ready = not tc._ft_inputs
+            vt = tc.native_vt()
+            if vt is not None and all_ready and aff is None \
+                    and flt is None and tc.key_fn is None \
+                    and len(tc.params) == 1:
+                # flat dep-free class (the independent-task shape):
+                # enumerate AND construct directly from the parameter
+                # range in C — Python Task.__init__ and the per-
+                # instance dict build leave the startup hot loop
+                # entirely (schedext.TaskVT.build_range)
+                space = tc.params[0][1](self.globals, {})
+                if isinstance(space, range):
+                    tasks = vt.build_range(tc.params[0][0], space.start,
+                                           space.stop, space.step)
+                else:
+                    name = tc.params[0][0]
+                    tasks = vt.build_batch([{name: v} for v in space])
+                nb_local += len(tasks)
+                ready.extend(tasks)
+                continue
+            build = vt.build_one if vt is not None else None
             for locals_ in tc.iter_space(self.globals):
                 # owner-computes through the recovery translation: a
                 # dead rank's partition enumerates on its adopting
@@ -287,7 +307,10 @@ class ParameterizedTaskpool(Taskpool):
                     continue
                 nb_local += 1
                 if all_ready or tc.nb_task_inputs(locals_) == 0:
-                    append(Task(tc, self, locals_))
+                    # iter_space yields a fresh dict per instance, so
+                    # the C constructor may alias it (build_one)
+                    append(build(locals_) if build is not None
+                           else Task(tc, self, locals_))
         if nb_local:
             self.termdet.taskpool_addto_nb_tasks(self, nb_local)
         return ready

@@ -44,7 +44,14 @@ class Termdet:
     def taskpool_ready(self, taskpool) -> None:
         raise NotImplementedError
 
-    def taskpool_addto_nb_tasks(self, taskpool, delta: int) -> int:
+    def taskpool_addto_nb_tasks(self, taskpool, delta: int,
+                                epoch: Optional[int] = None) -> int:
+        """``epoch`` carries a BATCHED delta's recovery generation
+        (core/scheduling's per-worker accumulators): the delta applies
+        only while ``taskpool.run_epoch`` still matches — a flush
+        racing a recovery restart drops its torn-generation counts
+        under the module lock instead of corrupting the re-counted
+        pool (the rewind/generation-fence contract)."""
         raise NotImplementedError
 
     def taskpool_addto_runtime_actions(self, taskpool, delta: int) -> int:
@@ -120,10 +127,20 @@ class LocalTermdet(Termdet):
         if fire:
             st["cb"]()
 
-    def _addto(self, taskpool, field: str, delta: int) -> int:
+    def _addto(self, taskpool, field: str, delta: int,
+               epoch: Optional[int] = None) -> int:
         fire = False
         with self._lock:
             st = self._state.get(id(taskpool))
+            if epoch is not None and \
+                    epoch != getattr(taskpool, "run_epoch", 0):
+                # torn-generation batch flush: the pool restarted after
+                # these decrements accumulated; the restart re-counted
+                # nb_tasks from scratch, so the stale delta must drop.
+                # Checked under the lock: taskpool_reset serializes on
+                # it, so a matching epoch here cannot be zeroed away
+                # between this check and the apply below
+                return getattr(taskpool, field)
             setattr(taskpool, field, getattr(taskpool, field) + delta)
             val = getattr(taskpool, field)
             if val < 0:
@@ -143,8 +160,9 @@ class LocalTermdet(Termdet):
             st["cb"]()
         return val
 
-    def taskpool_addto_nb_tasks(self, taskpool, delta: int) -> int:
-        return self._addto(taskpool, "nb_tasks", delta)
+    def taskpool_addto_nb_tasks(self, taskpool, delta: int,
+                                epoch: Optional[int] = None) -> int:
+        return self._addto(taskpool, "nb_tasks", delta, epoch)
 
     def taskpool_addto_runtime_actions(self, taskpool, delta: int) -> int:
         return self._addto(taskpool, "nb_pending_actions", delta)

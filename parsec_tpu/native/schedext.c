@@ -473,6 +473,904 @@ static PyTypeObject DTType = {
 };
 
 /* ------------------------------------------------------------------ */
+/* TaskCore: the C task object (reference: parsec_task_t as a plain   */
+/* C struct).  Field-for-field twin of core/task.py Task's slots so   */
+/* every Python consumer (engine, devices, profilers, recovery) works */
+/* unchanged by attribute access; construction and the trivial        */
+/* progress chain below never enter bytecode.                         */
+/* ------------------------------------------------------------------ */
+
+#include <structmember.h>
+
+/* TaskStatus values (core/task.py TaskStatus IntEnum; asserted at
+ * vtable construction on the Python side so drift cannot go silent) */
+#define ST_PENDING 0
+#define ST_PREPARED 2
+#define ST_RUNNING 3
+#define ST_COMPLETE 4
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *task_class, *taskpool, *locals, *key, *data;
+    PyObject *input_sources, *pinned_flows, *device, *prof, *dtd;
+    PyObject *ready_at, *mtr_t0, *retry_snap;
+    PyObject *vt;          /* TaskVT or NULL (reads as None) */
+    long long priority, seq, pool_epoch;
+    int status, chore_mask, retries;
+} TCObject;
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *task_class, *taskpool;
+    PyObject *name;         /* tc.name (the key head) */
+    PyObject *param_names;  /* tuple of str, make_key order */
+    PyObject *flow_names;   /* tuple of str, every flow */
+    PyObject *priority_fn;  /* callable or None */
+    PyObject *key_fn;       /* callable or None */
+    PyObject *hook;         /* the single trivial cpu hook, or None */
+    int trivial;
+} VTObject;
+
+/* interned attribute names for the progress chain (module init) */
+static PyObject *s_pins_map, *s_running_task, *s_nb_tasks_done,
+    *s_td_acc, *s_cancelled, *s_lineage, *s_context, *s_comm,
+    *s_run_epoch, *s_termdet, *s_addto, *s_chore_disabled,
+    *s_select, *s_exec_begin, *s_exec_end, *s_complete_exec,
+    *s_task_discard;
+
+/* lazily-bound runtime objects (cached after first use; importing an
+ * already-loaded module is a sys.modules dict hit) */
+static PyObject *g_seq_iter;      /* core.task._task_seq (itertools.count) */
+static PyObject *g_fi_dict;       /* utils.faultinject module __dict__ */
+static PyObject *g_body_failed;   /* scheduling._native_body_failed */
+static PyObject *g_hook_return;   /* scheduling._native_hook_return */
+static PyObject *g_one, *g_neg1;  /* cached small ints (module init) */
+
+static int ensure_runtime(void) {
+    if (g_body_failed)
+        return 0;
+    PyObject *m = PyImport_ImportModule("parsec_tpu.core.task");
+    if (!m)
+        return -1;
+    g_seq_iter = PyObject_GetAttrString(m, "_task_seq");
+    Py_DECREF(m);
+    if (!g_seq_iter)
+        return -1;
+    m = PyImport_ImportModule("parsec_tpu.utils.faultinject");
+    if (!m)
+        return -1;
+    g_fi_dict = PyModule_GetDict(m);   /* borrowed, module is cached */
+    Py_INCREF(g_fi_dict);
+    Py_DECREF(m);
+    m = PyImport_ImportModule("parsec_tpu.core.scheduling");
+    if (!m)
+        return -1;
+    g_hook_return = PyObject_GetAttrString(m, "_native_hook_return");
+    g_body_failed = PyObject_GetAttrString(m, "_native_body_failed");
+    Py_DECREF(m);
+    if (!g_hook_return || !g_body_failed) {
+        Py_CLEAR(g_body_failed);
+        Py_CLEAR(g_hook_return);
+        return -1;
+    }
+    return 0;
+}
+
+/* -- TaskCore type -------------------------------------------------- */
+
+static PyMemberDef tc_members[] = {
+    {"task_class", T_OBJECT, offsetof(TCObject, task_class), 0, NULL},
+    {"taskpool", T_OBJECT, offsetof(TCObject, taskpool), 0, NULL},
+    {"locals", T_OBJECT, offsetof(TCObject, locals), 0, NULL},
+    {"key", T_OBJECT, offsetof(TCObject, key), 0, NULL},
+    {"data", T_OBJECT, offsetof(TCObject, data), 0, NULL},
+    {"input_sources", T_OBJECT, offsetof(TCObject, input_sources), 0, NULL},
+    {"pinned_flows", T_OBJECT, offsetof(TCObject, pinned_flows), 0, NULL},
+    {"device", T_OBJECT, offsetof(TCObject, device), 0, NULL},
+    {"prof", T_OBJECT, offsetof(TCObject, prof), 0, NULL},
+    {"dtd", T_OBJECT, offsetof(TCObject, dtd), 0, NULL},
+    {"ready_at", T_OBJECT, offsetof(TCObject, ready_at), 0, NULL},
+    {"mtr_t0", T_OBJECT, offsetof(TCObject, mtr_t0), 0, NULL},
+    {"retry_snap", T_OBJECT, offsetof(TCObject, retry_snap), 0, NULL},
+    {"vt", T_OBJECT, offsetof(TCObject, vt), READONLY, NULL},
+    {"priority", T_LONGLONG, offsetof(TCObject, priority), 0, NULL},
+    {"seq", T_LONGLONG, offsetof(TCObject, seq), 0, NULL},
+    {"pool_epoch", T_LONGLONG, offsetof(TCObject, pool_epoch), 0, NULL},
+    {"status", T_INT, offsetof(TCObject, status), 0, NULL},
+    {"chore_mask", T_INT, offsetof(TCObject, chore_mask), 0, NULL},
+    {"retries", T_INT, offsetof(TCObject, retries), 0, NULL},
+    {NULL, 0, 0, 0, NULL}};
+
+static int tc_traverse(PyObject *self_, visitproc visit, void *arg) {
+    TCObject *t = (TCObject *)self_;
+    Py_VISIT(t->task_class);
+    Py_VISIT(t->taskpool);
+    Py_VISIT(t->locals);
+    Py_VISIT(t->key);
+    Py_VISIT(t->data);
+    Py_VISIT(t->input_sources);
+    Py_VISIT(t->pinned_flows);
+    Py_VISIT(t->device);
+    Py_VISIT(t->prof);
+    Py_VISIT(t->dtd);
+    Py_VISIT(t->ready_at);
+    Py_VISIT(t->mtr_t0);
+    Py_VISIT(t->retry_snap);
+    Py_VISIT(t->vt);
+    return 0;
+}
+
+static int tc_clear(PyObject *self_) {
+    TCObject *t = (TCObject *)self_;
+    Py_CLEAR(t->task_class);
+    Py_CLEAR(t->taskpool);
+    Py_CLEAR(t->locals);
+    Py_CLEAR(t->key);
+    Py_CLEAR(t->data);
+    Py_CLEAR(t->input_sources);
+    Py_CLEAR(t->pinned_flows);
+    Py_CLEAR(t->device);
+    Py_CLEAR(t->prof);
+    Py_CLEAR(t->dtd);
+    Py_CLEAR(t->ready_at);
+    Py_CLEAR(t->mtr_t0);
+    Py_CLEAR(t->retry_snap);
+    Py_CLEAR(t->vt);
+    return 0;
+}
+
+static void tc_dealloc(PyObject *self_) {
+    PyObject_GC_UnTrack(self_);
+    tc_clear(self_);
+    Py_TYPE(self_)->tp_free(self_);
+}
+
+/* repr matches core/task.py Task: "Name(k=1,m=2)" */
+static PyObject *tc_repr(PyObject *self_) {
+    TCObject *t = (TCObject *)self_;
+    PyObject *name = t->task_class
+        ? PyObject_GetAttrString(t->task_class, "name") : NULL;
+    if (!name) {
+        PyErr_Clear();
+        name = PyUnicode_FromString("?");
+        if (!name)
+            return NULL;
+    }
+    PyObject *parts = PyList_New(0);
+    if (!parts) {
+        Py_DECREF(name);
+        return NULL;
+    }
+    if (t->locals && PyDict_Check(t->locals)) {
+        PyObject *k, *v;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(t->locals, &pos, &k, &v)) {
+            PyObject *s = PyUnicode_FromFormat("%U=%S", k, v);
+            if (!s || PyList_Append(parts, s) < 0) {
+                Py_XDECREF(s);
+                Py_DECREF(parts);
+                Py_DECREF(name);
+                return NULL;
+            }
+            Py_DECREF(s);
+        }
+    }
+    PyObject *sep = PyUnicode_FromString(",");
+    PyObject *args = sep ? PyUnicode_Join(sep, parts) : NULL;
+    Py_XDECREF(sep);
+    Py_DECREF(parts);
+    if (!args) {
+        Py_DECREF(name);
+        return NULL;
+    }
+    PyObject *out = PyUnicode_FromFormat("%U(%U)", name, args);
+    Py_DECREF(name);
+    Py_DECREF(args);
+    return out;
+}
+
+static PyTypeObject TCType = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "schedext.TaskCore",
+    .tp_basicsize = sizeof(TCObject),
+    .tp_dealloc = tc_dealloc,
+    .tp_repr = tc_repr,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = tc_traverse,
+    .tp_clear = tc_clear,
+    .tp_members = tc_members,
+    .tp_new = NULL,   /* construct via TaskVT.build_* only */
+};
+
+/* -- TaskVT: the per-task-class vtable ------------------------------ */
+
+static int vt_traverse(PyObject *self_, visitproc visit, void *arg) {
+    VTObject *v = (VTObject *)self_;
+    Py_VISIT(v->task_class);
+    Py_VISIT(v->taskpool);
+    Py_VISIT(v->name);
+    Py_VISIT(v->param_names);
+    Py_VISIT(v->flow_names);
+    Py_VISIT(v->priority_fn);
+    Py_VISIT(v->key_fn);
+    Py_VISIT(v->hook);
+    return 0;
+}
+
+static int vt_clear(PyObject *self_) {
+    VTObject *v = (VTObject *)self_;
+    Py_CLEAR(v->task_class);
+    Py_CLEAR(v->taskpool);
+    Py_CLEAR(v->name);
+    Py_CLEAR(v->param_names);
+    Py_CLEAR(v->flow_names);
+    Py_CLEAR(v->priority_fn);
+    Py_CLEAR(v->key_fn);
+    Py_CLEAR(v->hook);
+    return 0;
+}
+
+static void vt_dealloc(PyObject *self_) {
+    PyObject_GC_UnTrack(self_);
+    vt_clear(self_);
+    Py_TYPE(self_)->tp_free(self_);
+}
+
+static int vt_init(PyObject *self_, PyObject *args, PyObject *kwds) {
+    (void)kwds;
+    VTObject *v = (VTObject *)self_;
+    PyObject *tc, *tp, *name, *pnames, *fnames, *prio, *keyfn, *hook;
+    int trivial;
+    if (!PyArg_ParseTuple(args, "OOO!O!O!OOOp", &tc, &tp,
+                          &PyUnicode_Type, &name,
+                          &PyTuple_Type, &pnames,
+                          &PyTuple_Type, &fnames,
+                          &prio, &keyfn, &hook, &trivial))
+        return -1;
+    Py_INCREF(tc);
+    Py_XSETREF(v->task_class, tc);
+    Py_INCREF(tp);
+    Py_XSETREF(v->taskpool, tp);
+    Py_INCREF(name);
+    Py_XSETREF(v->name, name);
+    Py_INCREF(pnames);
+    Py_XSETREF(v->param_names, pnames);
+    Py_INCREF(fnames);
+    Py_XSETREF(v->flow_names, fnames);
+    Py_INCREF(prio);
+    Py_XSETREF(v->priority_fn, prio);
+    Py_INCREF(keyfn);
+    Py_XSETREF(v->key_fn, keyfn);
+    Py_INCREF(hook);
+    Py_XSETREF(v->hook, hook);
+    v->trivial = trivial && hook != Py_None;
+    return 0;
+}
+
+static PyObject *vt_new(PyTypeObject *type, PyObject *args,
+                        PyObject *kwds) {
+    (void)args;
+    (void)kwds;
+    VTObject *v = (VTObject *)type->tp_alloc(type, 0);
+    return (PyObject *)v;
+}
+
+static long long vt_attr_ll(PyObject *obj, const char *name,
+                            long long dflt) {
+    PyObject *a = PyObject_GetAttrString(obj, name);
+    if (!a) {
+        PyErr_Clear();
+        return dflt;
+    }
+    long long r = PyLong_AsLongLong(a);
+    Py_DECREF(a);
+    if (r == -1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        return dflt;
+    }
+    return r;
+}
+
+/* one task: locals is ALIASED (the caller guarantees a fresh,
+ * exclusively-owned dict — iter_space / the DepTable record both
+ * produce one per instance) */
+static PyObject *vt_build_task(VTObject *v, PyObject *locals,
+                               long long epoch, long long pool_prio) {
+    if (ensure_runtime() < 0)
+        return NULL;
+    TCObject *t = (TCObject *)TCType.tp_alloc(&TCType, 0);
+    if (!t)
+        return NULL;
+    Py_INCREF(v->task_class);
+    t->task_class = v->task_class;
+    Py_INCREF(v->taskpool);
+    t->taskpool = v->taskpool;
+    Py_INCREF(locals);
+    t->locals = locals;
+    Py_INCREF((PyObject *)v);
+    t->vt = (PyObject *)v;
+    t->status = ST_PENDING;
+    t->chore_mask = 0xFFFF;
+    t->retries = 0;
+    t->pool_epoch = epoch;
+    t->priority = pool_prio;
+    /* key = (name,) + params, or (name, key_fn(locals)) */
+    if (v->key_fn != Py_None) {
+        PyObject *k2 = PyObject_CallFunctionObjArgs(v->key_fn, locals,
+                                                    NULL);
+        if (!k2)
+            goto fail;
+        t->key = PyTuple_Pack(2, v->name, k2);
+        Py_DECREF(k2);
+        if (!t->key)
+            goto fail;
+    } else {
+        Py_ssize_t np = PyTuple_GET_SIZE(v->param_names);
+        t->key = PyTuple_New(1 + np);
+        if (!t->key)
+            goto fail;
+        Py_INCREF(v->name);
+        PyTuple_SET_ITEM(t->key, 0, v->name);
+        for (Py_ssize_t i = 0; i < np; i++) {
+            PyObject *pv = PyDict_GetItemWithError(
+                locals, PyTuple_GET_ITEM(v->param_names, i));
+            if (!pv) {
+                if (!PyErr_Occurred())
+                    PyErr_Format(PyExc_KeyError, "task param %R missing",
+                                 PyTuple_GET_ITEM(v->param_names, i));
+                goto fail;
+            }
+            Py_INCREF(pv);
+            PyTuple_SET_ITEM(t->key, 1 + i, pv);
+        }
+    }
+    if (v->priority_fn != Py_None) {
+        PyObject *p = PyObject_CallFunctionObjArgs(v->priority_fn,
+                                                   locals, NULL);
+        if (!p)
+            goto fail;
+        long long cp = PyLong_AsLongLong(p);
+        Py_DECREF(p);
+        if (cp == -1 && PyErr_Occurred())
+            goto fail;
+        t->priority += cp;
+    }
+    {
+        /* itertools.count: the ONE process-global task sequence,
+         * shared with Python Task.__init__ */
+        PyObject *seq = PyIter_Next(g_seq_iter);
+        if (!seq)
+            goto fail;
+        t->seq = PyLong_AsLongLong(seq);
+        Py_DECREF(seq);
+    }
+    t->data = PyDict_New();
+    t->input_sources = PyDict_New();
+    t->pinned_flows = PySet_New(NULL);
+    if (!t->data || !t->input_sources || !t->pinned_flows)
+        goto fail;
+    /* tp_alloc already GC-tracked the object (PyType_GenericAlloc) */
+    return (PyObject *)t;
+fail:
+    Py_DECREF((PyObject *)t);
+    return NULL;
+}
+
+/* build_batch(locals_seq) -> [TaskCore, ...]: one crossing for the
+ * whole enumeration stream (Python Task.__init__ leaves the hot loop) */
+static PyObject *vt_build_batch(PyObject *self_, PyObject *arg) {
+    VTObject *v = (VTObject *)self_;
+    PyObject *fast = PySequence_Fast(arg, "locals_seq must be a sequence");
+    if (!fast)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    long long epoch = vt_attr_ll(v->taskpool, "run_epoch", 0);
+    long long prio = vt_attr_ll(v->taskpool, "priority", 0);
+    PyObject *out = PyList_New(n);
+    if (!out) {
+        Py_DECREF(fast);
+        return NULL;
+    }
+    PyObject **items = PySequence_Fast_ITEMS(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *t = vt_build_task(v, items[i], epoch, prio);
+        if (!t) {
+            Py_DECREF(out);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, t);
+    }
+    Py_DECREF(fast);
+    return out;
+}
+
+/* build_range(name, start, stop, step) -> [TaskCore, ...]: the flat
+ * single-parameter space fully enumerated AND constructed in C (the
+ * independent-task shape: locals dicts, keys, tasks — zero bytecode
+ * per instance) */
+static PyObject *vt_build_range(PyObject *self_, PyObject *const *args,
+                                Py_ssize_t nargs) {
+    VTObject *v = (VTObject *)self_;
+    if (nargs != 4) {
+        PyErr_SetString(PyExc_TypeError,
+                        "build_range(name, start, stop, step)");
+        return NULL;
+    }
+    PyObject *name = args[0];
+    long long start = PyLong_AsLongLong(args[1]);
+    long long stop = PyLong_AsLongLong(args[2]);
+    long long step = PyLong_AsLongLong(args[3]);
+    if (PyErr_Occurred())
+        return NULL;
+    if (step == 0) {
+        PyErr_SetString(PyExc_ValueError, "step must not be zero");
+        return NULL;
+    }
+    long long count = 0;
+    if (step > 0 && stop > start)
+        count = (stop - start + step - 1) / step;
+    else if (step < 0 && stop < start)
+        count = (start - stop + (-step) - 1) / (-step);
+    long long epoch = vt_attr_ll(v->taskpool, "run_epoch", 0);
+    long long prio = vt_attr_ll(v->taskpool, "priority", 0);
+    PyObject *out = PyList_New((Py_ssize_t)count);
+    if (!out)
+        return NULL;
+    long long val = start;
+    for (Py_ssize_t i = 0; i < (Py_ssize_t)count; i++, val += step) {
+        PyObject *locals = PyDict_New();
+        PyObject *pv = locals ? PyLong_FromLongLong(val) : NULL;
+        if (!pv || PyDict_SetItem(locals, name, pv) < 0) {
+            Py_XDECREF(pv);
+            Py_XDECREF(locals);
+            Py_DECREF(out);
+            return NULL;
+        }
+        Py_DECREF(pv);
+        PyObject *t = vt_build_task(v, locals, epoch, prio);
+        Py_DECREF(locals);
+        if (!t) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, t);
+    }
+    return out;
+}
+
+/* build_one(locals) -> TaskCore (the deliver_dep readiness path) */
+static PyObject *vt_build_one(PyObject *self_, PyObject *locals) {
+    VTObject *v = (VTObject *)self_;
+    if (!PyDict_Check(locals)) {
+        PyErr_SetString(PyExc_TypeError, "locals must be a dict");
+        return NULL;
+    }
+    return vt_build_task(v, locals,
+                         vt_attr_ll(v->taskpool, "run_epoch", 0),
+                         vt_attr_ll(v->taskpool, "priority", 0));
+}
+
+static PyMethodDef vt_methods[] = {
+    {"build_batch", (PyCFunction)vt_build_batch, METH_O,
+     "build_batch(locals_seq) -> [TaskCore]"},
+    {"build_range", (PyCFunction)(void (*)(void))vt_build_range,
+     METH_FASTCALL,
+     "build_range(name, start, stop, step) -> [TaskCore] (flat space)"},
+    {"build_one", (PyCFunction)vt_build_one, METH_O,
+     "build_one(locals) -> TaskCore"},
+    {NULL, NULL, 0, NULL}};
+
+static PyMemberDef vt_members[] = {
+    {"task_class", T_OBJECT, offsetof(VTObject, task_class), READONLY,
+     NULL},
+    {"taskpool", T_OBJECT, offsetof(VTObject, taskpool), READONLY, NULL},
+    {"trivial", T_INT, offsetof(VTObject, trivial), READONLY, NULL},
+    {NULL, 0, 0, 0, NULL}};
+
+static PyTypeObject VTType = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "schedext.TaskVT",
+    .tp_basicsize = sizeof(VTObject),
+    .tp_dealloc = vt_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = vt_traverse,
+    .tp_clear = vt_clear,
+    .tp_methods = vt_methods,
+    .tp_members = vt_members,
+    .tp_init = vt_init,
+    .tp_new = vt_new,
+};
+
+/* ------------------------------------------------------------------ */
+/* run_quantum: the worker inner loop in one crossing                  */
+/* ------------------------------------------------------------------ */
+
+/* dispatch one PINS event to a callback list (borrowed refs) */
+static int pins_dispatch(PyObject *cbs, PyObject *es, PyObject *event,
+                         PyObject *task) {
+    if (!cbs || !PyList_Check(cbs))
+        return 0;
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(cbs); i++) {
+        PyObject *r = PyObject_CallFunctionObjArgs(
+            PyList_GET_ITEM(cbs, i), es, event, task, NULL);
+        if (!r)
+            return -1;
+        Py_DECREF(r);
+    }
+    return 0;
+}
+
+/* per-quantum cached state (refreshed each run_quantum call) */
+typedef struct {
+    PyObject *es, *pins_map, *td_acc;
+    PyObject *cb_select, *cb_begin, *cb_end, *cb_complete, *cb_discard;
+    PyObject *last_tp;     /* OWNED: last gate-checked pool (a borrowed
+                            * pointer could be freed mid-quantum and a
+                            * new pool allocated at the same address
+                            * would inherit stale gate results) */
+    int last_ok;           /* gates passed for last_tp */
+    int fi_armed;
+    /* complete_exec stride gates (__pins_stride__ on the callback,
+     * read once per quantum): a callback advertising stride N is
+     * SKIPPED unless es.nb_tasks_done % N == 0 — the metrics
+     * handler's own unsampled early-return, without the call */
+    long long cstride[8];
+    Py_ssize_t n_complete;
+} quantum_t;
+
+/* interned-name attribute read as long long, default on absence */
+static long long attr_ll(PyObject *obj, PyObject *name, long long dflt) {
+    PyObject *a = PyObject_GetAttr(obj, name);
+    if (!a) {
+        PyErr_Clear();
+        return dflt;
+    }
+    long long r = PyLong_AsLongLong(a);
+    Py_DECREF(a);
+    if (r == -1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        return dflt;
+    }
+    return r;
+}
+
+/* raise an exception object (with its original traceback) */
+static PyObject *fetch_exc(void) {
+    PyObject *et, *ev, *tb;
+    PyErr_Fetch(&et, &ev, &tb);
+    PyErr_NormalizeException(&et, &ev, &tb);
+    if (tb)
+        PyException_SetTraceback(ev, tb);
+    Py_XDECREF(et);
+    Py_XDECREF(tb);
+    return ev;   /* owned */
+}
+
+/* pool-level fast-path gates: cancelled / lineage / comm / disabled
+ * chores.  Cached per pool for the quantum (a cancel landing mid-
+ * quantum is observed at the next quantum — in-flight tasks finish,
+ * exactly the documented cancellation contract). */
+static int gates_ok(quantum_t *qs, TCObject *t, VTObject *vt) {
+    PyObject *tp = t->taskpool;
+    if (tp == qs->last_tp)
+        return qs->last_ok;
+    Py_INCREF(tp);
+    Py_XSETREF(qs->last_tp, tp);
+    qs->last_ok = 0;
+    PyObject *a = PyObject_GetAttr(tp, s_cancelled);
+    if (!a)
+        return -1;
+    int truth = PyObject_IsTrue(a);
+    Py_DECREF(a);
+    if (truth)
+        return truth < 0 ? -1 : 0;
+    a = PyObject_GetAttr(tp, s_lineage);
+    if (!a)
+        return -1;
+    int has = (a != Py_None);
+    Py_DECREF(a);
+    if (has)
+        return 0;   /* recovery lineage records at complete: Python path */
+    PyObject *ctx = PyObject_GetAttr(tp, s_context);
+    if (!ctx)
+        return -1;
+    if (ctx == Py_None) {
+        Py_DECREF(ctx);
+        return 0;
+    }
+    a = PyObject_GetAttr(ctx, s_comm);
+    Py_DECREF(ctx);
+    if (!a)
+        return -1;
+    has = (a != Py_None);
+    Py_DECREF(a);
+    if (has)
+        return 0;   /* distributed: flush_activations must still run */
+    a = PyObject_GetAttr(vt->task_class, s_chore_disabled);
+    if (!a)
+        return -1;
+    long long dis = PyLong_AsLongLong(a);
+    Py_DECREF(a);
+    if (dis == -1 && PyErr_Occurred())
+        return -1;
+    if (dis)
+        return 0;
+    qs->last_ok = 1;
+    return 1;
+}
+
+/* the trivial progress chain: returns 1 handled, 0 fall back to the
+ * Python task_progress, -1 error */
+static int fast_progress(quantum_t *qs, PyObject *task) {
+    if (Py_TYPE(task) != &TCType)
+        return 0;
+    TCObject *t = (TCObject *)task;
+    if (!t->vt || Py_TYPE(t->vt) != &VTType)
+        return 0;
+    VTObject *vt = (VTObject *)t->vt;
+    if (!vt->trivial || qs->fi_armed || !(t->chore_mask & 1)
+        || t->retries)
+        return 0;
+    int g = gates_ok(qs, t, vt);
+    if (g <= 0)
+        return g;
+    PyObject *es = qs->es;
+    PyObject *ret = NULL;
+    /* claim BEFORE the fence check (the recovery drain contract —
+     * see task_progress's comment) */
+    if (PyObject_SetAttr(es, s_running_task, task) < 0)
+        return -1;
+    /* the recovery fence reads run_epoch FRESH per task — a restart
+     * bumping it mid-quantum must discard every later stale task */
+    if (t->pool_epoch != attr_ll(t->taskpool, s_run_epoch, 0)) {
+        /* stale generation: discard without executing or decrementing */
+        t->status = ST_COMPLETE;
+        if (pins_dispatch(qs->cb_discard, es, s_task_discard, task) < 0)
+            goto err;
+        goto done;
+    }
+    if (qs->cb_begin &&
+        pins_dispatch(qs->cb_begin, es, s_exec_begin, task) < 0)
+        goto err;
+    if (t->status < ST_PREPARED) {
+        /* trivial prepare: every flow binds None (no input deps) */
+        PyObject *fn = vt->flow_names;
+        for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(fn); i++) {
+            if (PyDict_SetItem(t->data, PyTuple_GET_ITEM(fn, i),
+                               Py_None) < 0)
+                goto err;
+        }
+        t->status = ST_PREPARED;
+    }
+    t->status = ST_RUNNING;
+    ret = PyObject_CallFunctionObjArgs(vt->hook, es, task, NULL);
+    if (!ret) {
+        /* body raised: the Python twin of task_progress's except
+         * branch (retry / record_error / complete failed) */
+        PyObject *exc = fetch_exc();
+        if (!exc) {
+            Py_INCREF(Py_None);
+            exc = Py_None;
+        }
+        PyObject *r = PyObject_CallFunctionObjArgs(g_body_failed, es,
+                                                   task, exc, NULL);
+        Py_DECREF(exc);
+        if (!r)
+            goto err;
+        Py_DECREF(r);
+        goto done;
+    }
+    if (ret != Py_None) {
+        /* AGAIN / ASYNC / DISABLE / values: the Python helper mirrors
+         * execute()'s normalization + task_progress's dispatch */
+        PyObject *r = PyObject_CallFunctionObjArgs(g_hook_return, es,
+                                                   task, ret, NULL);
+        Py_DECREF(ret);
+        if (!r)
+            goto err;
+        Py_DECREF(r);
+        goto done;
+    }
+    Py_DECREF(ret);
+    if (qs->cb_end &&
+        pins_dispatch(qs->cb_end, es, s_exec_end, task) < 0)
+        goto err;
+    /* complete_execution's empty-flow path: no writebacks, no
+     * release_deps, no repo holds — version bumps and successor
+     * delivery are structurally empty for a trivial class */
+    t->status = ST_COMPLETE;
+    {
+        long long nbv = attr_ll(es, s_nb_tasks_done, 0);
+        PyObject *cbs = qs->cb_complete;
+        if (cbs && PyList_Check(cbs)) {
+            Py_ssize_t ncb = PyList_GET_SIZE(cbs);
+            /* a list resized mid-quantum invalidates the cached
+             * strides: dispatch everything (stride 1) */
+            int gated = (ncb == qs->n_complete);
+            for (Py_ssize_t i = 0; i < ncb; i++) {
+                if (gated && qs->cstride[i] > 1 &&
+                    (nbv % qs->cstride[i]) != 0)
+                    continue;
+                PyObject *r = PyObject_CallFunctionObjArgs(
+                    PyList_GET_ITEM(cbs, i), es, s_complete_exec,
+                    task, NULL);
+                if (!r)
+                    goto err;
+                Py_DECREF(r);
+            }
+        }
+        PyObject *nb2 = PyLong_FromLongLong(nbv + 1);
+        if (!nb2)
+            goto err;
+        int rc = PyObject_SetAttr(es, s_nb_tasks_done, nb2);
+        Py_DECREF(nb2);
+        if (rc < 0)
+            goto err;
+    }
+    /* batched termdet: bump the per-worker accumulator (flushed by
+     * worker_loop at batch boundaries / idle); es._td_acc is None
+     * when termdet_batch <= 1 — then pay the locked decrement here */
+    if (qs->td_acc && qs->td_acc != Py_None) {
+        PyObject *entry = PyDict_GetItemWithError(qs->td_acc,
+                                                  t->taskpool);
+        if (!entry && PyErr_Occurred())
+            goto err;
+        long long ep = t->pool_epoch;
+        if (entry && PyList_Check(entry)
+            && PyLong_AsLongLong(PyList_GET_ITEM(entry, 0)) == ep) {
+            PyObject *n2 = PyNumber_Add(PyList_GET_ITEM(entry, 1),
+                                        g_one);
+            if (!n2)
+                goto err;
+            if (PyList_SetItem(entry, 1, n2) < 0)
+                goto err;
+        } else {
+            PyObject *fresh = Py_BuildValue("[Li]", ep, 1);
+            if (!fresh)
+                goto err;
+            int rc = PyDict_SetItem(qs->td_acc, t->taskpool, fresh);
+            Py_DECREF(fresh);
+            if (rc < 0)
+                goto err;
+        }
+    } else {
+        PyObject *td = PyObject_GetAttr(t->taskpool, s_termdet);
+        if (!td)
+            goto err;
+        PyObject *r = PyObject_CallMethodObjArgs(
+            td, s_addto, t->taskpool, g_neg1, NULL);
+        Py_DECREF(td);
+        if (!r)
+            goto err;
+        Py_DECREF(r);
+    }
+done:
+    if (PyObject_SetAttr(qs->es, s_running_task, Py_None) < 0)
+        return -1;
+    return 1;
+err:
+    PyObject_SetAttr(qs->es, s_running_task, Py_None);
+    return -1;
+}
+
+/* run_quantum(es, ready_queue, limit) -> (ndone, task_or_None):
+ * pop + select-PINS + the whole trivial prepare/execute/complete
+ * chain for up to ``limit`` tasks in ONE crossing.  A task the fast
+ * path cannot take (non-trivial class, cancelled pool, armed fault
+ * plan, recorded lineage, attached comm engine) pops out with its
+ * select event already fired, for the Python task_progress. */
+static PyObject *mod_run_quantum(PyObject *mod, PyObject *const *args,
+                                 Py_ssize_t nargs) {
+    (void)mod;
+    if (nargs != 3) {
+        PyErr_SetString(PyExc_TypeError,
+                        "run_quantum(es, ready_queue, limit)");
+        return NULL;
+    }
+    if (Py_TYPE(args[1]) != &RQType) {
+        PyErr_SetString(PyExc_TypeError, "second arg must be ReadyQueue");
+        return NULL;
+    }
+    if (ensure_runtime() < 0)
+        return NULL;
+    RQObject *q = (RQObject *)args[1];
+    long limit = PyLong_AsLong(args[2]);
+    if (limit == -1 && PyErr_Occurred())
+        return NULL;
+    quantum_t qs;
+    memset(&qs, 0, sizeof(qs));
+    qs.es = args[0];
+    qs.pins_map = PyObject_GetAttr(qs.es, s_pins_map);
+    if (!qs.pins_map)
+        return NULL;
+    qs.td_acc = PyObject_GetAttr(qs.es, s_td_acc);
+    if (!qs.td_acc) {
+        PyErr_Clear();
+        qs.td_acc = Py_None;
+        Py_INCREF(Py_None);
+    }
+    /* borrowed cb lists, refetched per quantum (pins_register mutates
+     * the lists in place; new events land within one quantum bound) */
+    qs.cb_select = PyDict_GetItemWithError(qs.pins_map, s_select);
+    qs.cb_begin = PyDict_GetItemWithError(qs.pins_map, s_exec_begin);
+    qs.cb_end = PyDict_GetItemWithError(qs.pins_map, s_exec_end);
+    qs.cb_complete = PyDict_GetItemWithError(qs.pins_map,
+                                             s_complete_exec);
+    qs.cb_discard = PyDict_GetItemWithError(qs.pins_map, s_task_discard);
+    {
+        PyObject *armed = g_fi_dict
+            ? PyDict_GetItemString(g_fi_dict, "ARMED") : NULL;
+        qs.fi_armed = armed ? PyObject_IsTrue(armed) : 0;
+    }
+    /* read each complete_exec callback's advertised sampling stride
+     * once per quantum (missing attribute = stride 1 = always call) */
+    qs.n_complete = -1;   /* sentinel: gate disabled */
+    if (qs.cb_complete && PyList_Check(qs.cb_complete) &&
+        PyList_GET_SIZE(qs.cb_complete) <=
+            (Py_ssize_t)(sizeof(qs.cstride) / sizeof(qs.cstride[0]))) {
+        qs.n_complete = PyList_GET_SIZE(qs.cb_complete);
+        for (Py_ssize_t i = 0; i < qs.n_complete; i++) {
+            long long v = 1;
+            PyObject *st = PyObject_GetAttrString(
+                PyList_GET_ITEM(qs.cb_complete, i), "__pins_stride__");
+            if (st) {
+                v = PyLong_AsLongLong(st);
+                Py_DECREF(st);
+                if (v < 1) {
+                    PyErr_Clear();
+                    v = 1;
+                }
+            } else {
+                PyErr_Clear();
+            }
+            qs.cstride[i] = v;
+        }
+    }
+    long ndone = 0;
+    PyObject *out_task = NULL;
+    while (ndone < limit) {
+        if (q->len == 0)
+            break;
+        PyObject *task = q->heap[0].task;   /* ownership moves here */
+        q->len--;
+        if (q->len > 0) {
+            q->heap[0] = q->heap[q->len];
+            rq_sift_down(q, 0);
+        }
+        q->pops++;
+        if (qs.cb_select &&
+            pins_dispatch(qs.cb_select, qs.es, s_select, task) < 0) {
+            Py_DECREF(task);
+            goto fail;
+        }
+        int rc = fast_progress(&qs, task);
+        if (rc < 0) {
+            Py_DECREF(task);
+            goto fail;
+        }
+        if (rc == 0) {
+            out_task = task;   /* Python task_progress takes it */
+            break;
+        }
+        Py_DECREF(task);
+        ndone++;
+    }
+    {
+        PyObject *res = Py_BuildValue("(lO)", ndone,
+                                      out_task ? out_task : Py_None);
+        Py_XDECREF(out_task);
+        Py_XDECREF(qs.last_tp);
+        Py_DECREF(qs.pins_map);
+        Py_DECREF(qs.td_acc);
+        return res;
+    }
+fail:
+    Py_XDECREF(qs.last_tp);
+    Py_DECREF(qs.pins_map);
+    Py_DECREF(qs.td_acc);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
 
 static PyObject *mod_now(PyObject *self_, PyObject *noargs) {
     (void)self_;
@@ -482,6 +1380,9 @@ static PyObject *mod_now(PyObject *self_, PyObject *noargs) {
 
 static PyMethodDef mod_methods[] = {
     {"now", mod_now, METH_NOARGS, "CLOCK_MONOTONIC seconds"},
+    {"run_quantum", (PyCFunction)(void (*)(void))mod_run_quantum,
+     METH_FASTCALL,
+     "run_quantum(es, ready_queue, limit) -> (ndone, task_or_None)"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef schedext_module = {
@@ -493,10 +1394,37 @@ PyMODINIT_FUNC PyInit_schedext(void) {
     s_status = PyUnicode_InternFromString("status");
     s_ready_at = PyUnicode_InternFromString("ready_at");
     s_priority = PyUnicode_InternFromString("priority");
-    if (!s_status || !s_ready_at || !s_priority)
+    s_pins_map = PyUnicode_InternFromString("_pins_map");
+    s_running_task = PyUnicode_InternFromString("running_task");
+    s_nb_tasks_done = PyUnicode_InternFromString("nb_tasks_done");
+    s_td_acc = PyUnicode_InternFromString("_td_acc");
+    s_cancelled = PyUnicode_InternFromString("cancelled");
+    s_lineage = PyUnicode_InternFromString("_lineage");
+    s_context = PyUnicode_InternFromString("context");
+    s_comm = PyUnicode_InternFromString("comm");
+    s_run_epoch = PyUnicode_InternFromString("run_epoch");
+    s_termdet = PyUnicode_InternFromString("termdet");
+    s_addto = PyUnicode_InternFromString("taskpool_addto_nb_tasks");
+    s_chore_disabled = PyUnicode_InternFromString("chore_disabled_mask");
+    s_select = PyUnicode_InternFromString("select");
+    s_exec_begin = PyUnicode_InternFromString("exec_begin");
+    s_exec_end = PyUnicode_InternFromString("exec_end");
+    s_complete_exec = PyUnicode_InternFromString("complete_exec");
+    s_task_discard = PyUnicode_InternFromString("task_discard");
+    if (!s_status || !s_ready_at || !s_priority || !s_pins_map ||
+        !s_running_task || !s_nb_tasks_done || !s_td_acc ||
+        !s_cancelled || !s_lineage || !s_context || !s_comm ||
+        !s_run_epoch || !s_termdet || !s_addto || !s_chore_disabled ||
+        !s_select || !s_exec_begin || !s_exec_end || !s_complete_exec ||
+        !s_task_discard)
+        return NULL;
+    g_one = PyLong_FromLong(1L);
+    g_neg1 = PyLong_FromLong(-1L);
+    if (!g_one || !g_neg1)
         return NULL;
     if (PyType_Ready(&RQType) < 0 || PyType_Ready(&DepRecType) < 0 ||
-        PyType_Ready(&DTType) < 0)
+        PyType_Ready(&DTType) < 0 || PyType_Ready(&TCType) < 0 ||
+        PyType_Ready(&VTType) < 0)
         return NULL;
     PyObject *m = PyModule_Create(&schedext_module);
     if (!m)
@@ -510,6 +1438,18 @@ PyMODINIT_FUNC PyInit_schedext(void) {
     Py_INCREF(&DTType);
     if (PyModule_AddObject(m, "DepTable", (PyObject *)&DTType) < 0) {
         Py_DECREF(&DTType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&TCType);
+    if (PyModule_AddObject(m, "TaskCore", (PyObject *)&TCType) < 0) {
+        Py_DECREF(&TCType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&VTType);
+    if (PyModule_AddObject(m, "TaskVT", (PyObject *)&VTType) < 0) {
+        Py_DECREF(&VTType);
         Py_DECREF(m);
         return NULL;
     }

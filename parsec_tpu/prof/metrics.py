@@ -318,6 +318,32 @@ def render_text(samples: List[dict]) -> str:
 # the runtime installer: PINS hooks + scrape-time collectors
 # ---------------------------------------------------------------------------
 
+class _StrideGated:
+    """complete_exec callback wrapper advertising its sampling stride
+    to the native worker quantum (schedext.run_quantum): when
+    ``es.nb_tasks_done % __pins_stride__`` is nonzero the C dispatcher
+    skips the call entirely — exactly equivalent to the wrapped
+    handler's own unsampled early-return (which touches nothing, not
+    even liveattr), but without the per-task Python call.  Split mode
+    (``metrics_queue_wait=1``) does real work on every event, so the
+    property answers stride 1 there (= never skip); the Python
+    dispatch path ignores the attribute and calls through unchanged."""
+
+    __slots__ = ("fn", "_m")
+
+    def __init__(self, fn, metrics):
+        self.fn = fn
+        self._m = metrics
+
+    @property
+    def __pins_stride__(self) -> int:
+        m = self._m
+        return 1 if m._split_queue else m._sample
+
+    def __call__(self, es, event, task):
+        return self.fn(es, event, task)
+
+
 class RuntimeMetrics:
     """One per Context.  Live hot-path metrics (task counters, sampled
     latency/queue-wait histograms, job SLO histograms) update through
@@ -353,6 +379,9 @@ class RuntimeMetrics:
         #: online attribution engine (prof/liveattr.py) riding THESE
         #: hooks — it registers no PINS callbacks of its own
         self._la = None
+        #: the stride-advertising wrapper _complete registers through
+        #: (built at install; the native quantum reads its stride)
+        self._complete_cb = None
 
     # -- lifecycle -------------------------------------------------------
     @property
@@ -370,12 +399,18 @@ class RuntimeMetrics:
             self._la = LiveAttr(self)
         # ONE hooked hot-path event by default: every additional PINS
         # dispatch with a live callback costs ~0.5us/task on the tasks
-        # probe — two hooks alone would eat the whole <=5% budget
+        # probe — two hooks alone would eat the whole armed budget
         if self._split_queue:
             context.pins_register("select", self._select)
             context.pins_register("exec_begin", self._exec_begin)
             context.pins_register("exec_end", self._exec_end)
-        context.pins_register("complete_exec", self._complete)
+        # registered through a stride-advertising wrapper: the native
+        # run_quantum reads __pins_stride__ and SKIPS the unsampled
+        # calls entirely (valid because _complete's unsampled
+        # single-hook path is a pure no-op — it returns before
+        # touching liveattr; split mode advertises stride 1)
+        self._complete_cb = _StrideGated(self._complete, self)
+        context.pins_register("complete_exec", self._complete_cb)
         context.pins_register("task_discard", self._discard)
         context.pins_register("job_done", self._job_done)
         ce = self._ce(context)
@@ -394,7 +429,7 @@ class RuntimeMetrics:
             context.pins_unregister("select", self._select)
             context.pins_unregister("exec_begin", self._exec_begin)
             context.pins_unregister("exec_end", self._exec_end)
-        context.pins_unregister("complete_exec", self._complete)
+        context.pins_unregister("complete_exec", self._complete_cb)
         context.pins_unregister("task_discard", self._discard)
         context.pins_unregister("job_done", self._job_done)
         ce = self._ce(context)

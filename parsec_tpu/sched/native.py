@@ -64,12 +64,14 @@ class NativeSched(Scheduler):
             raise RuntimeError("sched native: schedext did not build")
         self._q = se.ReadyQueue(TaskStatus.READY)
 
+    # lint: hot-path (ReadyQueue callback: one call per scheduling event)
     def schedule(self, es, tasks: List[Task], distance: int = 0) -> None:
         # one crossing: READY + ready_at (when a telemetry consumer
         # wants it) + priority-heap insert for the whole ring;
         # distance > 0 pins the ring behind everything (fairness)
         self._q.push_batch(tasks, self.context._ready_stamp, distance > 0)
 
+    # lint: hot-path (ReadyQueue callback: one call per selection)
     def select(self, es) -> Optional[Task]:
         return self._q.pop()
 

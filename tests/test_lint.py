@@ -19,8 +19,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from tools.parseclint import FileCtx, Finding  # noqa: E402
 from tools.parseclint.passes import (assert_hazard, device_put,  # noqa: E402
                                      evloop_blocking, except_hygiene,
-                                     lock_discipline, mca_knobs,
-                                     prom_metrics)
+                                     hot_path, lock_discipline,
+                                     mca_knobs, prom_metrics)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -570,6 +570,95 @@ def test_except_accepts_task_attributed_record():
                 self.context.record_error(exc, task)
     """
     assert not except_hygiene.check(_ctx(src))
+
+
+# ---------------------------------------------------------------------------
+# PCL-HOT: per-task lock round-trips in the task hot path
+# ---------------------------------------------------------------------------
+
+def test_hot_flags_termdet_call_in_complete_execution():
+    """The EXACT r14 bug class: the per-task locked termdet decrement
+    inside the completion chain."""
+    src = """
+        def complete_execution(es, task, failed=False):
+            tp = task.taskpool
+            task.status = 4
+            tp.termdet.taskpool_addto_nb_tasks(tp, -1)
+    """
+    fs = hot_path.check(_ctx(src, rel="parsec_tpu/core/snippet.py"))
+    assert _ids(fs) == ["PCL-HOT"] and \
+        "taskpool_addto_nb_tasks" in fs[0].message
+
+
+def test_hot_flags_lock_reached_through_helper():
+    """Same-file reachability: a `with self._lock` two calls below
+    task_progress still flags, naming the root it was reached from."""
+    src = """
+        def task_progress(es, task):
+            _account(es, task)
+
+        def _account(es, task):
+            _bump(es.metrics, task)
+
+        def _bump(m, task):
+            with m._lock:
+                m.count += 1
+    """
+    fs = hot_path.check(_ctx(src, rel="parsec_tpu/core/snippet.py"))
+    assert _ids(fs) == ["PCL-HOT"]
+    assert "with _lock" in fs[0].message
+    assert "reached from task_progress" in fs[0].message
+
+
+def test_hot_flags_acquire_in_marked_ready_queue_callback():
+    """ReadyQueue callbacks opt in via `# lint: hot-path` on the def
+    line (the scheduler schedule/select convention)."""
+    src = """
+        class Sched:
+            # lint: hot-path (per scheduling event)
+            def schedule(self, es, tasks, distance=0):
+                self._qlock.acquire()
+                try:
+                    self._q.extend(tasks)
+                finally:
+                    self._qlock.release()
+    """
+    fs = hot_path.check(_ctx(src, rel="parsec_tpu/sched/snippet.py"))
+    assert _ids(fs) == ["PCL-HOT"] and ".acquire()" in fs[0].message
+
+
+def test_hot_flags_lock_construction():
+    src = """
+        import threading
+
+        def task_progress(es, task):
+            gate = threading.Lock()
+            with gate:
+                pass
+    """
+    fs = hot_path.check(_ctx(src, rel="parsec_tpu/core/snippet.py"))
+    assert any("threading.Lock() construction" in f.message for f in fs)
+
+
+def test_hot_waiver_and_cold_functions_untouched():
+    """The batch-boundary flush carries a waiver; functions not
+    reachable from a hot root never flag."""
+    src = """
+        def worker_loop(es):
+            while True:
+                _flush(es)
+
+        def _flush(es):
+            for tp, ent in es._td_acc.items():
+                tp.termdet.taskpool_addto_nb_tasks(  # lint: ignore[PCL-HOT] batch boundary
+                    tp, -ent[1], epoch=ent[0])
+
+        def cold_admin_path(tp):
+            with tp._lock:
+                tp.nb_tasks = 0
+    """
+    assert not hot_path.check(
+        _ctx(src, rel="parsec_tpu/core/snippet.py"))
 
 
 # ---------------------------------------------------------------------------

@@ -40,7 +40,7 @@ import sys
 #: clamps there), where relative change is undefined and any ratio or
 #: cap scheme turns noise into a discontinuity.  Their derived
 #: vs_baseline is skipped for the same reason — the value IS the gate.
-ABSOLUTE_DELTA = ("telemetry_overhead",)
+ABSOLUTE_DELTA = ("telemetry_overhead", "overhead_us")
 
 #: metrics where SMALLER is better (everything else: bigger is better)
 LOWER_IS_BETTER = ("task_rtt", "tracer_overhead", "telemetry_overhead",
@@ -84,6 +84,12 @@ SKIP_KEYS = {"metric", "unit", "storage", "note", "ib",
              # was measured, not what was measured) and the telemetry
              # mode's raw side readings (the gated value is the ratio)
              "host", "tasks_off", "tasks_on",
+             # r14 tasks-probe diagnostics: the staged per-task budget
+             # breakdown localizes a headline regression (the gated
+             # value is task_throughput itself) and the suppressed-
+             # doorbell count tracks scheduling burst shape, not the
+             # code under test
+             "budget", "doorbell",
              # recovery A/B side readings (r13): host-load-sensitive
              # makespans and exact re-execution counts are evidence,
              # not rate metrics — the gated value is the headline

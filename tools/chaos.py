@@ -379,7 +379,10 @@ def ab_chain_recover_workload(ctx, rank, nranks):
     from parsec_tpu.core.taskpool import ParameterizedTaskpool
     from parsec_tpu.data.matrix import TwoDimTabular
 
-    steps = 20
+    # PARSEC_CHAOS_AB_STEPS scales the chain (the r14 residual
+    # re-measure: a bigger DAG with an earlier kill, where the
+    # survivor's skippable share dominates)
+    steps = int(os.environ.get("PARSEC_CHAOS_AB_STEPS", 20))
     half = steps // 2
     V = TwoDimTabular(2, 1, 2 * steps, 1,
                       table=[0] * half + [1] * (steps - half),
@@ -422,8 +425,15 @@ def ab_chain_recover_workload(ctx, rank, nranks):
             st.get("minimal_replays", 0), st.get("full_replays", 0))
 
 
-_AB_PLAN = ("seed=11;kill_rank=1@t+1.0s,mode=close;"
-            "delay_dispatch=key~W(,ms=100")
+def _ab_plan() -> str:
+    """The A/B kill plan; PARSEC_CHAOS_AB_KILL_S moves the kill point
+    (earlier kill = more completed-and-skippable survivor work on the
+    default tabular split) and PARSEC_CHAOS_AB_BODY_MS the per-body
+    stall for bigger-DAG runs."""
+    kill_s = os.environ.get("PARSEC_CHAOS_AB_KILL_S", "1.0")
+    body_ms = os.environ.get("PARSEC_CHAOS_AB_BODY_MS", "100")
+    return (f"seed=11;kill_rank=1@t+{kill_s}s,mode=close;"
+            f"delay_dispatch=key~W(,ms={body_ms}")
 
 
 def run_ab_pair(timeout=120.0):
@@ -437,7 +447,7 @@ def run_ab_pair(timeout=120.0):
     out = {}
     for mode, knob in (("minimal", "1"), ("full", "0")):
         saved = {k: os.environ.get(k) for k in keys}
-        os.environ["PARSEC_MCA_FAULT_PLAN"] = _AB_PLAN
+        os.environ["PARSEC_MCA_FAULT_PLAN"] = _ab_plan()
         os.environ["PARSEC_CHAOS_WAIT_S"] = "45"
         os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
         os.environ["PARSEC_MCA_RECOVERY_MINIMAL"] = knob

@@ -7,12 +7,13 @@ the driver).  Adding a pass = adding a module here and listing it in
 
 from tools.parseclint.passes import (assert_hazard, device_put,
                                      evloop_blocking, except_hygiene,
-                                     lock_discipline, mca_knobs,
-                                     prom_metrics)
+                                     hot_path, lock_discipline,
+                                     mca_knobs, prom_metrics)
 
 ALL_PASSES = (
     lock_discipline,
     evloop_blocking,
+    hot_path,
     device_put,
     mca_knobs,
     prom_metrics,
