@@ -960,39 +960,49 @@ def run_tracer_bench(n: int = 100000):
 
 
 def run_recovery_bench():
-    """Recovery A/B (r13): one no-fault baseline (same injected frame
-    delays, no kill) plus the acceptance kill under recorded-lineage
+    """Recovery A/B (r13, DTD leg r15): one no-fault baseline per DAG
+    (same injected body delays, no kill) plus the acceptance kill under
     MINIMAL replay and forced replay-from-restore-point
-    (tools/chaos.run_ab_pair).  Value = killed-minimal makespan over
-    the no-fault makespan — the metric of the ≤2x acceptance bound —
-    and the extras record BOTH re-execution counts: the
+    (tools/chaos.run_ab_pair / run_ab_pair_dtd).  Value = the PTG
+    killed-minimal makespan over its no-fault makespan — the metric of
+    the ≤2x acceptance bound — and the extras record BOTH legs' full
+    re-execution counts and makespan ratios: the
     tasks_reexecuted(minimal) < tasks_reexecuted(full) delta is the
-    minimal-replay headline."""
+    minimal-replay headline on each DAG (PTG recorded-lineage plan;
+    DTD insert-stream skip agreement)."""
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools"))
     import chaos
     from parsec_tpu.comm.launch import run_distributed
-    keys = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
-            "PARSEC_MCA_RECOVERY_ENABLE")
-    saved = {k: os.environ.get(k) for k in keys}
-    # baseline: the SAME A/B chain DAG under the same injected body
-    # delays, no kill — the ratio isolates the RECOVERY cost
-    os.environ["PARSEC_MCA_FAULT_PLAN"] = "seed=11;" + \
-        chaos._AB_PLAN.split(";", 2)[2]
-    os.environ["PARSEC_CHAOS_WAIT_S"] = "45"
-    os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
-    try:
-        t0 = time.perf_counter()
-        run_distributed(chaos.ab_chain_recover_workload, 2, timeout=90)
-        base_s = time.perf_counter() - t0
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+
+    def _baseline(plan: str, workload, nranks: int) -> float:
+        keys = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
+                "PARSEC_MCA_RECOVERY_ENABLE")
+        saved = {k: os.environ.get(k) for k in keys}
+        # baseline: the SAME chain DAG under the same injected body
+        # delays, no kill — the ratio isolates the RECOVERY cost
+        os.environ["PARSEC_MCA_FAULT_PLAN"] = \
+            "seed=11;" + plan.split(";", 2)[2]
+        os.environ["PARSEC_CHAOS_WAIT_S"] = "45"
+        os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
+        try:
+            t0 = time.perf_counter()
+            run_distributed(workload, nranks, timeout=90)
+            return time.perf_counter() - t0
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    base_s = _baseline(chaos._ab_plan(),
+                       chaos.ab_chain_recover_workload, 2)
     ab = chaos.run_ab_pair(timeout=120.0)
     ratio = ab["minimal"]["makespan_s"] / max(base_s, 1e-9)
+    dtd_base_s = _baseline(chaos._dtd_ab_plan(),
+                           chaos.dtd_ab_chain_workload, 3)
+    dab = chaos.run_ab_pair_dtd(timeout=120.0)
     extras = {"recovery": {
         "baseline_s": round(base_s, 2),
         "minimal": ab["minimal"],
@@ -1000,6 +1010,16 @@ def run_recovery_bench():
         "makespan_ratio_minimal": round(ratio, 3),
         "makespan_ratio_full": round(
             ab["full"]["makespan_s"] / max(base_s, 1e-9), 3),
+        "dtd": {
+            "baseline_s": round(dtd_base_s, 2),
+            "minimal": dab["minimal"],
+            "full": dab["full"],
+            "makespan_ratio_minimal": round(
+                dab["minimal"]["makespan_s"] / max(dtd_base_s, 1e-9),
+                3),
+            "makespan_ratio_full": round(
+                dab["full"]["makespan_s"] / max(dtd_base_s, 1e-9), 3),
+        },
     }}
     return ratio, extras
 

@@ -1594,6 +1594,14 @@ class RemoteDepEngine:
         return [r for r in range(self.nranks)
                 if r != self.rank and r not in dead]
 
+    def recovery_coordinator(self) -> int:
+        """Lowest live rank — the deterministic coordinator of every
+        TAG_RECOVER round (dead-set agreement, DTD skip agreement,
+        the completed-pool retirement handshake).  Every survivor
+        computes the same value from its dead set, so the rounds need
+        no leader election."""
+        return min([self.rank] + self._live_peers())
+
     def _next_live(self, r: int) -> Optional[int]:
         """The ring successor of ``r`` among live ranks (self counts as
         live); None when this rank is the only survivor."""

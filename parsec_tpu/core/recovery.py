@@ -169,9 +169,27 @@ params.register("recovery_agree_window_s", 0.25,
 params.register("recovery_agree_timeout_s", 3.0,
                 "how long a non-coordinator survivor waits for the "
                 "confirmed dead-set broadcast (and a minimal-replay "
-                "requester for its need acks) before proceeding with "
-                "its local view / full replay — the bounded fallback "
-                "when the coordinator itself died mid-round")
+                "requester for its need acks, and a DTD skip-agreement "
+                "participant for the frontier/prefix round) before "
+                "proceeding with its local view / full replay — the "
+                "bounded fallback when the coordinator itself died "
+                "mid-round")
+params.register("recovery_need_rounds", 2,
+                "bound on minimal-replay need-negotiation rounds per "
+                "pool restart: a merged seed closure that WIDENS the "
+                "remote needs re-issues a second need->ack/nack round "
+                "against the peers' frozen plans (acked when the "
+                "resolved producers are already in the frozen replay "
+                "set) instead of falling straight back to full replay; "
+                "past the cap the fallback is taken, counted in "
+                "parsec_recovery_need_rounds_total{outcome=exhausted}")
+params.register("recovery_dtd_skip", 1,
+                "cross-rank skip agreement for multi-rank DTD pools "
+                "(needs recovery_enable + recovery_minimal): survivors "
+                "agree on the largest common skippable insert-stream "
+                "prefix and the replay ghost-tracks it instead of "
+                "re-executing; 0 keeps the always-full DTD replay.  "
+                "Round timeouts ride recovery_agree_timeout_s")
 
 
 class RecoveryUnsupported(RuntimeError):
@@ -513,6 +531,68 @@ def minimal_plan(records, *, dead_set, pending=(), adopted=(),
     return plan
 
 
+def dtd_skip_prefix(frontiers: Dict[int, int],
+                    landed: Dict[int, Dict[Any, int]],
+                    writes) -> Tuple[int, Dict[Any, int], Dict[Any, int]]:
+    """The largest common skippable DTD insert-stream prefix (pure;
+    unit-tested on hand-built ladders).
+
+    ``frontiers[rank]`` is each survivor's completion frontier (every
+    LOCAL insert position below it completed); ``landed[rank][wire]``
+    the whole-covering version whose bytes that rank's datum holds;
+    ``writes`` the SPMD-identical ``(pos, wire)`` write ladder (the
+    coordinator uses its own — the streams are identical by the DTD
+    contract).
+
+    A prefix ``K`` is honorable when, for every tile, the version the
+    skipped prefix leaves it at (``vcut`` = number of writes below K)
+    is HELD by some survivor (``landed == vcut``) — that rank becomes
+    the tile's designated holder, serving the cut value in place of
+    the skipped producers' deliveries.  Tiles the prefix never writes
+    (``vcut == 0``) restore from the pool-attach snapshot / init_fn
+    instead.  Returns ``(K, holders, vcut)``; ``K == 0`` means no
+    common prefix is consistent with the survivors' materializable
+    cuts and the gang takes the full replay."""
+    from bisect import bisect_left
+    if not frontiers:
+        return 0, {}, {}
+    top = min(frontiers.values())
+    if top <= 0:
+        return 0, {}, {}
+    by_tile: Dict[Any, List[int]] = {}
+    for pos, wire in writes:
+        by_tile.setdefault(wire, []).append(pos)
+    for lst in by_tile.values():
+        lst.sort()
+    ranks = sorted(landed)
+    # feasibility only changes where a write enters/leaves the prefix,
+    # so only the CLASS MAXIMA need testing: the frontier itself plus
+    # each write position below it (any feasible K shares its class
+    # maximum's vcuts, so the largest feasible K is always one of
+    # these).  Bounds the scan by the write-ladder size (itself capped
+    # by the lineage ring) instead of the raw insert count.
+    cands = [top] + sorted({p for p, _w in writes if 0 < p < top},
+                           reverse=True)
+    for k in cands:
+        holders: Dict[Any, int] = {}
+        vcuts: Dict[Any, int] = {}
+        ok = True
+        for wire, poss in by_tile.items():
+            vcut = bisect_left(poss, k)   # writes at positions < k
+            if vcut == 0:
+                continue
+            holder = next((r for r in ranks
+                           if landed[r].get(wire, 0) == vcut), None)
+            if holder is None:
+                ok = False
+                break
+            holders[wire] = holder
+            vcuts[wire] = vcut
+        if ok:
+            return k, holders, vcuts
+    return 0, {}, {}
+
+
 # ---------------------------------------------------------------------------
 # the coordinator
 # ---------------------------------------------------------------------------
@@ -542,6 +622,8 @@ class RecoveryCoordinator:
             params.get("recovery_agree_window_s", 0.25))
         self.agree_timeout = float(
             params.get("recovery_agree_timeout_s", 3.0))
+        self.need_rounds_cap = int(params.get("recovery_need_rounds", 2))
+        self.dtd_skip_on = bool(int(params.get("recovery_dtd_skip", 1)))
         #: incremental tile checkpoint store (utils/checkpoint.py),
         #: shared by every registered pool's lineage hook; None = the
         #: capture plane is off (interval 0, the default)
@@ -577,6 +659,24 @@ class RecoveryCoordinator:
         #: replayed to late voters so an early committer's exit from
         #: the agreement wait cannot strand them into a timeout
         self._my_mode: Dict[int, Tuple[int, str]] = {}
+        #: (taskpool_id, rank) -> (round, report) — DTD skip-agreement
+        #: frontier/landed reports collected by the coordinator
+        #: (guarded-by: _ctl_cond)
+        self._skip_reports: Dict[Tuple[int, int], Tuple[int, dict]] = {}
+        #: taskpool_id -> (round, skipset msg) — the coordinator's
+        #: agreed-prefix broadcast (guarded-by: _ctl_cond)
+        self._skip_set: Dict[int, Tuple[int, dict]] = {}
+        #: taskpool_id -> ranks that reported LOCAL completion — the
+        #: retirement handshake's quorum; when every live rank is in,
+        #: the coordinator broadcasts the retirement and the pool
+        #: leaves restartable state (guarded-by: _ctl_cond)
+        self._retire_reports: Dict[int, set] = {}
+        #: taskpool_id -> this rank's FROZEN minimal replay set — a
+        #: second-round need arriving against a frozen plan acks iff
+        #: its resolved producers are already IN the set (no plan
+        #: change needed), instead of the unconditional r12 nack
+        #: (guarded-by: _ctl_cond)
+        self._frozen_tasks: Dict[int, set] = {}
         self._rde = None               # RemoteDepEngine (attach_comm)
         #: taskpool_id -> {"tp", "collections", "replay"}
         #: (guarded-by: _lock)
@@ -611,10 +711,22 @@ class RecoveryCoordinator:
         self.counts = {"started": 0, "completed": 0, "failed": 0}
         self.tasks_reexecuted = 0
         self.rejoins = 0
-        #: restart-policy split: minimal (recorded-lineage plan) vs
-        #: full (replay-from-restore-point fallback) pool restarts
+        #: restart-policy split: minimal (recorded-lineage plan OR an
+        #: agreed DTD skip prefix) vs full (replay-from-restore-point
+        #: fallback) pool restarts
         self.minimal_replays = 0
         self.full_replays = 0
+        #: concluded DTD skip agreements (a nonzero prefix agreed AND
+        #: committed through the mode round) — the counter the
+        #: kill-dtd-minimal chaos case proves against
+        self.skip_agreements = 0
+        #: completed pools retired through the explicit handshake
+        #: (coordinator confirmed every live rank locally complete)
+        self.retirements = 0
+        #: need-negotiation rounds by outcome (acked / nacked /
+        #: widened / exhausted) — a silent round is a failed gate
+        self.need_round_counts = {"acked": 0, "nacked": 0,
+                                  "widened": 0, "exhausted": 0}
         from parsec_tpu.prof.metrics import Histogram
         self.duration_hist = Histogram()
         m = getattr(context, "metrics", None)
@@ -702,11 +814,91 @@ class RecoveryCoordinator:
 
     def _pool_done(self, tp) -> None:
         """Completion callback: stamp the grace-window clock (a restart
-        re-stamps it on re-termination)."""
+        re-stamps it on re-termination) and start the RETIREMENT
+        HANDSHAKE — report this rank's local completion to the
+        coordinator, which confirms global quiescence (every live rank
+        locally complete) before the pool leaves restartable state.
+        The ``recovery_completed_grace_s`` window remains the bounded
+        FALLBACK (dead coordinator, lost report): past it the spec
+        evicts exactly as before."""
         with self._lock:
             spec = self._specs.get(tp.taskpool_id)
             if spec is not None:
                 spec["completed_at"] = time.monotonic()
+        if spec is None:
+            return
+        self._report_retire(tp)
+
+    def _report_retire(self, tp) -> None:
+        """Send (or locally record) this rank's local-completion report
+        for one pool; called from the completion callback (worker
+        thread) — never holds _lock across the send."""
+        rde = self._rde
+        ce = rde.ce if rde is not None else None
+        if ce is None or ce.nranks <= 1:
+            # single-rank context: local completion IS global
+            self._apply_retired(tp.taskpool_id)
+            return
+        coord = rde.recovery_coordinator()
+        if coord == ce.rank:
+            self._note_retire_report(tp.taskpool_id, ce.rank)
+            return
+        from parsec_tpu.comm.engine import TAG_RECOVER
+        try:
+            ce.send_am(TAG_RECOVER, coord,
+                       {"k": "retire", "tp": tp.taskpool_id})
+        except OSError:
+            pass   # grace-window fallback bounds the miss
+
+    def _note_retire_report(self, tpid: int, src: int) -> None:
+        """Coordinator side: record one rank's local completion and,
+        once EVERY live rank reported, broadcast the confirmed
+        retirement.  Quorum membership is evaluated at report time —
+        a rank dying mid-handshake shrinks the live set and its
+        restart path clears the report state for replayed pools."""
+        rde = self._rde
+        ce = rde.ce if rde is not None else None
+        if ce is None:
+            return
+        with self._ctl_cond:
+            reported = self._retire_reports.setdefault(tpid, set())
+            reported.add(src)
+            live = {r for r in range(ce.nranks)
+                    if r not in ce.dead_peers}
+            done = live <= reported
+        if not done:
+            return
+        with self._lock:
+            if tpid in self._active or self._events:
+                return   # a restart owns this pool; quorum re-collects
+        from parsec_tpu.comm.engine import TAG_RECOVER
+        for r in sorted(live - {ce.rank}):
+            try:
+                ce.send_am(TAG_RECOVER, r, {"k": "retired", "tp": tpid})
+            except OSError:
+                pass
+        self._apply_retired(tpid)
+
+    def _apply_retired(self, tpid: int) -> None:
+        """Confirmed-retirement landing (both sides of the handshake):
+        the pool is GLOBALLY done — it leaves restartable state now
+        instead of dangling through the grace window, and a later peer
+        death can never resurrect it (or re-fire its completion into
+        the job service)."""
+        with self._lock:
+            spec = self._specs.get(tpid)
+            tp = spec["tp"] if spec is not None else None
+            if tp is None or tpid in self._active or not tp.completed:
+                return
+            tp.retired = True
+            self.retirements += 1
+            # no synchronous sweep: the retired flag already ends
+            # restartability (on_peer_dead skips retired pools), and
+            # the spec/snapshot/capture eviction rides the normal
+            # sweep cadence (next registration / grace) so late
+            # readers of the capture plane are not cut off mid-read
+        debug_verbose(2, "rank %d: pool %d RETIRED (global quiescence "
+                      "confirmed)", self.context.rank, tpid)
 
     def _sweep_locked(self) -> None:   # holds-lock: _lock
         """Evict specs (and the tile snapshots only they referenced) of
@@ -737,12 +929,18 @@ class RecoveryCoordinator:
                 for tpid in evicted:
                     self._plan_state.pop(tpid, None)
                     self._extra_seeds.pop(tpid, None)
+                    self._skip_set.pop(tpid, None)
+                    self._retire_reports.pop(tpid, None)
+                    self._frozen_tasks.pop(tpid, None)
                     for kk in [kk for kk in self._need_acks
                                if kk[0] == tpid]:
                         del self._need_acks[kk]
                     for kk in [kk for kk in self._peer_modes
                                if kk[0] == tpid]:
                         del self._peer_modes[kk]
+                    for kk in [kk for kk in self._skip_reports
+                               if kk[0] == tpid]:
+                        del self._skip_reports[kk]
         live_dcs = {id(dc) for spec in self._specs.values()
                     for dc in spec["collections"]}
         for key in [k for k in self._snaps if k not in live_dcs]:
@@ -856,8 +1054,20 @@ class RecoveryCoordinator:
             # either — a full replay honored them by re-running
             # everything
             for tp_ in take:
-                self._plan_state.pop(tp_.taskpool_id, None)
-                self._extra_seeds.pop(tp_.taskpool_id, None)
+                tpid_ = tp_.taskpool_id
+                self._plan_state.pop(tpid_, None)
+                self._extra_seeds.pop(tpid_, None)
+                self._frozen_tasks.pop(tpid_, None)
+                # the restarted pool's retirement quorum re-collects
+                # from its re-completions (stale reports must not
+                # retire a pool a survivor is still replaying).  Skip
+                # reports/broadcasts are NOT purged: like the mode
+                # ballots they carry the restart-attempt round and
+                # _plan_dtd_skip matches rounds — purging would delete
+                # a FASTER peer's current-round report (a hang-detected
+                # death lets peers report before we even declare) and
+                # force a spurious full-replay fallback
+                self._retire_reports.pop(tpid_, None)
         # excuse SYNCHRONOUSLY, on the declaring thread: a survivor
         # polling wait_quiescence every 50 ms must never observe
         # dead-but-not-yet-excused in the window before the recovery
@@ -1039,24 +1249,35 @@ class RecoveryCoordinator:
         dead_set = set(dead_map)
         tpid = tp.taskpool_id
         # minimal replay applies to enumerable PTG pools with a
-        # complete lineage ring; everything else (insert-driven,
-        # dynamic discovery, evicted/disabled ring) takes the
-        # restore-point fallback
+        # complete lineage ring; insert-driven DTD pools take the
+        # SKIP-AGREEMENT path instead (ghost-replay the agreed
+        # prefix); everything else (dynamic discovery, evicted/
+        # disabled ring) takes the restore-point fallback
         want_minimal = (self.minimal_on and self.lineage_on
                         and spec["replay"] is None
                         and not getattr(tp, "dynamic", False)
                         and isinstance(tp, ParameterizedTaskpool)
                         and tp._lineage is not None
                         and not tp._lineage.overflow)
+        want_skip = (self.minimal_on and self.lineage_on
+                     and self.dtd_skip_on
+                     and spec["replay"] is not None
+                     and callable(getattr(tp, "dtd_skip_report", None))
+                     and tp._lineage is not None
+                     and not tp._lineage.overflow)
         with self._ctl_cond:
             # (stale votes need no purge here: ballots carry the
             # restart-attempt round, and _agree_mode matches rounds —
             # purging instead would delete a FASTER peer's
             # current-round vote and split the gang's modes)
             self._plan_state[tpid] = "open" if want_minimal else "full"
-        if not want_minimal:
+        if not want_minimal and not want_skip:
             self._broadcast_mode(tpid, False)
+        fallback_reason = None
+        if not want_minimal and not want_skip:
+            fallback_reason = self._static_fallback_reason(tp, spec)
         rplan = synth = base_restores = None
+        skip = None
         try:
             # pre-flight: every tile this rank now owns must have a
             # restore source — check BEFORE tearing runtime state down
@@ -1095,6 +1316,8 @@ class RecoveryCoordinator:
                         debug_verbose(1, "rank %d: pool %d minimal "
                                       "replay fell back (a peer took "
                                       "full replay)", ctx.rank, tpid)
+                        fallback_reason = "mode-vote full (a peer " \
+                                          "took full replay)"
                         rplan = None
                         with self._ctl_cond:
                             self._plan_state[tpid] = "full"
@@ -1103,9 +1326,32 @@ class RecoveryCoordinator:
                     debug_verbose(1, "rank %d: pool %d minimal replay "
                                   "fell back to restore-point (%s)",
                                   ctx.rank, tpid, why)
+                    fallback_reason = str(why)
                     rplan = None
                     with self._ctl_cond:
                         self._plan_state[tpid] = "full"
+                    self._broadcast_mode(tpid, False)
+            if want_skip:
+                # DTD insert-stream skip agreement: evidence is stable
+                # now (fence + drain), and the torn generation's comm
+                # state is gone — agree the skippable prefix BEFORE
+                # the reset discards the landed/seed evidence
+                try:
+                    skip = self._plan_dtd_skip(tp, spec, dead_set)
+                    self._broadcast_mode(tpid, True)
+                    if not self._agree_mode(tpid):
+                        debug_verbose(1, "rank %d: pool %d DTD skip "
+                                      "fell back (skip-vote full on a "
+                                      "peer)", ctx.rank, tpid)
+                        fallback_reason = "skip-vote full"
+                        skip = None
+                        self._broadcast_mode(tpid, False)
+                except RecoveryUnsupported as why:
+                    debug_verbose(1, "rank %d: pool %d DTD skip fell "
+                                  "back to full replay (%s)",
+                                  ctx.rank, tpid, why)
+                    fallback_reason = str(why)
+                    skip = None
                     self._broadcast_mode(tpid, False)
             # termdet rewind.  force_terminated: a pool that completed
             # LOCALLY (its partition drained before the kill) must
@@ -1144,6 +1390,20 @@ class RecoveryCoordinator:
                         dc.data_of(*idx).overwrite_host(np.asarray(arr))
                 for dc, idx, arr in base_restores:
                     dc.data_of(*idx).overwrite_host(np.asarray(arr))
+            elif skip is not None:
+                # DTD skip: restore ONLY tiles the agreed prefix never
+                # writes (vcut 0 — pool-attach snapshot / init state);
+                # every written tile's cut value is the designated
+                # holder's live bytes, seeded/served during the replay
+                vc = skip["vcut"]
+                dcids = getattr(tp, "_dc_ids", {})
+                for dc, idx, arr in plan:
+                    wire = ("c", dcids.get(id(dc)),
+                            dc.data_key(*idx))
+                    if wire not in vc:
+                        dc.data_of(*idx).overwrite_host(np.asarray(arr))
+                tp.dtd_arm_skip(skip["prefix"], skip["holders"],
+                                skip["seeds"], vc)
             else:
                 # restore the last surviving version of every owned tile
                 for dc, idx, arr in plan:
@@ -1159,6 +1419,10 @@ class RecoveryCoordinator:
         # re-insert the re-execution sub-DAG
         if spec["replay"] is not None:
             spec["replay"](tp)
+            if skip is not None:
+                # covers the all-skipped stream (no post-prefix insert
+                # triggered the finalize) and disarms the filter
+                tp.dtd_skip_finish()
             n = max(int(tp.nb_tasks), 0)
         else:
             ready = tp.startup()
@@ -1175,8 +1439,23 @@ class RecoveryCoordinator:
                           "task(s), %d synthesized edge(s), %d "
                           "rewound tile(s)", ctx.rank, tpid, n,
                           len(synth), len(base_restores))
+        elif skip is not None:
+            self.minimal_replays += 1
+            self.skip_agreements += 1
+            debug_verbose(1, "rank %d: pool %d DTD MINIMAL replay: "
+                          "skipped the agreed insert prefix %d (%d "
+                          "held cut payload(s)), %d task(s) re-run",
+                          ctx.rank, tpid, skip["prefix"],
+                          len(skip["seeds"]), n)
         else:
             self.full_replays += 1
+            # every full-replay fallback is DIAGNOSABLE from the
+            # flight-recorder bundle (reason string: evicted ring /
+            # nacked need / skip-vote full / unsupported pool / ...),
+            # not inferred from counter deltas
+            ctx.telemetry_incident(
+                f"recovery-fallback pool={tpid} "
+                f"reason={fallback_reason or 'unknown'}")
         tp.ready()
         with self._lock:
             self._active.discard(tp.taskpool_id)
@@ -1187,6 +1466,25 @@ class RecoveryCoordinator:
         if drain is not None and hasattr(tp, "_dtd_incoming"):
             drain(tp)
         return n
+
+    def _static_fallback_reason(self, tp, spec) -> str:
+        """Why a pool never even attempts a minimal/skip plan — the
+        reason string every full-replay fallback's flight-recorder
+        incident carries."""
+        if not (self.minimal_on and self.lineage_on):
+            return "minimal replay disabled by configuration"
+        lin = tp._lineage
+        if lin is None:
+            return "unsupported pool (no lineage ring armed)"
+        if lin.overflow:
+            return "evicted ring"
+        if spec["replay"] is not None:
+            if not self.dtd_skip_on:
+                return "dtd skip agreement disabled by configuration"
+            return "unsupported pool (replay-driven, no skip report)"
+        if getattr(tp, "dynamic", False):
+            return "unsupported pool (dynamic discovery)"
+        return "unsupported pool"
 
     # -- dead-set agreement + replay-need negotiation (TAG_RECOVER) ------
     def _agree_dead_set(self, observed: set) -> set:
@@ -1290,6 +1588,25 @@ class RecoveryCoordinator:
                 self._need_acks[(msg.get("tp"), src)] = \
                     bool(msg.get("ok"))
                 self._ctl_cond.notify_all()
+        elif k == "skipf":
+            # DTD skip agreement: a survivor's frontier/landed report
+            # (or its full vote) — store for the coordinator's round
+            with self._ctl_cond:
+                self._skip_reports[(msg.get("tp"), src)] = \
+                    (int(msg.get("round", 0)), msg)
+                self._ctl_cond.notify_all()
+        elif k == "skipset":
+            # the coordinator's agreed-prefix broadcast
+            with self._ctl_cond:
+                self._skip_set[msg.get("tp")] = \
+                    (int(msg.get("round", 0)), msg)
+                self._ctl_cond.notify_all()
+        elif k == "retire":
+            # retirement handshake: a rank reports local completion
+            self._note_retire_report(msg.get("tp"), src)
+        elif k == "retired":
+            # coordinator confirmed global quiescence for this pool
+            self._apply_retired(msg.get("tp"))
         elif k == "mode":
             tpid = msg.get("tp")
             rnd = int(msg.get("round", 0))
@@ -1343,6 +1660,17 @@ class RecoveryCoordinator:
                         self._extra_seeds.setdefault(
                             tpid, set()).update(seeds)
                         ok = True
+                    else:
+                        # SECOND-ROUND need against a frozen plan (the
+                        # requester's merged seed closure widened): ack
+                        # without modification iff every resolved
+                        # producer is ALREADY in the frozen replay set
+                        # — the promise costs nothing, and the r12
+                        # unconditional nack forced a full replay for
+                        # needs the plan was about to satisfy anyway
+                        frozen = self._frozen_tasks.get(tpid)
+                        ok = frozen is not None \
+                            and all(s in frozen for s in seeds)
         rde = self._rde
         if rde is not None:
             from parsec_tpu.comm.engine import TAG_RECOVER
@@ -1473,19 +1801,156 @@ class RecoveryCoordinator:
                     return False
                 self._ctl_cond.wait(left)
 
+    # -- DTD insert-stream skip agreement ---------------------------------
+    def _plan_dtd_skip(self, tp, spec, dead_set: set) -> dict:
+        """Agree the largest common skippable insert-stream prefix for
+        one multi-rank DTD pool restart (one TAG_RECOVER report/
+        broadcast round, bounded by ``recovery_agree_timeout_s``) and
+        materialize this rank's side of it: the cut payloads it is the
+        designated holder of, and the vcut map the selective restore
+        consults.  A sole survivor short-circuits to its local view
+        (no wire round).  Raises :class:`RecoveryUnsupported` on any
+        infeasibility — the caller votes full and the PR 11 mode round
+        falls the whole gang back symmetrically."""
+        from parsec_tpu.comm.engine import TAG_RECOVER
+        tpid = tp.taskpool_id
+        rep = tp.dtd_skip_report()
+        full_why = rep.get("full")
+        rde = self._rde
+        ce = rde.ce if rde is not None else None
+        peers = rde._live_peers() if rde is not None else []
+        rnd = self._mode_round(tpid)
+        me = self.context.rank
+        if not peers or ce is None:
+            # sole survivor: the agreement short-circuits locally
+            if full_why is not None:
+                raise RecoveryUnsupported(f"dtd skip: {full_why}")
+            k, holders, vcuts = dtd_skip_prefix(
+                {me: rep["frontier"]}, {me: rep["landed"]},
+                rep["writes"])
+            if k <= 0:
+                raise RecoveryUnsupported(
+                    "dtd skip: no skippable prefix in the local view")
+        elif rde.recovery_coordinator() == ce.rank:
+            # coordinator: collect every survivor's frontier report,
+            # cut the common prefix, broadcast it (prefix 0 = the gang
+            # falls back fast instead of timing out one by one)
+            k, holders, vcuts = 0, {}, {}
+            why = None
+            if full_why is not None:
+                why = f"local vote full ({full_why})"
+            else:
+                deadline = time.monotonic() + self.agree_timeout
+                reports: Dict[int, dict] = {}
+                with self._ctl_cond:
+                    while True:
+                        reports = {}
+                        for r in peers:
+                            ent = self._skip_reports.get((tpid, r))
+                            if ent is not None and ent[0] == rnd:
+                                reports[r] = ent[1]
+                        if len(reports) == len(peers):
+                            break
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._ctl_cond.wait(left)
+                if len(reports) < len(peers):
+                    why = "a survivor's skip report never arrived"
+                else:
+                    fulls = sorted(r for r, m in reports.items()
+                                   if m.get("full"))
+                    if fulls:
+                        why = (f"rank {fulls[0]} voted full "
+                               f"({reports[fulls[0]]['full']})")
+                    else:
+                        frontiers = {me: rep["frontier"]}
+                        landed = {me: dict(rep["landed"])}
+                        for r, m in reports.items():
+                            frontiers[r] = int(m["frontier"])
+                            landed[r] = dict(m["landed"])
+                        k, holders, vcuts = dtd_skip_prefix(
+                            frontiers, landed, rep["writes"])
+                        if k <= 0:
+                            why = ("no common prefix consistent with "
+                                   "the survivors' materializable cuts")
+            out = {"k": "skipset", "tp": tpid, "round": rnd,
+                   "prefix": k, "holders": holders, "vcut": vcuts}
+            for r in peers:
+                try:
+                    ce.send_am(TAG_RECOVER, r, dict(out))
+                except OSError:
+                    pass   # its death gets its own event
+            if why is not None:
+                raise RecoveryUnsupported(f"dtd skip: {why}")
+        else:
+            # participant: report the frontier (or the full vote),
+            # then wait for the coordinator's agreed prefix
+            coord = rde.recovery_coordinator()
+            msg = {"k": "skipf", "tp": tpid, "round": rnd}
+            if full_why is not None:
+                msg["full"] = full_why
+            else:
+                msg["frontier"] = rep["frontier"]
+                msg["landed"] = rep["landed"]
+            try:
+                ce.send_am(TAG_RECOVER, coord, msg)
+            except OSError:
+                raise RecoveryUnsupported(
+                    "dtd skip: coordinator unreachable")
+            if full_why is not None:
+                raise RecoveryUnsupported(f"dtd skip: {full_why}")
+            deadline = time.monotonic() + self.agree_timeout
+            with self._ctl_cond:
+                while True:
+                    ent = self._skip_set.get(tpid)
+                    if ent is not None and ent[0] == rnd:
+                        agreed = ent[1]
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise RecoveryUnsupported(
+                            "dtd skip: agreed-prefix broadcast never "
+                            "arrived (coordinator died mid-round?)")
+                    self._ctl_cond.wait(left)
+            k = int(agreed.get("prefix", 0))
+            holders = dict(agreed.get("holders") or {})
+            vcuts = dict(agreed.get("vcut") or {})
+            if k <= 0:
+                raise RecoveryUnsupported(
+                    "dtd skip: coordinator declared no skippable "
+                    "prefix")
+        mine = [w for w, h in holders.items() if h == me]
+        seeds = tp.dtd_capture_seeds(mine)
+        if len(seeds) != len(mine):
+            # a held cut payload with no host bytes is an
+            # infeasibility, not a crash — the mode round falls the
+            # gang back symmetrically
+            raise RecoveryUnsupported(
+                "dtd skip: a held cut payload is not host-pullable")
+        return {"prefix": k, "holders": holders, "vcut": vcuts,
+                "seeds": seeds}
+
     # -- minimal replay (recorded-lineage plan) ---------------------------
     def _plan_minimal(self, tp, spec, dead_set: set) -> ReplayPlan:
         """Compute, negotiate, and FREEZE the minimal plan for one pool
         restart.  Raises RecoveryUnsupported on any infeasibility — the
         caller then takes the restore-point fallback."""
         tpid = tp.taskpool_id
+        rounds = self.need_rounds_cap
+        used = 0
+        counts = self.need_round_counts
         with self._ctl_cond:
             extra = set(self._extra_seeds.get(tpid, ()))
         plan = self._compute_minimal(tp, spec, dead_set, extra)
         first_needs = {(r, k, f) for r, k, f in plan.needs}
-        if plan.needs and not self._negotiate_needs(tp, plan.needs):
-            raise RecoveryUnsupported(
-                "a peer nacked (or never acked) a re-feed need")
+        if plan.needs:
+            used = 1
+            if not self._negotiate_needs(tp, plan.needs):
+                counts["nacked"] += 1
+                raise RecoveryUnsupported(
+                    "a peer nacked (or never acked) a re-feed need")
+            counts["acked"] += 1
         if self._rde is not None and self._rde._live_peers():
             # one window for LATE cross-survivor needs to land before
             # the plan freezes (peers restarting the same pool send
@@ -1493,14 +1958,35 @@ class RecoveryCoordinator:
             time.sleep(min(self.agree_window, 1.0))
         with self._ctl_cond:
             self._plan_state[tpid] = "frozen"
+            # published so a peer's OWN widened second-round need can
+            # ack against this rank's committed replay set
+            self._frozen_tasks[tpid] = set(plan.tasks)
             extra2 = set(self._extra_seeds.pop(tpid, ()))
         if extra2 - extra:
             plan = self._compute_minimal(tp, spec, dead_set, extra2)
-            if {(r, k, f) for r, k, f in plan.needs} - first_needs:
-                # the merged seeds' closure reached a peer nobody asked
-                # — a second negotiation round could cascade; fall back
-                raise RecoveryUnsupported(
-                    "merged re-feed seeds widened the remote needs")
+            with self._ctl_cond:
+                self._frozen_tasks[tpid] = set(plan.tasks)
+            widened = {(r, k, f) for r, k, f in plan.needs} \
+                - first_needs
+            if widened:
+                # the merged seeds' closure reached producers nobody
+                # asked for: re-issue a BOUNDED second need round
+                # against the peers' frozen plans (they ack iff the
+                # producers are already committed) instead of the r12
+                # unconditional fallback
+                if used >= rounds:
+                    counts["exhausted"] += 1
+                    raise RecoveryUnsupported(
+                        "merged re-feed seeds widened the remote needs "
+                        f"past recovery_need_rounds={rounds}")
+                used += 1
+                counts["widened"] += 1
+                if not self._negotiate_needs(tp, sorted(widened)):
+                    counts["nacked"] += 1
+                    raise RecoveryUnsupported(
+                        "a peer nacked a widened re-feed need "
+                        "(second negotiation round)")
+                counts["acked"] += 1
         return plan
 
     def _compute_minimal(self, tp, spec, dead_set: set,
@@ -1865,6 +2351,9 @@ class RecoveryCoordinator:
                 "rejoins": self.rejoins,
                 "minimal_replays": self.minimal_replays,
                 "full_replays": self.full_replays,
+                "skip_agreements": self.skip_agreements,
+                "retirements": self.retirements,
+                "need_rounds": dict(self.need_round_counts),
                 "dead_map": dict(self._dead_map),
                 "active_pools": sorted(self._active),
             }
@@ -1886,6 +2375,13 @@ class RecoveryCoordinator:
                                   self.minimal_replays))
         out.append(counter_sample("parsec_recovery_full_replays_total",
                                   self.full_replays))
+        out.append(counter_sample("parsec_recovery_skip_agreements_total",
+                                  self.skip_agreements))
+        out.append(counter_sample(
+            "parsec_recovery_pool_retirements_total", self.retirements))
+        out.extend(counter_sample("parsec_recovery_need_rounds_total",
+                                  v, {"outcome": outcome})
+                   for outcome, v in self.need_round_counts.items())
         out.append(histogram_sample("parsec_recovery_duration_seconds",
                                     self.duration_hist))
         return out

@@ -254,6 +254,17 @@ def complete_execution(es, task: Task, failed: bool = False) -> None:
             if copy is not None and copy.data is not None \
                     and copy.data.collection is not None:
                 copy.data.complete_write(copy.device)
+        if task.dtd is not None and tp._lineage is not None:
+            # DTD twin of the version taint: a SUCCESSFUL body's
+            # in-place tile writes are LANDED bytes — advance the
+            # tiles' applied_ver so the skip-agreement landed map
+            # cannot claim an older version over mutated payloads.  A
+            # FAILED body's bytes are indeterminate (it may have
+            # mutated partway): they match NO version, so the pool
+            # votes full instead
+            taint = getattr(tp, "dtd_taint_stale", None)
+            if taint is not None:
+                taint(task.dtd, failed=failed)
         task.status = _COMPLETE
         es.pins("task_discard", task)
         return

@@ -147,10 +147,13 @@ class Taskpool:
         #: pristine and full-replay states) disables the gate
         self._replay_filter: Optional[set] = None
         #: GLOBALLY done: set once a distributed run passes global
-        #: quiescence after this pool completed (Context.wait).  A pool
-        #: that completed only LOCALLY stays restartable — another
-        #: survivor may still need its re-executed partition; a retired
-        #: one is never resurrected by recovery
+        #: quiescence after this pool completed (Context.wait), or the
+        #: recovery plane's RETIREMENT HANDSHAKE confirmed every live
+        #: rank locally complete (core/recovery.py — the service-grade
+        #: path for resident contexts that never call Context.wait).
+        #: A pool that completed only LOCALLY stays restartable —
+        #: another survivor may still need its re-executed partition;
+        #: a retired one is never resurrected by recovery
         self.retired = False
 
     # -- construction ------------------------------------------------------

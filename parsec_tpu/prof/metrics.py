@@ -547,6 +547,10 @@ class RuntimeMetrics:
     # -- job lifecycle (service/service.py _emit; jobs_submitted derives
     # from the service collector, so only job_done is hooked) ------------
     def _job_done(self, es, event, job) -> None:
+        # fired EXACTLY ONCE per job (JobService._emit_done's one-shot
+        # seam): a recovery restart re-terminating a completed pool is
+        # absorbed below the service, so the SLO histograms and the
+        # per-status counters never double-observe a job
         try:
             status = job.status().name.lower()
             self._jobs_done.labels(status=status).inc()

@@ -87,6 +87,11 @@ class JobHandle:
         #: this job (None for ordinary task failures) — set by the
         #: service's containment route (PeerFailedError -> _job_error)
         self.failed_rank: Optional[int] = None
+        #: the ONE terminal ``job_done`` emission happened (service
+        #: seam: a recovery restart re-firing a completed pool's
+        #: termination callbacks must be absorbed below the service —
+        #: JobService._emit_done test-and-sets this)
+        self._done_emitted = False
         self._done = threading.Event()
         self._lock = threading.Lock()
 

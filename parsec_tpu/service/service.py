@@ -200,7 +200,7 @@ class JobService:
                 # must not dangle forever
                 for j in self._pending:
                     if j._to(JobStatus.CANCELLED):
-                        self._emit("job_done", j)
+                        self._emit_done(j)
                 self._pending.clear()
                 self._space.notify_all()
                 return True
@@ -233,7 +233,7 @@ class JobService:
                     and now_mono - job.submitted_mono > job.deadline:
                 self._pending.remove(job)
                 if job._to(JobStatus.TIMEOUT):
-                    self._emit("job_done", job)
+                    self._emit_done(job)
                 self._space.notify_all()
         for job in list(self._running.values()):
             if job.deadline is not None \
@@ -259,7 +259,7 @@ class JobService:
                     self._running.pop(job.job_id, None)
                     self._prune_history()
                     self._work.notify_all()
-                self._emit("job_done", job)
+                self._emit_done(job)
                 return
             job.started_at = time.time()
             tp.on_complete(lambda _tp, job=job: self._finish(job))
@@ -277,7 +277,7 @@ class JobService:
             with self._lock:
                 self._running.pop(job.job_id, None)
                 self._work.notify_all()
-            self._emit("job_done", job)
+            self._emit_done(job)
 
     def _brand(self, tp: Taskpool, job: JobHandle) -> None:
         """Stamp a job's pool tree: id tag (PINS/gauges attribution),
@@ -293,7 +293,19 @@ class JobService:
 
     # -- completion / failure ---------------------------------------------
     def _finish(self, job: JobHandle) -> None:
-        """Pool termination callback (worker thread)."""
+        """Pool termination callback (worker thread).
+
+        A completed pool restarted by the recovery plane (a peer died
+        inside its restartable window) TERMINATES A SECOND TIME when
+        the replay drains — the re-fired completion is absorbed here,
+        below the service seam: the job's terminal transition already
+        happened and its one ``job_done`` already emitted (SLO
+        histograms, gauges, and client waiters must each see exactly
+        one terminal event per job)."""
+        if job._done_emitted:
+            debug_verbose(2, "service: %s re-completed after a "
+                          "recovery restart; absorbed", job.name)
+            return
         job._to(JobStatus.DONE)     # keeps FAILED/CANCELLED/TIMEOUT
         if job.status() != JobStatus.DONE:
             # no result will ever be read: drop the result closure (it
@@ -312,7 +324,7 @@ class JobService:
             self._running.pop(job.job_id, None)
             self._prune_history()
             self._work.notify_all()
-        self._emit("job_done", job)
+        self._emit_done(job)
 
     def _prune_history(self) -> None:
         """Bound the job index (lock held): a resident service must not
@@ -357,7 +369,7 @@ class JobService:
                 # transition owns the job_done emission there, so only
                 # emit for jobs cancelled straight out of the queue
                 if took and in_queue:
-                    self._emit("job_done", job)
+                    self._emit_done(job)
                 return took
             if job.status() != JobStatus.RUNNING:
                 return False
@@ -493,6 +505,17 @@ class JobService:
             debug_verbose(2, "service device sync: %s", exc)
             raise RuntimeError(
                 "device sync failed before result read") from exc
+
+    def _emit_done(self, job: JobHandle) -> None:
+        """Emit a job's terminal ``job_done`` EXACTLY ONCE, whatever
+        path reached it first (completion, failure, cancel, deadline,
+        dispatcher stop) and however often a recovery restart re-fires
+        the pool's termination afterwards."""
+        with job._lock:
+            if job._done_emitted:
+                return
+            job._done_emitted = True
+        self._emit("job_done", job)
 
     def _emit(self, event: str, job: JobHandle) -> None:
         """Job-lifecycle PINS events (payload: the JobHandle)."""

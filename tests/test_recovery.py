@@ -23,7 +23,8 @@ import pytest
 from parsec_tpu.core.errors import (CheckpointDegradedError,
                                     PeerFailedError)
 from parsec_tpu.core.recovery import (LineageRecord, RecoveryUnsupported,
-                                      lineage_plan, minimal_plan)
+                                      dtd_skip_prefix, lineage_plan,
+                                      minimal_plan)
 from parsec_tpu.utils.mca import params
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -222,6 +223,579 @@ def test_minimal_plan_synth_drops_when_producer_joins():
     # dropped in favor of the natural delivery
     assert "U0" in plan.tasks
     assert not any(s[4] == "U0" for s in plan.synth)
+
+
+# ---------------------------------------------------------------------------
+# DTD insert-stream skip agreement: the pure prefix planner on
+# hand-built write ladders (r15)
+# ---------------------------------------------------------------------------
+
+#: a 10-insert single-tile chain: insert i writes version i+1
+_LADDER = [(i, "t") for i in range(10)]
+
+
+def test_dtd_skip_prefix_full_prefix():
+    """Every survivor's frontier covers the whole stream and someone
+    holds the final version: the whole prefix skips."""
+    k, holders, vcut = dtd_skip_prefix(
+        {0: 10, 2: 10}, {0: {"t": 10}, 2: {"t": 4}}, _LADDER)
+    assert k == 10 and holders == {"t": 0} and vcut == {"t": 10}
+
+
+def test_dtd_skip_prefix_cuts_to_held_version():
+    """Frontiers split inside a window (the mid-insert kill shape):
+    the agreed prefix is the largest K where some survivor HOLDS the
+    cut version — not just the min frontier."""
+    # min frontier 8, but the best-landed survivor holds only v6: the
+    # scan walks down to the materializable cut
+    k, holders, vcut = dtd_skip_prefix(
+        {0: 8, 2: 40}, {0: {"t": 6}, 2: {"t": 3}}, _LADDER)
+    assert k == 6 and holders == {"t": 0} and vcut == {"t": 6}
+    # the lower-landed survivor's version also works when it is the
+    # only consistent cut
+    k, holders, _ = dtd_skip_prefix(
+        {0: 8, 2: 40}, {0: {"t": 0}, 2: {"t": 3}}, _LADDER)
+    assert k == 3 and holders == {"t": 2}
+
+
+def test_dtd_skip_prefix_no_holder_falls_back():
+    """Nobody holds any cut version (the dead rank's payloads never
+    landed): no common prefix — the gang takes the full replay."""
+    k, holders, vcut = dtd_skip_prefix(
+        {0: 10, 2: 10}, {0: {}, 2: {}}, _LADDER)
+    assert k == 0 and not holders and not vcut
+
+
+def test_dtd_skip_prefix_unwritten_tiles_need_no_holder():
+    """A tile the prefix never writes (vcut 0) restores from the
+    pool-attach snapshot instead of needing a holder."""
+    writes = [(0, "a"), (1, "a")]
+    k, holders, vcut = dtd_skip_prefix(
+        {0: 5, 1: 5}, {0: {"a": 2, "b": 7}, 1: {}}, writes)
+    assert k == 5
+    assert holders == {"a": 0} and vcut == {"a": 2}
+
+
+def test_dtd_skip_prefix_multi_tile_intersection():
+    """Two tiles: the agreed K must satisfy BOTH materializable cuts
+    simultaneously."""
+    writes = [(0, "a"), (1, "b"), (2, "a"), (3, "b")]
+    landed = {0: {"a": 2, "b": 1}, 1: {"a": 1, "b": 2}}
+    k, holders, vcut = dtd_skip_prefix({0: 4, 1: 4}, landed, writes)
+    assert k == 4
+    assert vcut == {"a": 2, "b": 2}
+    assert holders == {"a": 0, "b": 1}
+    # rank 1's b-ladder stops at v1: K drops to where both cuts hold
+    k, _h, vcut = dtd_skip_prefix(
+        {0: 4, 1: 4}, {0: {"a": 2, "b": 1}, 1: {"a": 1, "b": 1}},
+        writes)
+    assert k == 3 and vcut == {"a": 2, "b": 1}
+
+
+# ---------------------------------------------------------------------------
+# DTD skip machinery: pool-level replay (ghost prefix, holder seeding,
+# tid-gated filter) and the pool-side full votes
+# ---------------------------------------------------------------------------
+
+def _dtd_chain_pool(ctx, steps=10):
+    import numpy as np
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import INOUT, DTDTaskpool
+    V = VectorTwoDimCyclic(mb=4, lm=4, nodes=1, myrank=0, name="Vsk")
+    V.data_of(0).copy_on(0).payload[:] = 0.0
+    tp = DTDTaskpool("skiptest")
+    tp.recovery_collections = [V]
+    ctx.add_taskpool(tp)
+    ctx.start()
+    t = tp.tile_of(V, 0)
+
+    def step(T):
+        return T + 1.0
+    for _ in range(steps):
+        tp.insert_task(step, (t, INOUT))
+    tp.wait(timeout=30)
+    return V, tp, t, step
+
+
+def test_dtd_skip_replay_ghosts_prefix_and_seeds_holder():
+    """Single-pool replay mechanics, deterministically: arm a skip at
+    K=6 with this rank the holder of the seeded v6 cut — the replay
+    ghost-tracks 6 inserts (versions advance, no body runs), the
+    finalize seeds the cut payload, and exactly the 4 post-prefix
+    bodies re-run to the exact final value."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.taskpool import TaskpoolState
+    from parsec_tpu.core.termdet import TermdetState
+    from parsec_tpu.dsl.dtd import INOUT
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        V, tp, t, step = _dtd_chain_pool(ctx, steps=10)
+        assert tp._lineage is not None
+        rep = tp.dtd_skip_report()
+        assert rep.get("full") is None and rep["frontier"] == 10
+        wire = t.wire_key
+        assert rep["landed"] == {wire: 10}
+        # drive the restart shape _restart_pool uses
+        tp.state = TaskpoolState.ATTACHED
+        tp.run_epoch += 1
+        assert tp.termdet.taskpool_reset(tp, force_terminated=True) \
+            == TermdetState.TERMINATED
+        with ctx._lock:
+            ctx._active_taskpools += 1
+        tp._done_event.clear()
+        tp.termdet.taskpool_addto_runtime_actions(tp, 1)
+        tp.recovery_reset()
+        tp.dtd_arm_skip(6, {wire: 0},
+                        {wire: np.full(4, 6.0, np.float32)}, {wire: 6})
+        t2 = tp.tile_of(V, 0)
+        for _ in range(10):
+            tp.insert_task(step, (t2, INOUT))
+        tp.dtd_skip_finish()
+        tp.ready()
+        assert tp.wait_local(30)
+        val = np.asarray(V.data_of(0).pull_to_host().payload)
+        np.testing.assert_allclose(val, 10.0)
+        assert sorted(tp._pos_done) == [6, 7, 8, 9]  # prefix ghosted
+        # one skip per generation: the next death votes full
+        assert tp.dtd_skip_report().get("full")
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_dtd_skip_report_votes_full_on_unskippable_pools():
+    """Region lanes and tile_new wire keys latch the pool unskippable
+    (the report votes full instead of planning from partial
+    evidence)."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.dsl.dtd import (INOUT, INPUT, DTDTaskpool, Region)
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        from parsec_tpu.data.matrix import VectorTwoDimCyclic
+        V = VectorTwoDimCyclic(mb=4, lm=4, nodes=1, myrank=0,
+                               name="Vrl")
+        tp = DTDTaskpool("regions")
+        tp.recovery_collections = [V]
+        ctx.add_taskpool(tp)
+        ctx.start()
+        t = tp.tile_of(V, 0)
+        tp.insert_task(lambda T: None,
+                       (t, INPUT | Region("u", (slice(0, 2),))))
+        tp.wait(timeout=30)
+        assert tp.dtd_skip_report()["full"] == "region lanes"
+
+        tp2 = DTDTaskpool("news")
+        tp2.recovery_collections = [V]
+        ctx.add_taskpool(tp2)
+        tn = tp2.tile_new((4,))
+        tp2.insert_task(lambda T: T + 1.0, (tn, INOUT))
+        tp2.wait(timeout=30)
+        assert "tile_new" in tp2.dtd_skip_report()["full"]
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def _stub_rde(rank, peers, sent):
+    import types
+    ce = types.SimpleNamespace(
+        rank=rank, nranks=max([rank] + list(peers)) + 1,
+        dead_peers=set(),
+        send_am=lambda tag, dst, payload: sent.append((dst, payload)))
+    return types.SimpleNamespace(
+        ce=ce, _live_peers=lambda: list(peers),
+        recovery_coordinator=lambda: min([rank] + list(peers)))
+
+
+def test_dtd_skip_round_coordinator_cuts_and_broadcasts():
+    """Coordinator side of the skip round: a pre-delivered peer report
+    (divergent frontier) cuts the prefix; a report from a FOREIGN rank
+    (one that rejoined mid-round — not in the round's peer snapshot)
+    is ignored."""
+    from parsec_tpu.core.context import Context
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        V, tp, t, _step = _dtd_chain_pool(ctx, steps=10)
+        rec = ctx.recovery
+        sent = []
+        rec._rde = _stub_rde(0, [2], sent)
+        wire = t.wire_key
+        with rec._ctl_cond:
+            rec._skip_reports[(tp.taskpool_id, 2)] = \
+                (0, {"frontier": 6, "landed": {wire: 4}})
+            # rank 3 rejoined mid-round: its unsolicited report must
+            # not join the quorum (it is not in the peer snapshot)
+            rec._skip_reports[(tp.taskpool_id, 3)] = \
+                (0, {"frontier": 1, "landed": {}})
+        spec = {"tp": tp, "collections": tp.recovery_collections,
+                "replay": lambda tp: None}
+        skip = rec._plan_dtd_skip(tp, spec, {1})
+        # K honors rank 2's held v4 cut, not its frontier of 6 (this
+        # rank holds v10, which no K <= 6 can use)
+        assert skip["prefix"] == 4
+        assert skip["holders"] == {wire: 2}
+        assert skip["seeds"] == {}          # rank 2 holds the cut
+        # the agreed prefix was broadcast to the round's peers only
+        assert [d for d, _m in sent] == [2]
+        assert sent[0][1]["k"] == "skipset" \
+            and sent[0][1]["prefix"] == 4
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_dtd_skip_round_peer_full_vote_converges_gang():
+    """A survivor whose lineage ring evicted votes full: the
+    coordinator broadcasts prefix 0 (everyone falls back FAST instead
+    of timing out) and takes the full replay itself."""
+    from parsec_tpu.core.context import Context
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        _V, tp, _t, _step = _dtd_chain_pool(ctx, steps=10)
+        rec = ctx.recovery
+        sent = []
+        rec._rde = _stub_rde(0, [2], sent)
+        with rec._ctl_cond:
+            rec._skip_reports[(tp.taskpool_id, 2)] = \
+                (0, {"full": "evicted ring"})
+        spec = {"tp": tp, "collections": tp.recovery_collections,
+                "replay": lambda tp: None}
+        with pytest.raises(RecoveryUnsupported, match="voted full"):
+            rec._plan_dtd_skip(tp, spec, {1})
+        assert sent and sent[0][1]["prefix"] == 0
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_dtd_skip_round_participant_timeout_falls_back():
+    """Participant side with no coordinator broadcast (a coordinator
+    that died — or was displaced by a rejoin — mid-round): the bounded
+    wait expires into the full-replay fallback instead of a hang."""
+    from parsec_tpu.core.context import Context
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        _V, tp, _t, _step = _dtd_chain_pool(ctx, steps=10)
+        rec = ctx.recovery
+        sent = []
+        # this rank is NOT the coordinator: ce.rank 2, coordinator 0
+        rde = _stub_rde(2, [0], sent)
+        rec._rde = rde
+        rec.agree_timeout = 0.2
+        spec = {"tp": tp, "collections": tp.recovery_collections,
+                "replay": lambda tp: None}
+        t0 = time.monotonic()
+        with pytest.raises(RecoveryUnsupported, match="never arrived"):
+            rec._plan_dtd_skip(tp, spec, {1})
+        assert time.monotonic() - t0 < 2.0
+        # the report reached the coordinator before the wait
+        assert sent and sent[0][0] == 0 and sent[0][1]["k"] == "skipf"
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+# ---------------------------------------------------------------------------
+# multi-round need negotiation (r15): a widened closure re-negotiates
+# against frozen plans instead of falling back
+# ---------------------------------------------------------------------------
+
+def _need_round_harness(ctx, cap):
+    """A RecoveryCoordinator wired for _plan_minimal control-flow
+    tests: _compute_minimal is a recorded stub that simulates a peer's
+    re-feed seed landing MID-WINDOW (the merged closure then widens
+    the remote needs — the exact r12 fallback shape)."""
+    rec = ctx.recovery
+    rec.need_rounds_cap = cap
+    rec.agree_window = 0.01
+    rec._rde = _stub_rde(0, [2], [])
+
+    from parsec_tpu.core.recovery import ReplayPlan
+    calls = {"negotiated": []}
+
+    def compute(tp, spec, dead_set, extra):
+        plan = ReplayPlan()
+        plan.tasks = {"A"} | set(extra)
+        if not extra:
+            # a peer's need lands inside the pre-freeze window: the
+            # freeze pops it and the recompute widens the needs
+            with rec._ctl_cond:
+                rec._extra_seeds[tp.taskpool_id] = {"B"}
+        else:
+            # the merged seed closure reaches a producer on rank 2
+            plan.needs = [(2, "W", "F")]
+        return plan
+
+    def negotiate(tp, needs):
+        calls["negotiated"].append(list(needs))
+        return True
+
+    rec._compute_minimal = compute
+    rec._negotiate_needs = negotiate
+    return rec, calls
+
+
+def test_plan_minimal_second_round_recovers_widened_needs():
+    """The r12 fallback shape — merged re-feed seeds widen the remote
+    needs after the freeze — now negotiates a SECOND round and stays
+    minimal, counter-proven (widened + acked move, exhausted does
+    not)."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.taskpool import Taskpool
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        rec, calls = _need_round_harness(ctx, cap=2)
+        tp = Taskpool("nr")
+        before = dict(rec.need_round_counts)
+        plan = rec._plan_minimal(tp, {"tp": tp}, {1})
+        assert "B" in plan.tasks
+        assert calls["negotiated"] == [[(2, "W", "F")]]
+        after = rec.need_round_counts
+        assert after["widened"] == before["widened"] + 1
+        assert after["acked"] == before["acked"] + 1
+        assert after["exhausted"] == before["exhausted"]
+        # the frozen replay set is published for peers' second rounds
+        with rec._ctl_cond:
+            assert rec._frozen_tasks[tp.taskpool_id] == plan.tasks
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_plan_minimal_round_cap_exhausts_to_full():
+    """recovery_need_rounds=0 restores the r12 single-shot behavior:
+    a widened closure falls back, counted as exhausted."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.taskpool import Taskpool
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        rec, calls = _need_round_harness(ctx, cap=0)
+        tp = Taskpool("nr0")
+        with pytest.raises(RecoveryUnsupported,
+                           match="recovery_need_rounds"):
+            rec._plan_minimal(tp, {"tp": tp}, {1})
+        assert rec.need_round_counts["exhausted"] == 1
+        assert not calls["negotiated"]
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_handle_need_acks_frozen_plan_when_covered():
+    """A second-round need against a FROZEN plan acks iff the resolved
+    producers are already in the frozen replay set (the r12
+    unconditional nack forced full replays the plan satisfied
+    anyway)."""
+    from parsec_tpu.core.context import Context
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        rec = ctx.recovery
+        sent = []
+        rec._rde = _stub_rde(0, [2], sent)
+        tp, tc = _frozen_need_pool(ctx)
+        tpid = tp.taskpool_id
+        with rec._lock:
+            rec._active.add(tpid)
+        with rec._ctl_cond:
+            rec._plan_state[tpid] = "frozen"
+            rec._frozen_tasks[tpid] = {("W", 0), ("W", 1)}
+        rec._handle_need(2, {"tp": tpid,
+                             "needs": [[("W", 1), "P"]]})
+        assert sent[-1][1] == {"k": "need_ack", "tp": tpid, "ok": True}
+        # a need whose producer the frozen plan does NOT re-run nacks
+        with rec._ctl_cond:
+            rec._frozen_tasks[tpid] = {("W", 5)}
+        rec._handle_need(2, {"tp": tpid,
+                             "needs": [[("W", 1), "P"]]})
+        assert sent[-1][1]["ok"] is False
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def _frozen_need_pool(ctx):
+    """A 2-task chain pool whose need edges _resolve_need can invert:
+    W(i) reads P from W(i-1)."""
+    from parsec_tpu.core.task import (Dep, FromDesc, FromTask, READ,
+                                      RW, TaskClass, ToDesc, ToTask)
+    from parsec_tpu.core.taskpool import ParameterizedTaskpool
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    V = VectorTwoDimCyclic(mb=2, lm=8, nodes=1, myrank=0, name="Vfn")
+    V.set_init(lambda m, n=0: np.zeros(2, np.float32))
+    tc = TaskClass(
+        "W", params=[("i", lambda g, l: range(4))],
+        affinity=lambda loc, V=V: V(loc["i"]),
+        flows=[READ("P",
+                    inputs=[Dep(FromTask("W", "T",
+                                         lambda loc:
+                                         {"i": loc["i"] - 1}),
+                                guard=lambda loc: loc["i"] > 0)]),
+               RW("T",
+                  inputs=[Dep(FromDesc(lambda loc, V=V: V(loc["i"])))],
+                  outputs=[Dep(ToTask("W", "P",
+                                      lambda loc: {"i": loc["i"] + 1}),
+                               guard=lambda loc: loc["i"] < 3),
+                           Dep(ToDesc(lambda loc, V=V: V(loc["i"])))])],
+        incarnations=[("cpu", lambda es, task: None)])
+    tp = ParameterizedTaskpool("fn")
+    tp.add_task_class(tc)
+    tp.recovery_collections = [V]
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=30)
+    return tp, tc
+
+
+# ---------------------------------------------------------------------------
+# completed-pool retirement handshake (r15): coordinator confirms
+# global quiescence before a pool leaves restartable state
+# ---------------------------------------------------------------------------
+
+def test_retirement_handshake_coordinator_quorum():
+    """Coordinator side: local completion alone keeps the pool
+    restartable; once EVERY live rank reported, the pool retires, the
+    confirmation broadcasts, and the counter moves."""
+    from parsec_tpu.comm.engine import SocketCE
+    from parsec_tpu.comm.launch import _probe_port_base
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.taskpool import TaskpoolState
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    params.set("recovery_enable", 1)
+    ce = SocketCE(0, 2, _probe_port_base(2))
+    ctx = Context(nb_cores=1, rank=0, nranks=2)
+    rde = RemoteDepEngine(ce, ctx)
+    sent = []
+    ce.send_am = lambda tag, dst, payload: sent.append((dst, payload))
+    try:
+        from parsec_tpu.core.taskpool import Taskpool
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=8, ln=8, nodes=2,
+                              myrank=0, name="Aret")
+        tp = Taskpool("ret")
+        tp.recovery_collections = [A]
+        ctx.add_taskpool(tp)
+        rec = ctx.recovery
+        tp.state = TaskpoolState.DONE          # locally complete
+        rec._pool_done(tp)
+        assert not tp.retired                  # rank 1 outstanding
+        with rec._lock:
+            assert rec._specs[tp.taskpool_id]["completed_at"] \
+                is not None
+        rec._on_recover_msg(1, {"k": "retire", "tp": tp.taskpool_id})
+        assert tp.retired and rec.retirements == 1
+        assert any(p.get("k") == "retired" for _d, p in sent)
+        # retired pools are never recovery candidates again
+        handled, leave = rec.on_peer_dead(
+            1, PeerFailedError(1, "x", detector="close"), [])
+        assert handled and leave == []
+        with rec._lock:
+            assert tp.taskpool_id not in rec._active
+        tp.cancel()
+    finally:
+        ce._stop = True
+        rde.fini()
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_retirement_broadcast_applies_on_peer():
+    """Non-coordinator side: the coordinator's confirmed ``retired``
+    broadcast retires a locally-complete pool; a pool mid-restart
+    ignores a stale confirmation."""
+    from parsec_tpu.comm.engine import SocketCE
+    from parsec_tpu.comm.launch import _probe_port_base
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.taskpool import Taskpool, TaskpoolState
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    params.set("recovery_enable", 1)
+    ce = SocketCE(0, 2, _probe_port_base(2))
+    ctx = Context(nb_cores=1, rank=0, nranks=2)
+    rde = RemoteDepEngine(ce, ctx)
+    ce.send_am = lambda tag, dst, payload: None
+    try:
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=8, ln=8, nodes=2,
+                              myrank=0, name="Bret")
+        tp = Taskpool("ret1")
+        tp.recovery_collections = [A]
+        ctx.add_taskpool(tp)
+        rec = ctx.recovery
+        tp.state = TaskpoolState.DONE
+        # a restart owns the pool: the stale confirmation is ignored
+        with rec._lock:
+            rec._active.add(tp.taskpool_id)
+        rec._on_recover_msg(0, {"k": "retired", "tp": tp.taskpool_id})
+        assert not tp.retired
+        with rec._lock:
+            rec._active.discard(tp.taskpool_id)
+        rec._on_recover_msg(0, {"k": "retired", "tp": tp.taskpool_id})
+        assert tp.retired
+        tp.cancel()
+    finally:
+        ce._stop = True
+        rde.fini()
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_single_rank_pool_retires_at_completion():
+    """No peers: local completion IS global quiescence — the pool
+    leaves restartable state immediately instead of dangling through
+    the 30 s grace window."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        from parsec_tpu.apps.potrf import potrf_taskpool
+        n, mb = 32, 16
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+        A = TwoDimBlockCyclic(mb=mb, nb=mb, lm=n, ln=n,
+                              name="Aret1").from_array(spd.copy())
+        tp = potrf_taskpool(A, device="cpu")
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+        assert tp.retired
+        assert ctx.recovery.retirements >= 1
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
+def test_refired_completion_emits_exactly_one_job_done():
+    """Service seam: a recovery restart re-firing a completed pool's
+    termination callbacks is absorbed below the service — exactly ONE
+    terminal job_done per job (SLO histograms and waiters would
+    otherwise double-observe)."""
+    from parsec_tpu.service.service import JobService
+    from parsec_tpu.core.taskpool import Taskpool
+    svc = JobService(max_active=1, nb_cores=1)
+    try:
+        events = []
+        svc.context.pins_register(
+            "job_done", lambda es, ev, job: events.append(job.job_id))
+        job = svc.submit(lambda: Taskpool("j1"), name="j1")
+        assert job.wait(10)
+        deadline = time.monotonic() + 5
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert events == [job.job_id]
+        # the recovery restart re-fires the pool's completion path
+        svc._finish(job)
+        svc._finish(job)
+        time.sleep(0.05)
+        assert events == [job.job_id]
+    finally:
+        svc.shutdown(timeout=10)
 
 
 # ---------------------------------------------------------------------------
@@ -797,6 +1371,27 @@ def test_minimal_replay_reexecutes_strictly_fewer():
     assert ab["minimal"]["reexec"] < ab["full"]["reexec"], ab
 
 
+def test_kill_dtd_chain_skip_minimal_sole_survivor():
+    """2-rank DTD chain kill: the sole survivor SHORT-CIRCUITS the
+    skip agreement to its local view (no wire round), ghost-replays
+    the completed prefix, and ends with the exact final value — the
+    counters prove the minimal path (full_replays stays 0); the wired
+    multi-survivor round is the chaos kill-dtd-minimal 3-rank case."""
+    import chaos
+    res = _run_distributed_with_env(
+        chaos.dtd_ab_chain_workload, 2,
+        {"PARSEC_MCA_FAULT_PLAN":
+         "seed=5;kill_rank=1@t+2.0s,mode=close;"
+         "delay_dispatch=key~_dtd_chain_step,ms=100",
+         "PARSEC_MCA_RECOVERY_ENABLE": "1",
+         "PARSEC_CHAOS_WAIT_S": "45"},
+        timeout=120, tolerate_ranks=(1,))
+    surv = res[0]
+    assert surv is not None and surv[0] == "ok" and res[1] is None
+    assert surv[2] >= 1 and surv[3] == 0    # minimal, never full
+    assert surv[4] >= 1                     # skip agreement concluded
+
+
 def test_kill_recovers_dynamic_taskpool_with_hold():
     """A DynamicTaskpool killed while its distributed termination hold
     is outstanding restarts on the survivor with the hold RE-ARMED
@@ -901,13 +1496,13 @@ def test_three_rank_potrf_survives_midrun_kill():
 @pytest.mark.slow
 def test_chaos_recover_catalog():
     """The full recovery catalog (close/hang x evloop/shm/threads +
-    DTD + minimal replay + dyn holds + multi-death agreement +
-    survivor exhaustion, plus the shm kill->restart->rejoin leg)
-    through the chaos harness."""
+    DTD + minimal replay + the DTD skip agreement + dyn holds +
+    multi-death agreement + survivor exhaustion, plus the shm
+    kill->restart->rejoin leg) through the chaos harness."""
     import subprocess
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
-         "--recover", "--seeds", "11", "--timeout", "120"],
+         "--recover", "--seeds", "12", "--timeout", "120"],
         capture_output=True, text=True, timeout=1500,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -90,11 +90,14 @@ SKIP_KEYS = {"metric", "unit", "storage", "note", "ib",
              # doorbell count tracks scheduling burst shape, not the
              # code under test
              "budget", "doorbell",
-             # recovery A/B side readings (r13): host-load-sensitive
+             # recovery A/B side readings (r13; r15 adds the nested
+             # "dtd" leg — insert-stream skip-agreement re-execution
+             # counts + makespan ratios): host-load-sensitive
              # makespans and exact re-execution counts are evidence,
              # not rate metrics — the gated value is the headline
-             # minimal-makespan ratio, and the minimal<full invariant
-             # is asserted by chaos --ab-minimal in premerge
+             # minimal-makespan ratio (lower-is-better), and the
+             # minimal<full invariant on BOTH DAGs is asserted by
+             # chaos --ab-minimal in premerge
              "recovery"}
 
 

@@ -150,6 +150,72 @@ def dtd_chain_recover_workload(ctx, rank, nranks):
     return "ok"
 
 
+def _dtd_chain_step(T):
+    """Named DTD chain body: the function name lands in the task key,
+    so keyed fault directives (``delay_dispatch=key~_dtd_chain_step``)
+    can stall exactly these bodies."""
+    return T + 1.0
+
+
+def dtd_ab_chain_workload(ctx, rank, nranks):
+    """Multi-rank DTD increment chain with keyed 100 ms bodies and a
+    recovery spec — the DTD minimal-vs-full A/B DAG.  Inserts
+    alternate between ranks 0 and 1 (rank 2+, when present, tracks the
+    SPMD stream as a pure observer and participates in the skip
+    agreement over the wire).  A mid-chain kill leaves the survivor a
+    completed skippable prefix at any kill point; replay-from-restore-
+    point re-runs the whole stream either way, so minimal < full
+    deterministically.  Returns the survivor's replay accounting."""
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, DTDTaskpool
+
+    steps = int(os.environ.get("PARSEC_CHAOS_DTD_STEPS", 40))
+    V = VectorTwoDimCyclic(mb=4, lm=4, nodes=nranks, myrank=rank,
+                           name="Vdtdab")
+    # re-runnable v0 source: an ADOPTING survivor with no attach
+    # snapshot of the tile (it was never local) restores from here
+    V.set_init(lambda m, n=0: np.zeros(4, np.float32))
+    if rank == 0:
+        V.data_of(0).copy_on(0).payload[:] = 0.0
+    tp = DTDTaskpool("chaos-dtd-ab")
+
+    def insert_stream(pool, V=V, steps=steps):
+        t = pool.tile_of(V, 0)
+        for i in range(steps):
+            pool.insert_task(_dtd_chain_step, (t, INOUT),
+                             (i % 2, AFFINITY))
+
+    tp.recovery_collections = [V]
+    tp.recovery_replay = insert_stream
+    ctx.add_taskpool(tp)
+    ctx.start()
+    insert_stream(tp)
+    tp.wait(timeout=_wait_s())
+    ctx.wait(timeout=_wait_s())
+    if rank == 0:
+        val = np.asarray(V.data_of(0).pull_to_host().payload)
+        np.testing.assert_allclose(val, float(steps))
+    rec = ctx.recovery
+    st = rec.stats() if rec is not None else {}
+    return ("ok", st.get("tasks_reexecuted", 0),
+            st.get("minimal_replays", 0), st.get("full_replays", 0),
+            st.get("skip_agreements", 0))
+
+
+def dtd_minimal_recover_workload(ctx, rank, nranks):
+    """The DTD A/B chain under a kill, with the SKIP-AGREEMENT path
+    asserted on every survivor: a full-replay fallback FAILS the case
+    (observed-outcome discipline — the counters prove which path ran,
+    a silent fallback is a regression, not a pass)."""
+    r = dtd_ab_chain_workload(ctx, rank, nranks)
+    if r[2] < 1 or r[3] > 0 or r[4] < 1:
+        raise AssertionError(
+            f"DTD minimal replay did not engage (minimal={r[2]}, "
+            f"full={r[3]}, skip_agreements={r[4]}) — silent fallback "
+            "to full insert-stream replay")
+    return r
+
+
 def _chain_hook(es, task):
     """Shared CPU incarnation of the dyn and A/B chains' W(i): own
     tile T := predecessor P + 1 (P is READ — never mutated, so sharing
@@ -239,7 +305,9 @@ WORKLOADS = {"potrf": potrf_workload, "dtd": dtd_chain_workload,
              "dtd-recover": dtd_chain_recover_workload,
              "dyn-recover": dyn_chain_recover_workload,
              "potrf-recover-count": potrf_recover_count_workload,
-             "ab-chain-minimal": ab_chain_minimal_workload}
+             "ab-chain-minimal": ab_chain_minimal_workload,
+             "dtd-ab-chain": dtd_ab_chain_workload,
+             "dtd-minimal": dtd_minimal_recover_workload}
 
 
 # ---------------------------------------------------------------------------
@@ -436,24 +504,26 @@ def _ab_plan() -> str:
             f"delay_dispatch=key~W(,ms={body_ms}")
 
 
-def run_ab_pair(timeout=120.0):
-    """Run the A/B kill twice — recorded-lineage minimal replay vs
-    forced replay-from-restore-point — and return
-    ``{mode: {"reexec", "minimal", "full", "makespan_s"}}``.  Raises
-    RuntimeError when either leg fails or the kill never fired (a run
-    that outpaced its trigger exercised no recovery)."""
+def _run_ab_legs(plan: str, workload, nranks: int, timeout: float,
+                 label: str = ""):
+    """The shared A/B scaffolding: run one kill plan twice — minimal
+    replay vs forced replay-from-restore-point — with env save/restore
+    and the kill-actually-fired validation.  Returns
+    ``{mode: {"reexec", "minimal", "full", ["skip"], "makespan_s"}}``;
+    raises RuntimeError when either leg fails or the kill never fired
+    (a run that outpaced its trigger exercised no recovery)."""
     from parsec_tpu.comm.launch import run_distributed
     keys = _CHAOS_ENV + ("PARSEC_MCA_RECOVERY_MINIMAL",)
     out = {}
     for mode, knob in (("minimal", "1"), ("full", "0")):
         saved = {k: os.environ.get(k) for k in keys}
-        os.environ["PARSEC_MCA_FAULT_PLAN"] = _ab_plan()
+        os.environ["PARSEC_MCA_FAULT_PLAN"] = plan
         os.environ["PARSEC_CHAOS_WAIT_S"] = "45"
         os.environ["PARSEC_MCA_RECOVERY_ENABLE"] = "1"
         os.environ["PARSEC_MCA_RECOVERY_MINIMAL"] = knob
         t0 = time.monotonic()
         try:
-            res = run_distributed(ab_chain_recover_workload, 2,
+            res = run_distributed(workload, nranks,
                                   timeout=timeout, tolerate_ranks=[1])
         finally:
             for k, v in saved.items():
@@ -464,19 +534,52 @@ def run_ab_pair(timeout=120.0):
         dt = time.monotonic() - t0
         surv = res[0]
         if surv is None or surv[0] != "ok":
-            raise RuntimeError(f"{mode} leg failed: {res!r}")
+            raise RuntimeError(f"{label}{mode} leg failed: {res!r}")
         if res[1] is not None:
             raise RuntimeError(
-                f"{mode} leg outpaced its kill trigger (victim "
+                f"{label}{mode} leg outpaced its kill trigger (victim "
                 "completed) — no recovery was exercised")
-        out[mode] = {"reexec": surv[1], "minimal": surv[2],
-                     "full": surv[3], "makespan_s": round(dt, 2)}
+        ent = {"reexec": surv[1], "minimal": surv[2],
+               "full": surv[3], "makespan_s": round(dt, 2)}
+        if len(surv) > 4:
+            ent["skip"] = surv[4]
+        out[mode] = ent
     return out
+
+
+def run_ab_pair(timeout=120.0):
+    """The PTG A/B: recorded-lineage minimal replay vs forced
+    replay-from-restore-point on the same deterministic chain kill."""
+    return _run_ab_legs(_ab_plan(), ab_chain_recover_workload, 2,
+                        timeout)
+
+
+def _dtd_ab_plan() -> str:
+    """The DTD A/B kill plan: keyed 100 ms chain bodies make the
+    40-step chain's makespan >= 4 s, so the t+2.0s kill always lands
+    mid-stream — late enough that the survivor provably holds a
+    completed, skippable prefix even on a loaded host (spawn + jax
+    import eat the first second or more of the kill budget)."""
+    kill_s = os.environ.get("PARSEC_CHAOS_AB_KILL_S", "2.0")
+    body_ms = os.environ.get("PARSEC_CHAOS_AB_BODY_MS", "100")
+    return (f"seed=11;kill_rank=1@t+{kill_s}s,mode=close;"
+            f"delay_dispatch=key~_dtd_chain_step,ms={body_ms}")
+
+
+def run_ab_pair_dtd(timeout=120.0, nranks=3):
+    """The DTD insert-stream A/B: the same mid-chain kill under the
+    cross-rank skip agreement vs forced full replay.  3 ranks by
+    default so the skip round runs OVER THE WIRE between two survivors
+    (2 ranks would short-circuit at the sole survivor)."""
+    return _run_ab_legs(_dtd_ab_plan(), dtd_ab_chain_workload, nranks,
+                        timeout, label="dtd ")
 
 
 def run_ab_minimal(timeout=120.0) -> int:
     """CI leg: assert tasks_reexecuted(minimal) < tasks_reexecuted(full)
-    on the acceptance DAG and that each leg took its intended path."""
+    on the acceptance DAG — BOTH A/B lines: the PTG chain (recorded-
+    lineage plan) and the DTD chain (insert-stream skip agreement) —
+    with each leg provably taking its intended path."""
     try:
         ab = run_ab_pair(timeout=timeout)
     except RuntimeError as exc:
@@ -494,7 +597,27 @@ def run_ab_minimal(timeout=120.0) -> int:
           f"{ab['full']['full']}; makespans "
           f"{ab['minimal']['makespan_s']}s vs "
           f"{ab['full']['makespan_s']}s)")
-    return 0 if ok else 1
+    rc = 0 if ok else 1
+    try:
+        dab = run_ab_pair_dtd(timeout=timeout)
+    except RuntimeError as exc:
+        print(f"[FAIL] ab-minimal-dtd: {exc}")
+        return 1
+    dok = (dab["minimal"]["minimal"] >= 1
+           and dab["minimal"]["full"] == 0
+           and dab["minimal"]["skip"] >= 1
+           and dab["full"]["full"] >= 1
+           and dab["minimal"]["reexec"] < dab["full"]["reexec"])
+    status = "PASS" if dok else "FAIL"
+    print(f"[{status}] ab-minimal-dtd: skip-agreed replay re-executed "
+          f"{dab['minimal']['reexec']} vs full "
+          f"{dab['full']['reexec']} task(s) on the same kill "
+          f"(paths: minimal={dab['minimal']['minimal']}/"
+          f"{dab['minimal']['full']} skip={dab['minimal']['skip']}, "
+          f"full={dab['full']['minimal']}/{dab['full']['full']}; "
+          f"makespans {dab['minimal']['makespan_s']}s vs "
+          f"{dab['full']['makespan_s']}s)")
+    return rc or (0 if dok else 1)
 
 #: (name, plan template, workload, expected outcome, extra env).
 #: {s} is the seed.  Expected outcomes:
@@ -634,6 +757,18 @@ CATALOG = [
      "ab-chain-minimal", "recovered",
      {"PARSEC_CHAOS_WAIT_S": "45",
       "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
+    # DTD skip agreement (r15): a 3-rank DTD chain kill down the
+    # cross-rank skip-agreement path — two survivors agree the
+    # skippable insert prefix OVER THE WIRE and the workload RAISES
+    # if recovery silently fell back to the full insert-stream replay
+    # (the quantitative minimal<full check is chaos --ab-minimal's
+    # second A/B line)
+    ("kill-dtd-minimal",
+     "seed={s};kill_rank=1@t+2.0s,mode=close;"
+     "delay_dispatch=key~_dtd_chain_step,ms=100",
+     "dtd-minimal", "recovered",
+     {"PARSEC_CHAOS_WAIT_S": "45", "_NRANKS": "3",
+      "PARSEC_MCA_RECOVERY_ENABLE": "1", "_TOLERATE": "1"}),
     # dyn-hold recovery (r13): a DynamicTaskpool killed with its
     # distributed termination hold outstanding restarts on the survivor
     # with the hold RE-ARMED (previously stranded across the restart)
@@ -676,7 +811,7 @@ _RECOVER = ("kill-close-recover", "kill-hang-recover",
             "kill-close-recover-threads", "kill-hang-recover-shm",
             "kill-hang-recover-threads", "double-kill",
             "kill-minimal-recover", "kill-dyn-recover",
-            "multi-death-agreement")
+            "kill-dtd-minimal", "multi-death-agreement")
 
 _CHAOS_ENV = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
               "PARSEC_MCA_COMM_PEER_TIMEOUT_S",
@@ -750,10 +885,53 @@ def run_case(name, plan, workload, expect, env, timeout):
     return outcome == expect, outcome, detail
 
 
+def run_soak(n: int, timeout: float) -> int:
+    """``--soak N``: N RANDOMLY seeded schedules drawn from the recover
+    catalog, each with the full per-run invariant checks (numerics
+    validated in-worker, no hang, recovery OBSERVED when expected).
+    The master seed and every (case, seed) pair are printed so any
+    failure replays exactly:
+
+        PARSEC_CHAOS_SOAK_SEED=<master> python tools/chaos.py --soak N
+        # or one case: --only <case> --seeds 1 with the printed plan
+    """
+    import random
+    master = int(os.environ.get("PARSEC_CHAOS_SOAK_SEED",
+                                str(int(time.time()) % 1000000)))
+    rng = random.Random(master)
+    cases = [c for c in CATALOG if c[0] in _RECOVER]
+    print(f"soak: {n} random recover schedules "
+          f"(PARSEC_CHAOS_SOAK_SEED={master})")
+    failures = 0
+    for i in range(n):
+        name, plan_t, wl, expect, env = rng.choice(cases)
+        seed = rng.randrange(1, 1000000)
+        plan = plan_t.format(s=seed)
+        t0 = time.monotonic()
+        ok, outcome, detail = run_case(name, plan, wl, expect, env,
+                                       timeout)
+        dt = time.monotonic() - t0
+        status = "PASS" if ok else "FAIL"
+        print(f"[{status}] soak {i + 1}/{n} {name:20s} seed={seed} "
+              f"expect={expect} got={outcome} ({dt:.1f}s)", flush=True)
+        if not ok:
+            failures += 1
+            print(f"       plan: {plan}", flush=True)
+            print(f"       {detail}", flush=True)
+    print(f"soak: {n - failures}/{n} random schedules held the "
+          f"invariants (replay: PARSEC_CHAOS_SOAK_SEED={master})")
+    return 1 if failures else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=12,
                     help="seeded plan runs (rotating over the catalog)")
+    ap.add_argument("--soak", type=int, default=0, metavar="N",
+                    help="N randomly seeded schedules from the recover "
+                         "catalog with per-run invariant checks; "
+                         "seeds printed for replay "
+                         "(PARSEC_CHAOS_SOAK_SEED pins the draw)")
     ap.add_argument("--quick", action="store_true",
                     help="premerge smoke: only the quick catalog subset")
     ap.add_argument("--recover", action="store_true",
@@ -778,6 +956,8 @@ def main(argv=None):
     ap.add_argument("--list", action="store_true")
     args = ap.parse_args(argv)
 
+    if args.soak:
+        return run_soak(args.soak, args.timeout)
     if args.ab_minimal:
         return run_ab_minimal(timeout=args.timeout)
     if args.rejoin:

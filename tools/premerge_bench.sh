@@ -239,8 +239,19 @@ echo "== premerge probe: recovery minimal-vs-full replay A/B =="
 # r13: the recorded-lineage minimal replay must re-execute STRICTLY
 # FEWER tasks than replay-from-restore-point on the acceptance kill,
 # with each leg provably taking its intended path (a silent fallback
-# to full replay fails the gate)
+# to full replay fails the gate).  r15 adds a SECOND A/B line to the
+# same gate: the 3-rank DTD chain down the cross-rank skip-agreement
+# path (insert-stream prefix agreed over the wire between two
+# survivors) vs the forced full insert-stream replay.
 if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --ab-minimal; then
+    rc=1
+fi
+echo "== premerge probe: chaos soak (random recover schedules) =="
+# r15: N=4 randomly seeded schedules drawn from the recover catalog,
+# each with the full per-run invariant checks (validated numerics,
+# no hang, recovery observed); the master seed is printed so any
+# failure replays exactly (PARSEC_CHAOS_SOAK_SEED=<seed> --soak 4)
+if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --soak 4; then
     rc=1
 fi
 exit $rc
