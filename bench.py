@@ -800,26 +800,28 @@ def run_tasks_bench(n: int = 20000):
                     "doorbell": doorbell}
 
 
-def run_telemetry_bench(n: int = 20000):
-    """Always-on telemetry overhead, as a ratio: the tasks probe with
-    the metrics registry AND flight recorder armed vs both off —
-    the premerge telemetry gate's measurement (bound <= 5%, an order
-    cheaper than the causal tracer's 50% gate).  Four back-to-back
-    off/on pairs; the reported value is the MINIMUM pair ratio (the
-    min-RTT discipline — see the inline rationale) so one loaded host
-    window cannot fake a gate failure, while a real regression, which
-    shows in every pair, still trips it."""
+def _overhead_probe(knobs, label: str, n: int = 20000):
+    """Shared armed-vs-off overhead harness (the telemetry AND journal
+    gates): interleaved back-to-back pairs of the null-task probe with
+    every knob in ``knobs`` set to 1 (armed) vs 0 (off).
+
+    The reported value is the MINIMUM pair ratio — the clock
+    estimator's min-RTT principle applied to an overhead gate:
+    host-load noise on a shared CI core spans ~10% run to run (an
+    order above the effect measured) and contaminates individual
+    pairs in either direction, but a REAL regression shows in every
+    pair, so the cleanest pair bounds the true overhead from below
+    while staying immune to one loaded window faking a gate failure.
+    The ABSOLUTE armed cost in us/task rides along: the gate that
+    stays meaningful as the base gets faster (at the r14 ~1us/task
+    headline a constant 0.5us plane reads as +50% ratio — the ratio
+    stops measuring the code under test)."""
     from parsec_tpu.core.context import Context
     from parsec_tpu.utils.mca import params as _params
 
     def rate(armed: int) -> float:
-        _params.set("metrics_enabled", armed)
-        _params.set("flightrec_enabled", armed)
-        # the armed leg carries the WHOLE plane: registry + flight
-        # recorder + the live attribution engine with straggler
-        # detection (liveattr rides the metrics sampling stride, so
-        # arming it is the production configuration this gate bounds)
-        _params.set("liveattr_enable", armed)
+        for k in knobs:
+            _params.set(k, armed)
         try:
             with Context(nb_cores=int(os.environ.get(
                     "PARSEC_BENCH_CORES", 4))) as ctx:
@@ -830,17 +832,9 @@ def run_telemetry_bench(n: int = 20000):
                 ctx.wait()
                 return n / (time.perf_counter() - t0)
         finally:
-            _params.unset("metrics_enabled")
-            _params.unset("flightrec_enabled")
-            _params.unset("liveattr_enable")
+            for k in knobs:
+                _params.unset(k)
 
-    # minimum over back-to-back pair ratios — the clock estimator's
-    # min-RTT principle applied to an overhead gate: host-load noise
-    # on a shared CI core spans ~10% run to run (an order above the
-    # effect measured) and contaminates individual pairs in either
-    # direction, but a REAL regression shows in every pair, so the
-    # cleanest pair bounds the true overhead from below while staying
-    # immune to one loaded window faking a gate failure
     pairs = []
     us_pairs = []
     off = on = 0.0
@@ -849,19 +843,40 @@ def run_telemetry_bench(n: int = 20000):
         off, on = max(off, o), max(on, a)
         if a and o:
             pairs.append(max(0.0, o / a - 1.0))
-            # the ABSOLUTE armed cost in us/task: the gate that stays
-            # meaningful as the base gets faster (at the r14 ~1us/task
-            # headline a constant 0.5us plane reads as +50% ratio —
-            # the ratio stopped measuring the telemetry code)
             us_pairs.append(max(0.0, (1.0 / a - 1.0 / o) * 1e6))
     overhead = min(pairs) if pairs else 1.0
     overhead_us = min(us_pairs) if us_pairs else 10.0
-    log(f"telemetry overhead: {overhead:+.1%} / {overhead_us:.3f} "
+    log(f"{label} overhead: {overhead:+.1%} / {overhead_us:.3f} "
         f"us/task (min of {['%+.1f%%' % (p * 100) for p in pairs]}; "
         f"best off {off:.0f} -> armed {on:.0f} tasks/s)")
     return overhead, {"tasks_off": round(off, 1),
                       "tasks_on": round(on, 1),
                       "overhead_us": round(overhead_us, 3)}
+
+
+def run_telemetry_bench(n: int = 20000):
+    """Always-on telemetry overhead, as a ratio: the tasks probe with
+    the metrics registry AND flight recorder armed vs both off — the
+    premerge telemetry gate's measurement (bound <= 5%, an order
+    cheaper than the causal tracer's 50% gate).  The armed leg
+    carries the WHOLE plane: registry + flight recorder + the live
+    attribution engine with straggler detection (liveattr rides the
+    metrics sampling stride, so arming it is the production
+    configuration this gate bounds)."""
+    return _overhead_probe(("metrics_enabled", "flightrec_enabled",
+                            "liveattr_enable"), "telemetry", n)
+
+
+def run_journal_bench(n: int = 20000):
+    """Control-plane journal overhead on the tasks probe, armed vs
+    off — the telemetry-gate discipline (interleaved pairs, min-of-
+    pairs, both the ratio and the ABSOLUTE us/task cost reported).
+    The journal has NO per-task emit sites by construction (every
+    emit is control-plane code: recovery rounds, retirement
+    handshakes, barriers, job lifecycle), so the C run_quantum fast
+    path never crosses it — this gate PROVES that instead of
+    asserting it in prose."""
+    return _overhead_probe(("journal_enabled",), "journal", n)
 
 
 def run_stencil_bench(mb: int = 0, nt: int = 8, steps: int = 0):
@@ -1032,6 +1047,8 @@ _AUX_MODES = {
     "tasks": (run_tasks_bench, "task_throughput", "tasks/s", 10000.0, True),
     "telemetry": (run_telemetry_bench, "telemetry_overhead", "ratio",
                   0.05, False),
+    "journal": (run_journal_bench, "journal_overhead", "ratio",
+                0.05, False),
     "stencil": (run_stencil_bench, "stencil_throughput", "points/s",
                 1e8, True),
     "tracer": (run_tracer_bench, "tracer_overhead", "us/task", 1.0, False),

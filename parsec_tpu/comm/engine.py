@@ -423,6 +423,12 @@ class CommEngine:
         self._metrics_replies: Dict[int, Dict[int, Any]] = {}  # guarded-by: _metrics_cond
         self._metrics_req = 0                    # guarded-by: _metrics_cond
         self.tag_register(TAG_METRICS, self._metrics_cb)
+        #: control-plane journal (prof/journal.py): the Context's
+        #: journal attaches here so barrier/death events land in it,
+        #: and a provider serves cross-rank journal pulls riding the
+        #: SAME TAG_METRICS req/reply machinery (zero new wire tags)
+        self.journal = None
+        self.journal_provider: Optional[Callable[[], Any]] = None
         #: flight recorder (prof/flightrec.py): a peer's incident
         #: broadcast asks this rank to dump its ring into the bundle
         self.on_flight_dump: Optional[Callable[[str], None]] = None
@@ -482,6 +488,15 @@ class CommEngine:
         return [r for r in range(self.nranks)
                 if r == self.rank or r not in self.excused_peers]
 
+    def _journal_barrier(self, gen: int, root: int, outcome: str) -> None:
+        """Journal one barrier round's terminal state (the generation
+        numbers are protocol state the rejoin handshake re-syncs — a
+        divergent generation is exactly a black-box question)."""
+        jr = self.journal
+        if jr is not None:
+            jr.emit("barrier", gen=gen, outcome=outcome, root=root,
+                    peers=self._bar_live())
+
     def barrier(self, timeout: float = 60.0) -> None:
         with self._bar_cond:
             # under the lock: two app threads racing barrier() must not
@@ -498,9 +513,11 @@ class CommEngine:
             # survivor-of-one barrier (trivially met), otherwise the
             # fatal check below raises as before
             if self._bar_fatal():
+                self._journal_barrier(gen, root, "dead")
                 raise ConnectionError(
                     f"rank {self.rank}: barrier with dead peer(s) "
                     f"{sorted(self.dead_peers)}")
+            self._journal_barrier(gen, root, "ok")
             return
         with self._bar_cond:
             # GC residue of past generations (stragglers landing after a
@@ -534,6 +551,7 @@ class CommEngine:
                 if not failed:
                     if not ok:
                         self._bar_arrived.pop(gen, None)
+                        self._journal_barrier(gen, root, "timeout")
                         raise TimeoutError(
                             f"rank {self.rank}: barrier timeout")
                     self._bar_arrived.pop(gen, None)
@@ -553,6 +571,7 @@ class CommEngine:
                         self.send_am(TAG_BARRIER, r, ("abort", gen))
                     except OSError:
                         pass
+                self._journal_barrier(gen, root, "dead")
                 raise ConnectionError(
                     f"rank {self.rank}: barrier with dead peer(s) "
                     f"{sorted(self.dead_peers)}")
@@ -566,6 +585,7 @@ class CommEngine:
                     # the release of later-ranked survivors
                     warning("rank %d: barrier release to dead rank %d "
                             "skipped", self.rank, r)
+            self._journal_barrier(gen, root, "ok")
         else:
             self.send_am(TAG_BARRIER, root, ("arrive", gen))
             with self._bar_cond:
@@ -590,6 +610,8 @@ class CommEngine:
                          or root in self.dead_peers):
                     aborted = gen in self._bar_aborted
                     self._bar_aborted.discard(gen)
+                    self._journal_barrier(
+                        gen, root, "abort" if aborted else "dead")
                     raise ConnectionError(
                         f"rank {self.rank}: barrier with dead peer(s) "
                         f"{sorted(self.dead_peers)}"
@@ -597,11 +619,13 @@ class CommEngine:
                 if not ok:
                     self._bar_released.discard(gen)
                     self._bar_aborted.discard(gen)
+                    self._journal_barrier(gen, root, "timeout")
                     raise TimeoutError(
                         f"rank {self.rank}: barrier timeout "
                         f"(dead peers: {sorted(self.dead_peers) or None})")
                 self._bar_released.discard(gen)
                 self._bar_aborted.discard(gen)
+                self._journal_barrier(gen, root, "ok")
 
     # -- clock alignment (causal traces): Cristian-style ping exchange --
     # lint: on-loop (periodic hook on the comm loop/progress thread)
@@ -697,8 +721,12 @@ class CommEngine:
     # lint: on-loop (AM callback: builds a snapshot — short lock holds
     # in the registry — and replies on the control lane)
     def _metrics_cb(self, src: int, msg: dict) -> None:
-        if msg.get("k") == "pull":
-            provider = self.metrics_provider
+        if msg.get("k") in ("pull", "jpull"):
+            # "pull" = telemetry snapshot, "jpull" = control-plane
+            # journal snapshot; both reply with a req-correlated push
+            # so one reply/wait machinery serves both
+            provider = self.metrics_provider if msg["k"] == "pull" \
+                else self.journal_provider
             try:
                 samples = provider() if provider is not None else []
             except Exception:   # a broken provider must not kill the loop
@@ -716,11 +744,11 @@ class CommEngine:
                 pend[int(msg.get("rank", src))] = msg.get("samples") or []
                 self._metrics_cond.notify_all()
 
-    def gather_metrics(self, timeout: float = 2.0) -> Dict[int, Any]:
-        """Pull every live peer's telemetry snapshot over TAG_METRICS;
-        returns rank -> sample list (missing ranks timed out or died).
-        Blocks the CALLER — scrape threads (service/server.py), never
-        the comm loop itself."""
+    def _gather(self, kind: str, timeout: float) -> Dict[int, Any]:
+        """One req-correlated pull round at every live peer (the shared
+        machinery under gather_metrics/gather_journals).  Blocks the
+        CALLER — scrape threads (service/server.py), never the comm
+        loop itself."""
         targets = [r for r in range(self.nranks)
                    if r != self.rank and r not in self.dead_peers]
         if not targets:
@@ -732,7 +760,7 @@ class CommEngine:
         reached = []
         for r in targets:
             try:
-                self.send_am(TAG_METRICS, r, {"k": "pull", "req": req})
+                self.send_am(TAG_METRICS, r, {"k": kind, "req": req})
                 reached.append(r)
             except OSError:
                 pass   # died since the dead_peers check: don't wait on it
@@ -743,6 +771,19 @@ class CommEngine:
                     >= len(reached),
                     timeout=timeout)
             return self._metrics_replies.pop(req, {})
+
+    def gather_metrics(self, timeout: float = 2.0) -> Dict[int, Any]:
+        """Pull every live peer's telemetry snapshot over TAG_METRICS;
+        returns rank -> sample list (missing ranks timed out or died)."""
+        return self._gather("pull", timeout)
+
+    def gather_journals(self, timeout: float = 2.0) -> Dict[int, Any]:
+        """Pull every live peer's control-plane journal snapshot (the
+        job-port ``{"op": "journal"}`` surface and the hang autopsy's
+        clock-aligned tail both ride this); rank -> snapshot dict."""
+        out = self._gather("jpull", timeout)
+        return {r: snap for r, snap in out.items()
+                if isinstance(snap, dict) and snap}
 
     # lint: on-loop (AM callback — hands the dump to a timer thread so
     # file I/O never stalls the comm loop)
@@ -844,9 +885,13 @@ class CommEngine:
     def excuse_peer(self, r: int) -> None:
         """Mark a dead rank ROUTED-AROUND: collectives and quiescence
         proceed over the survivors instead of failing on it."""
+        first = r not in self.excused_peers
         self.excused_peers.add(r)
         with self._bar_cond:
             self._bar_cond.notify_all()
+        jr = self.journal
+        if jr is not None and first:
+            jr.emit("peer_excused", peer=r)
 
     def peer_rejoined(self, r: int, epoch: int) -> None:
         """A restarted incarnation of ``r`` completed the TAG_REJOIN
@@ -918,6 +963,10 @@ class CommEngine:
         if r in self.dead_peers or self._stop_requested():
             return
         warning("rank %d: declaring rank %d dead: %s", self.rank, r, exc)
+        jr = self.journal
+        if jr is not None:
+            jr.emit("peer_dead", peer=r,
+                    detector=getattr(exc, "detector", "unknown"))
         self.dead_peers.add(r)
         self._drop_peer(r)
         with self._bar_cond:

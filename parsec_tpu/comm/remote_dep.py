@@ -330,6 +330,12 @@ class RemoteDepEngine:
         if m is not None:
             ce.metrics_provider = m.samples
             ce.on_clock_rtt = m.comm_frame_rtt.observe
+        jr = getattr(context, "journal", None)
+        if jr is not None:
+            # control-plane black box: the journal learns this rank's
+            # incarnation + clock table, the engine learns where
+            # barrier/death events land and how to answer journal pulls
+            jr.attach_comm(ce)
         fr = getattr(context, "_flightrec", None)
         if fr is not None:
             fr.attach_comm(self)
@@ -706,9 +712,16 @@ class RemoteDepEngine:
         once the re-inserted sub-DAG drains (the generalization of the
         on_frame_fault drop reconcile)."""
         with self._term_lock:
-            self._fence_epoch[dead] = self._peer_epoch.get(dead, 0) + 1
-            self._app_sent -= self._sent_to.pop(dead, 0)
-            self._app_recv -= self._recv_from.pop(dead, 0)
+            fence = self._fence_epoch[dead] = \
+                self._peer_epoch.get(dead, 0) + 1
+            sent = self._sent_to.pop(dead, 0)
+            recv = self._recv_from.pop(dead, 0)
+            self._app_sent -= sent
+            self._app_recv -= recv
+        jr = getattr(self.context, "journal", None)
+        if jr is not None:
+            jr.emit("safra_reconcile", peer=dead, fence=fence,
+                    sent=sent, recv=recv)
 
     def forget_pool(self, tp) -> None:
         """Drop every parked/queued protocol item of a pool's torn

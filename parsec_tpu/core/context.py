@@ -170,6 +170,12 @@ class Context:
         self._causal_tracer = None     # prof/causal.py CausalTracer
         self.metrics = None            # prof/metrics.py RuntimeMetrics
         self._flightrec = None         # prof/flightrec.py FlightRecorder
+        # control-plane black box (prof/journal.py): every protocol
+        # decision — recovery rounds, termdet rewinds, retirement
+        # handshakes, rejoin fencing, barrier generations, job
+        # lifecycle — lands in this bounded ring; no per-task emits
+        from parsec_tpu.prof.journal import install_journal
+        install_journal(self)
         #: schedule() stamps Task.ready_at only when a telemetry
         #: consumer wants it (causal tracer or metrics registry), and
         #: devices/xla.py fires device_dispatch/device_done PINS only
@@ -633,6 +639,29 @@ class Context:
                     lines.append("comm: " + repr(dbg()))
                 except Exception as exc:   # the autopsy must never raise
                     lines.append(f"comm: <debug_state failed: {exc}>")
+        # control-plane tail: the last ~N protocol events per rank,
+        # clock-aligned — a wedged negotiation (a mode vote that never
+        # got its quorum, a need round nobody answered) is visible in
+        # the autopsy text itself, no bundle pull needed
+        tail_n = int(params.get("journal_autopsy_tail", 20))
+        if tail_n > 0 and getattr(self, "journal", None) is not None:
+            try:
+                from parsec_tpu.prof.journal import (cluster_journals,
+                                                     format_event,
+                                                     merge_journals)
+                per_rank = cluster_journals(self, timeout=2.0)
+                for r in sorted(per_rank):
+                    snap = per_rank[r]
+                    snap["events"] = snap.get("events", [])[-tail_n:]
+                merged = merge_journals(per_rank)
+                if merged:
+                    t0 = merged[0]["t"]
+                    lines.append("control-plane journal tail "
+                                 f"(last {tail_n}/rank, clock-aligned):")
+                    lines.extend("  " + format_event(ev, t0)
+                                 for ev in merged)
+            except Exception as exc:   # the autopsy must never raise
+                lines.append(f"journal tail: <failed: {exc}>")
         # armed flight recorder: the last-N-seconds ring is worth more
         # than this snapshot — dump it and point the reader at the
         # bundle (merge with tools/trace2chrome.py --merge)
@@ -678,6 +707,17 @@ class Context:
             self.metrics.uninstall(self)
         if self._flightrec is not None:
             self._flightrec.uninstall(self)
+        jdir = str(params.get("journal_dir", "") or "").strip()
+        jr = getattr(self, "journal", None)
+        if jdir and jr is not None and jr.enabled:
+            # per-rank black-box bundle for tools/journal_audit.py
+            # (chaos --audit-journal arms this per case).  A DISABLED
+            # journal dumps nothing at all — a header-only file would
+            # let an audit pass vacuously over zero events
+            try:
+                jr.dump(jdir)
+            except OSError as exc:
+                debug_verbose(1, "journal dump failed: %s", exc)
 
     def __enter__(self):
         return self

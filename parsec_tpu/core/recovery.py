@@ -723,6 +723,16 @@ class RecoveryCoordinator:
         #: completed pools retired through the explicit handshake
         #: (coordinator confirmed every live rank locally complete)
         self.retirements = 0
+        #: pools whose retirement handshake never concluded and whose
+        #: restartable state fell back to the grace-window eviction
+        #: (coordinator died mid-handshake, lost report) — the PR 14
+        #: residual, previously silent; journaled as retire_degraded
+        self.retire_degraded = 0
+        #: pools whose "retired" journal event already emitted (the
+        #: auditor's exactly-one-retirement-outcome invariant; the
+        #: handshake can apply twice when Context.wait's quiescence
+        #: retire races the coordinator broadcast) guarded-by: _lock
+        self._retired_emitted: set = set()
         #: need-negotiation rounds by outcome (acked / nacked /
         #: widened / exhausted) — a silent round is a failed gate
         self.need_round_counts = {"acked": 0, "nacked": 0,
@@ -840,6 +850,9 @@ class RecoveryCoordinator:
             self._apply_retired(tp.taskpool_id)
             return
         coord = rde.recovery_coordinator()
+        jr = self.context.journal
+        if jr is not None:
+            jr.emit("retire_report", pool=tp.taskpool_id, coord=coord)
         if coord == ce.rank:
             self._note_retire_report(tp.taskpool_id, ce.rank)
             return
@@ -860,6 +873,9 @@ class RecoveryCoordinator:
         ce = rde.ce if rde is not None else None
         if ce is None:
             return
+        jr = self.context.journal
+        if jr is not None:
+            jr.emit("retire_recv", pool=tpid, src=src)
         with self._ctl_cond:
             reported = self._retire_reports.setdefault(tpid, set())
             reported.add(src)
@@ -885,6 +901,7 @@ class RecoveryCoordinator:
         instead of dangling through the grace window, and a later peer
         death can never resurrect it (or re-fire its completion into
         the job service)."""
+        emit = False
         with self._lock:
             spec = self._specs.get(tpid)
             tp = spec["tp"] if spec is not None else None
@@ -892,6 +909,16 @@ class RecoveryCoordinator:
                 return
             tp.retired = True
             self.retirements += 1
+            if tpid not in self._retired_emitted:
+                # exactly ONE retirement-outcome journal event per pool
+                # per rank — the auditor's invariant; a second apply
+                # (quiescence-retire racing the broadcast) is absorbed
+                self._retired_emitted.add(tpid)
+                emit = True
+        if emit:
+            jr = self.context.journal
+            if jr is not None:
+                jr.emit("retired", pool=tpid)
             # no synchronous sweep: the retired flag already ends
             # restartability (on_peer_dead skips retired pools), and
             # the spec/snapshot/capture eviction rides the normal
@@ -921,10 +948,22 @@ class RecoveryCoordinator:
                 self._attempts.pop(tpid, None)
                 evicted.append(tpid)
                 evicted_dcs.update(id(dc) for dc in spec["collections"])
+                if done_at is not None and not tp.cancelled \
+                        and not getattr(tp, "retired", False):
+                    # the pool completed but its retirement handshake
+                    # never concluded (coordinator died mid-handshake,
+                    # lost report) and no quiescence round retired it:
+                    # the PR 14 grace-window degradation, counted and
+                    # journaled instead of silent
+                    self.retire_degraded += 1
+                    jr = self.context.journal
+                    if jr is not None:
+                        jr.emit("retire_degraded", pool=tpid)
         if evicted:
             # the TAG_RECOVER control state retires with the spec — a
             # resident service must not accumulate per-restart entries
             # (safe nesting: _ctl_cond is never held while taking _lock)
+            self._retired_emitted.difference_update(evicted)
             with self._ctl_cond:
                 for tpid in evicted:
                     self._plan_state.pop(tpid, None)
@@ -1076,6 +1115,11 @@ class RecoveryCoordinator:
         # a harmless repeat
         ce.excuse_peer(rank)
         self.counts["started"] += 1
+        jr = self.context.journal
+        if jr is not None:
+            jr.emit("recovery_start", peer=rank,
+                    pools=[tp.taskpool_id for tp in take],
+                    contained=[tp.taskpool_id for tp in leave])
         self.context.telemetry_incident(
             f"recovery-start rank={rank} pools="
             f"{[tp.taskpool_id for tp in take]}")
@@ -1207,6 +1251,10 @@ class RecoveryCoordinator:
         dt = time.monotonic() - t0
         self.duration_hist.observe(dt)
         self.counts["completed" if ok else "failed"] += 1
+        jr = ctx.journal
+        if jr is not None:
+            jr.emit("recovery_done", peer=rank, ok=ok,
+                    duration_s=round(dt, 4))
         self._notify_services("done" if ok else "failed", rank)
         warning("rank %d: recovery for dead rank %d %s in %.2fs",
                 ctx.rank, rank, "completed" if ok else "FAILED", dt)
@@ -1286,6 +1334,10 @@ class RecoveryCoordinator:
             # stale generations (run_epoch) and wait their bodies out
             tp.state = TaskpoolState.ATTACHED
             tp.run_epoch += 1
+            jr = self.context.journal
+            if jr is not None:
+                jr.emit("epoch_fence", pool=tpid, epoch=tp.run_epoch,
+                        dead=dead_set)
             # belt only: correctness rides on claim-before-fence-check
             # in task_progress (the drain observes every claimed body);
             # this just skips one drain poll for tasks popped right at
@@ -1360,6 +1412,10 @@ class RecoveryCoordinator:
             # re-arm the completion bookkeeping its termination already
             # released
             was = tp.termdet.taskpool_reset(tp, force_terminated=True)
+            if jr is not None:
+                jr.emit("termdet_rewind", pool=tpid,
+                        was=(was.name if was is not None else None),
+                        epoch=tp.run_epoch)
             if was is None:
                 tp.state = TaskpoolState.DONE
                 with self._lock:
@@ -1433,8 +1489,14 @@ class RecoveryCoordinator:
             n = max(int(tp.nb_tasks), 0)
             if ready:
                 scheduling.schedule(ctx.streams[0], ready)
+        jr2 = ctx.journal
+        rnd = self._mode_round(tpid)
         if rplan is not None:
             self.minimal_replays += 1
+            if jr2 is not None:
+                jr2.emit("replay_mode", pool=tpid, mode="minimal",
+                         round=rnd, tasks=n, synth=len(synth),
+                         rewinds=len(base_restores))
             debug_verbose(1, "rank %d: pool %d MINIMAL replay: %d "
                           "task(s), %d synthesized edge(s), %d "
                           "rewound tile(s)", ctx.rank, tpid, n,
@@ -1442,6 +1504,10 @@ class RecoveryCoordinator:
         elif skip is not None:
             self.minimal_replays += 1
             self.skip_agreements += 1
+            if jr2 is not None:
+                jr2.emit("replay_mode", pool=tpid, mode="skip",
+                         round=rnd, prefix=skip["prefix"],
+                         seeds=len(skip["seeds"]), tasks=n)
             debug_verbose(1, "rank %d: pool %d DTD MINIMAL replay: "
                           "skipped the agreed insert prefix %d (%d "
                           "held cut payload(s)), %d task(s) re-run",
@@ -1449,6 +1515,10 @@ class RecoveryCoordinator:
                           len(skip["seeds"]), n)
         else:
             self.full_replays += 1
+            if jr2 is not None:
+                jr2.emit("replay_mode", pool=tpid, mode="full",
+                         round=rnd, reason=fallback_reason or "unknown",
+                         tasks=n)
             # every full-replay fallback is DIAGNOSABLE from the
             # flight-recorder bundle (reason string: evicted ring /
             # nacked need / skip-vote full / unsupported pool / ...),
@@ -1522,6 +1592,9 @@ class RecoveryCoordinator:
                          | set(ce.dead_peers)) - {me}
             with self._ctl_cond:
                 self._agree_confirmed.update(confirmed)
+            jr = self.context.journal
+            if jr is not None:
+                jr.emit("deadset_bcast", peers=confirmed)
             for r in sorted(set(range(ce.nranks)) - confirmed - {me}):
                 try:
                     ce.send_am(TAG_RECOVER, r,
@@ -1530,6 +1603,9 @@ class RecoveryCoordinator:
                 except OSError:
                     pass   # its death will get its own event
             return confirmed
+        jr = self.context.journal
+        if jr is not None:
+            jr.emit("deadset_report", peers=observed, coord=coord)
         try:
             ce.send_am(TAG_RECOVER, coord,
                        {"k": "dead", "ranks": sorted(observed)})
@@ -1544,6 +1620,12 @@ class RecoveryCoordinator:
                             "waiting for coordinator %d; proceeding "
                             "with the local view %s", me, coord,
                             sorted(observed))
+                    if jr is not None:
+                        # the bounded degradation, now on the record:
+                        # the coordinator died mid-round and this
+                        # survivor proceeds on its local view
+                        jr.emit("deadset_timeout", peers=observed,
+                                coord=coord)
                     return set(observed)
                 self._ctl_cond.wait(left)
             return set(observed) | set(self._agree_confirmed)
@@ -1569,14 +1651,19 @@ class RecoveryCoordinator:
         """Recovery control lane (comm thread: store, signal, reply —
         the heavy work stays on the recovery thread)."""
         k = msg.get("k")
+        jr = self.context.journal
         if k == "dead":
             ranks = {int(r) for r in msg.get("ranks", ())}
+            if jr is not None:
+                jr.emit("deadset_recv", peers=ranks, src=src, kind=k)
             with self._ctl_cond:
                 self._agree_reports.setdefault(src, set()).update(ranks)
                 self._ctl_cond.notify_all()
             self._declare_reported(ranks, src)
         elif k == "deadset":
             ranks = {int(r) for r in msg.get("ranks", ())}
+            if jr is not None:
+                jr.emit("deadset_recv", peers=ranks, src=src, kind=k)
             with self._ctl_cond:
                 self._agree_confirmed.update(ranks)
                 self._ctl_cond.notify_all()
@@ -1591,12 +1678,21 @@ class RecoveryCoordinator:
         elif k == "skipf":
             # DTD skip agreement: a survivor's frontier/landed report
             # (or its full vote) — store for the coordinator's round
+            if jr is not None:
+                jr.emit("skip_offer", pool=msg.get("tp"),
+                        round=int(msg.get("round", 0)),
+                        frontier=int(msg.get("frontier", -1)),
+                        src=src, full=msg.get("full"))
             with self._ctl_cond:
                 self._skip_reports[(msg.get("tp"), src)] = \
                     (int(msg.get("round", 0)), msg)
                 self._ctl_cond.notify_all()
         elif k == "skipset":
             # the coordinator's agreed-prefix broadcast
+            if jr is not None:
+                jr.emit("skip_cut", pool=msg.get("tp"),
+                        round=int(msg.get("round", 0)),
+                        prefix=int(msg.get("prefix", 0)), src=src)
             with self._ctl_cond:
                 self._skip_set[msg.get("tp")] = \
                     (int(msg.get("round", 0)), msg)
@@ -1610,6 +1706,10 @@ class RecoveryCoordinator:
         elif k == "mode":
             tpid = msg.get("tp")
             rnd = int(msg.get("round", 0))
+            if jr is not None:
+                jr.emit("mode_vote", pool=tpid, round=rnd,
+                        mode="minimal" if msg.get("minimal") else "full",
+                        src=src)
             reply = None
             with self._ctl_cond:
                 self._peer_modes[(tpid, src)] = \
@@ -1641,6 +1741,10 @@ class RecoveryCoordinator:
         replay fallback."""
         tpid = msg.get("tp")
         tp = self.context.taskpools.get(tpid)
+        jr = self.context.journal
+        if jr is not None:
+            jr.emit("need_req", pool=tpid, src=src,
+                    n=len(msg.get("needs", ())))
         ok = False
         if tp is not None and self.recovering(tp):
             seeds: List[Any] = []
@@ -1672,6 +1776,11 @@ class RecoveryCoordinator:
                         ok = frozen is not None \
                             and all(s in frozen for s in seeds)
         rde = self._rde
+        if jr is not None:
+            # the answered-or-degraded invariant's responder half:
+            # every need_req this rank observed gets its need_ack on
+            # the record (a missing pair is an unanswered negotiation)
+            jr.emit("need_ack", pool=tpid, dst=src, ok=ok)
         if rde is not None:
             from parsec_tpu.comm.engine import TAG_RECOVER
             try:
@@ -1711,7 +1820,8 @@ class RecoveryCoordinator:
             -> bool:
         """Ask each producing survivor to include our needed producers
         in ITS replay set; True only when every peer acked within the
-        agreement timeout."""
+        agreement timeout.  (The journal's ``need_send`` record is the
+        caller's — _plan_minimal knows the negotiation round.)"""
         rde = self._rde
         if rde is None:
             return False
@@ -1763,8 +1873,16 @@ class RecoveryCoordinator:
         mode = "minimal" if minimal else "full"
         with self._ctl_cond:
             self._my_mode[tpid] = (rnd, mode)
+        peers = rde._live_peers()
+        jr = self.context.journal
+        if jr is not None:
+            # membership = this voter's view of the round's live gang
+            # (the auditor's votes-agree-on-membership invariant reads
+            # exactly this field across ranks)
+            jr.emit("mode_decl", pool=tpid, round=rnd, mode=mode,
+                    peers=set(peers) | {self.context.rank})
         from parsec_tpu.comm.engine import TAG_RECOVER
-        for r in rde._live_peers():
+        for r in peers:
             try:
                 rde.ce.send_am(TAG_RECOVER, r,
                                {"k": "mode", "tp": tpid, "round": rnd,
@@ -1787,18 +1905,26 @@ class RecoveryCoordinator:
             return True
         rnd = self._mode_round(tpid)
         deadline = time.monotonic() + self.agree_timeout
+
+        def _result(agreed: bool) -> bool:
+            jr = self.context.journal
+            if jr is not None:
+                jr.emit("mode_result", pool=tpid, round=rnd,
+                        mode="minimal" if agreed else "full")
+            return agreed
+
         with self._ctl_cond:
             while True:
                 modes = [self._peer_modes.get((tpid, r)) for r in peers]
                 modes = [m[1] if m is not None and m[0] == rnd else None
                          for m in modes]
                 if any(m == "full" for m in modes):
-                    return False
+                    return _result(False)
                 if all(m == "minimal" for m in modes):
-                    return True
+                    return _result(True)
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    return False
+                    return _result(False)
                 self._ctl_cond.wait(left)
 
     # -- DTD insert-stream skip agreement ---------------------------------
@@ -1821,6 +1947,14 @@ class RecoveryCoordinator:
         peers = rde._live_peers() if rde is not None else []
         rnd = self._mode_round(tpid)
         me = self.context.rank
+        jr = self.context.journal
+        if jr is not None:
+            # this rank's OWN offered cut — the auditor checks every
+            # agreed prefix against every offer in the round
+            jr.emit("skip_offer", pool=tpid, round=rnd,
+                    frontier=(-1 if full_why is not None
+                              else int(rep["frontier"])),
+                    full=full_why)
         if not peers or ce is None:
             # sole survivor: the agreement short-circuits locally
             if full_why is not None:
@@ -1828,6 +1962,8 @@ class RecoveryCoordinator:
             k, holders, vcuts = dtd_skip_prefix(
                 {me: rep["frontier"]}, {me: rep["landed"]},
                 rep["writes"])
+            if jr is not None:
+                jr.emit("skip_cut", pool=tpid, round=rnd, prefix=int(k))
             if k <= 0:
                 raise RecoveryUnsupported(
                     "dtd skip: no skippable prefix in the local view")
@@ -1876,6 +2012,9 @@ class RecoveryCoordinator:
                                    "the survivors' materializable cuts")
             out = {"k": "skipset", "tp": tpid, "round": rnd,
                    "prefix": k, "holders": holders, "vcut": vcuts}
+            if jr is not None:
+                jr.emit("skip_cut", pool=tpid, round=rnd, prefix=int(k),
+                        why=why)
             for r in peers:
                 try:
                     ce.send_am(TAG_RECOVER, r, dict(out))
@@ -1940,17 +2079,37 @@ class RecoveryCoordinator:
         rounds = self.need_rounds_cap
         used = 0
         counts = self.need_round_counts
+
+        def _round_outcome(outcome: str, rnd: int, peers) -> None:
+            """Count AND journal one negotiation round's terminal
+            outcome — a silent round is exactly the bug class the
+            auditor's answered-or-degraded invariant encodes."""
+            counts[outcome] += 1
+            jr = self.context.journal
+            if jr is not None:
+                jr.emit("need_round", pool=tpid, round=rnd,
+                        outcome=outcome, peers=peers)
+
+        def _round_send(rnd: int, needs) -> None:
+            jr = self.context.journal
+            if jr is not None:
+                jr.emit("need_send", pool=tpid, round=rnd,
+                        peers={r for r, _k, _f in needs},
+                        n=len(needs))
+
         with self._ctl_cond:
             extra = set(self._extra_seeds.get(tpid, ()))
         plan = self._compute_minimal(tp, spec, dead_set, extra)
         first_needs = {(r, k, f) for r, k, f in plan.needs}
         if plan.needs:
             used = 1
+            need_peers = {r for r, _k, _f in plan.needs}
+            _round_send(1, plan.needs)
             if not self._negotiate_needs(tp, plan.needs):
-                counts["nacked"] += 1
+                _round_outcome("nacked", 1, need_peers)
                 raise RecoveryUnsupported(
                     "a peer nacked (or never acked) a re-feed need")
-            counts["acked"] += 1
+            _round_outcome("acked", 1, need_peers)
         if self._rde is not None and self._rde._live_peers():
             # one window for LATE cross-survivor needs to land before
             # the plan freezes (peers restarting the same pool send
@@ -1974,19 +2133,21 @@ class RecoveryCoordinator:
                 # against the peers' frozen plans (they ack iff the
                 # producers are already committed) instead of the r12
                 # unconditional fallback
+                wide_peers = {r for r, _k, _f in widened}
                 if used >= rounds:
-                    counts["exhausted"] += 1
+                    _round_outcome("exhausted", used + 1, wide_peers)
                     raise RecoveryUnsupported(
                         "merged re-feed seeds widened the remote needs "
                         f"past recovery_need_rounds={rounds}")
                 used += 1
-                counts["widened"] += 1
+                _round_outcome("widened", used, wide_peers)
+                _round_send(used, sorted(widened))
                 if not self._negotiate_needs(tp, sorted(widened)):
-                    counts["nacked"] += 1
+                    _round_outcome("nacked", used, wide_peers)
                     raise RecoveryUnsupported(
                         "a peer nacked a widened re-feed need "
                         "(second negotiation round)")
-                counts["acked"] += 1
+                _round_outcome("acked", used, wide_peers)
         return plan
 
     def _compute_minimal(self, tp, spec, dead_set: set,
@@ -2252,11 +2413,18 @@ class RecoveryCoordinator:
             return None
         epoch = int(msg.get("epoch", 0))
         fence = rde.peer_fence(src)
+        jr = self.context.journal
         if epoch < fence:
+            if jr is not None:
+                jr.emit("rejoin_req", src=src, epoch=epoch, ok=False,
+                        fence=fence)
             warning("rank %d: rejected rejoin of rank %d with stale "
                     "epoch %d (fence %d)", self.context.rank, src,
                     epoch, fence)
             return None
+        if jr is not None:
+            jr.emit("rejoin_req", src=src, epoch=epoch, ok=True,
+                    fence=fence)
         rde.note_peer_epoch(src, epoch)
         rde.ce.peer_rejoined(src, epoch)
         with self._ctl_cond:
@@ -2332,6 +2500,11 @@ class RecoveryCoordinator:
                 "unreachable)")
         table = {int(k): int(v)
                  for k, v in (ack.get("translation") or {}).items()}
+        jr = self.context.journal
+        if jr is not None:
+            jr.emit("rejoin_done", epoch=ce.epoch,
+                    acked_by=int(ack.get("rank", -1)),
+                    bar_gen=int(ack.get("bar_gen", 0)))
         with self._lock:
             self._dead_map.update(table)
         # generation-numbered state transfer: the fresh engine's barrier
@@ -2353,6 +2526,7 @@ class RecoveryCoordinator:
                 "full_replays": self.full_replays,
                 "skip_agreements": self.skip_agreements,
                 "retirements": self.retirements,
+                "retire_degraded": self.retire_degraded,
                 "need_rounds": dict(self.need_round_counts),
                 "dead_map": dict(self._dead_map),
                 "active_pools": sorted(self._active),
@@ -2379,6 +2553,9 @@ class RecoveryCoordinator:
                                   self.skip_agreements))
         out.append(counter_sample(
             "parsec_recovery_pool_retirements_total", self.retirements))
+        out.append(counter_sample(
+            "parsec_recovery_retire_degraded_total",
+            self.retire_degraded))
         out.extend(counter_sample("parsec_recovery_need_rounds_total",
                                   v, {"outcome": outcome})
                    for outcome, v in self.need_round_counts.items())

@@ -274,6 +274,18 @@ class FlightRecorder(CausalTracer):
         ctx = self.context
         if ctx is not None:
             self.finalize(ctx)     # rank + nranks + clock offsets header
+            jr = getattr(ctx, "journal", None)
+            if jr is not None:
+                # the control-plane story lands NEXT TO the data-plane
+                # ring: every incident bundle carries this rank's
+                # protocol journal (journal-rank<N>.jsonl), so
+                # tools/journal_audit.py reconstructs the recovery
+                # rounds behind the incident from the same directory
+                try:
+                    jr.dump(self.bundle_dir)
+                except OSError as exc:
+                    warning("flight recorder: journal dump failed: %s",
+                            exc)
         self.profile.add_information("flightrec_reason", reason)
         out = os.path.join(self.bundle_dir, f"rank{self.rank}.ptt")
         self.profile.dump(out)

@@ -24,6 +24,9 @@ Requests are one JSON object; every request gets one JSON reply with an
     {"op": "jobs"} / {"op": "stats"} / {"op": "gauges"} / {"op": "apps"}
     {"op": "metrics"}  -> {"ok": true, "text": <Prometheus exposition>,
                            "ranks": [...]}   (cross-rank via TAG_METRICS)
+    {"op": "journal"}  -> {"ok": true, "ranks": {rank: journal snapshot}}
+                      (the control-plane black box, cross-rank — audit
+                       with tools/journal_audit.py)
 
 The same port also answers plain HTTP ``GET /metrics`` (Prometheus
 text) and ``GET /status`` (the live job-status JSON) — the first four
@@ -393,6 +396,19 @@ class JobServer:
                 aggregate=bool(req.get("aggregate", True)),
                 timeout=float(req.get("timeout", 2.0)))
             return {"ok": True, "text": text, "ranks": ranks}
+        if op == "journal":
+            # the control-plane black box: every rank's protocol
+            # journal (recovery rounds, retirement handshakes, rejoin
+            # fencing, barrier generations, job lifecycle), pulled
+            # cross-rank over the TAG_METRICS control lane — feed the
+            # result to tools/journal_audit.py --timeline / --audit
+            from parsec_tpu.prof.journal import cluster_journals
+            per_rank = cluster_journals(
+                self.service.context,
+                timeout=float(req.get("timeout", 2.0)))
+            return {"ok": True,
+                    "ranks": {str(r): snap
+                              for r, snap in per_rank.items()}}
         if op == "apps":
             return {"ok": True, "apps": sorted(APPS)}
         raise ValueError(f"unknown op {op!r}")

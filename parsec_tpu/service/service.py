@@ -171,6 +171,13 @@ class JobService:
             self._jobs[job.job_id] = job
             self._work.notify_all()
         self._emit("job_submit", job)
+        jr = getattr(self.context, "journal", None)
+        if jr is not None:
+            # admission decisions are control-plane history: the black
+            # box records every admit/dispatch/terminal transition next
+            # to the recovery rounds that may explain their latency
+            jr.emit("job_admit", job=job.job_id, priority=priority,
+                    name=job.name)
         debug_verbose(3, "service: admitted %s prio=%d", job.name, priority)
         return job
 
@@ -264,6 +271,10 @@ class JobService:
             job.started_at = time.time()
             tp.on_complete(lambda _tp, job=job: self._finish(job))
             self._emit("job_start", job)
+            jr = getattr(self.context, "journal", None)
+            if jr is not None:
+                jr.emit("job_start", job=job.job_id,
+                        pool=tp.taskpool_id)
             self.context.add_taskpool(tp, start=True)
             if tp.cancelled and not tp.completed:
                 # cancel()/deadline fired between _to(RUNNING) and the
@@ -349,6 +360,11 @@ class JobService:
             job.failed_rank = exc.rank
             with self._lock:
                 self._degraded_ranks.add(exc.rank)
+            jr = getattr(self.context, "journal", None)
+            if jr is not None:
+                jr.emit("service_state", peer=exc.rank,
+                        state="degraded", cause="containment",
+                        job=job.job_id)
         took = job._to(JobStatus.FAILED)
         debug_verbose(2, "service: %s failed on %s: %s", job.name, task,
                       exc)
@@ -364,6 +380,10 @@ class JobService:
                     self._pending.remove(job)
                     self._space.notify_all()
                 took = job._to(JobStatus.CANCELLED)
+                if took:
+                    jr = getattr(self.context, "journal", None)
+                    if jr is not None:
+                        jr.emit("job_cancel", job=job.job_id)
                 # a PENDING job not in the queue is in the dispatcher's
                 # hands (factory running): _launch's failed RUNNING
                 # transition owns the job_done emission there, so only
@@ -375,6 +395,10 @@ class JobService:
                 return False
             took = job._to(JobStatus.CANCELLED)
             tp = job.taskpool
+        if took:
+            jr = getattr(self.context, "journal", None)
+            if jr is not None:
+                jr.emit("job_cancel", job=job.job_id)
         if took and tp is not None:
             tp.cancel()             # termination fires _finish
         return took
@@ -413,14 +437,21 @@ class JobService:
                 self._degraded_ranks.add(rank)
                 self._recovering_ranks.add(rank)
                 jobs = []
+                state = "recovering"
             elif event in ("done", "rejoin"):
                 self._recovering_ranks.discard(rank)
                 self._degraded_ranks.discard(rank)
                 jobs = [j for j in self._jobs.values()
                         if j.failed_rank == rank and not j.done]
+                state = "healthy"
             else:   # failed: recovery gave up; the degradation stands
                 self._recovering_ranks.discard(rank)
                 jobs = []
+                state = "degraded"
+        jr = getattr(self.context, "journal", None)
+        if jr is not None:
+            jr.emit("service_state", peer=rank, state=state,
+                    cause=event)
         for job in jobs:
             job.failed_rank = None
 
@@ -515,6 +546,10 @@ class JobService:
             if job._done_emitted:
                 return
             job._done_emitted = True
+        jr = getattr(self.context, "journal", None)
+        if jr is not None:
+            jr.emit("job_done", job=job.job_id,
+                    status=job.status().name.lower())
         self._emit("job_done", job)
 
     def _emit(self, event: str, job: JobHandle) -> None:

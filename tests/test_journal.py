@@ -1,0 +1,562 @@
+"""Control-plane black box (ISSUE 15): journal ring + emit schema,
+clock-aligned merge, the offline invariant auditor over hand-built
+violation corpora, the job-port pull, flight-recorder bundle
+inclusion, the autopsy tail, the retirement grace-window degradation
+counter, and (slow) the full recover catalog under ``chaos
+--audit-journal`` with a reconstructable 3-rank skip-agreement round.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from parsec_tpu.prof.journal import (EVENT_SCHEMA, Journal,  # noqa: E402
+                                     format_event, merge_journals)
+from parsec_tpu.utils.mca import params  # noqa: E402
+from tools import journal_audit  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# ring + emit discipline
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_and_stamps():
+    j = Journal(rank=3, cap=128)
+    for i in range(300):
+        j.emit("retired", pool=i)
+    assert len(j) == 128
+    evs = j.tail(128)
+    # oldest overwritten, stamps monotone
+    assert evs[0]["pool"] == 300 - 128
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    assert all(e["inc"] == 0 and "t" in e for e in evs)
+
+
+def test_disabled_journal_is_a_noop():
+    params.set("journal_enabled", 0)
+    try:
+        j = Journal(rank=0)
+        j.emit("retired", pool=1)
+        assert len(j) == 0 and j.tail() == []
+    finally:
+        params.unset("journal_enabled")
+
+
+def test_emit_normalizes_sets_for_the_wire():
+    j = Journal(rank=0)
+    j.emit("mode_decl", pool=1, round=2, mode="minimal",
+           peers={2, 0, 1}, extra=frozenset({"b", "a"}))
+    ev = j.tail(1)[0]
+    assert ev["peers"] == [0, 1, 2]
+    assert ev["extra"] == ["a", "b"]
+    json.dumps(j.snapshot())   # must serialize as-is
+
+
+def test_schema_table_well_formed():
+    for etype, fields in EVENT_SCHEMA.items():
+        assert isinstance(etype, str) and etype
+        assert isinstance(fields, tuple)
+        assert all(isinstance(f, str) for f in fields)
+    # the round-scoped protocol families all demand round attribution
+    for etype in ("mode_decl", "mode_vote", "mode_result", "skip_offer",
+                  "skip_cut", "need_send", "need_round"):
+        assert "round" in EVENT_SCHEMA[etype], etype
+
+
+def test_dump_appends_and_loads_roundtrip(tmp_path):
+    j = Journal(rank=2, cap=64)
+    j.emit("epoch_fence", pool=1, epoch=1)
+    path = j.dump(str(tmp_path))
+    j.emit("retired", pool=1)
+    assert j.dump(str(tmp_path)) == path
+    snaps = journal_audit.load_file(path)
+    assert len(snaps) == 2            # one header per dump, appended
+    assert len(snaps[0]["events"]) == 1
+    assert len(snaps[1]["events"]) == 2   # ring re-dumped whole
+    per_rank = journal_audit.load_bundle([str(tmp_path)])
+    assert sorted(per_rank) == [2]
+
+
+# ---------------------------------------------------------------------------
+# clock-aligned merge
+# ---------------------------------------------------------------------------
+
+def _snap(rank, events, clock=None, inc=0):
+    return {"rank": rank, "inc": inc, "nranks": 2, "wall": 0.0,
+            "perf": 0.0, "clock": clock or {}, "events": events}
+
+
+def test_merge_aligns_on_reference_clock():
+    """Rank 1's clock runs 100 s ahead; its own measured offset to
+    rank 0 (clock_0 - clock_1 = -100) must pull its events back onto
+    rank 0's timeline so causality reads correctly."""
+    e0 = [{"e": "skip_cut", "t": 5.0, "seq": 1, "inc": 0, "pool": 1,
+           "round": 1, "prefix": 3}]
+    e1 = [{"e": "skip_offer", "t": 104.0, "seq": 1, "inc": 0,
+           "pool": 1, "round": 1, "frontier": 4}]
+    merged = merge_journals({
+        0: _snap(0, e0),
+        1: _snap(1, e1, clock={0: {"offset": -100.0, "rtt": 0.001}})})
+    assert [m["e"] for m in merged] == ["skip_offer", "skip_cut"]
+    assert abs(merged[0]["t"] - 4.0) < 1e-9
+    assert merged[0]["rank"] == 1
+    line = format_event(merged[0], t0=merged[0]["t"])
+    assert "skip_offer" in line and "rank 1" in line
+
+
+def test_merge_falls_back_to_reference_measurement():
+    """No own-table entry: the reference's measurement of the peer is
+    negated (offset = clock_peer - clock_ref)."""
+    e1 = [{"e": "retired", "t": 107.0, "seq": 1, "inc": 0, "pool": 9}]
+    merged = merge_journals({
+        0: _snap(0, [], clock={1: {"offset": 100.0, "rtt": 0.001}}),
+        1: _snap(1, e1)})
+    assert abs(merged[0]["t"] - 7.0) < 1e-9
+    # JSON round-trip stringifies clock keys; alignment must survive
+    rt = json.loads(json.dumps(
+        {0: _snap(0, [], clock={1: {"offset": 100.0}}), 1: _snap(1, e1)}))
+    merged2 = merge_journals({int(r): s for r, s in rt.items()})
+    assert abs(merged2[0]["t"] - 7.0) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# the invariant auditor: clean reference + one corpus per invariant
+# ---------------------------------------------------------------------------
+
+def _bundle(*rank_events, incs=None):
+    """rank_events[i] = events of rank i (t/seq/inc auto-filled)."""
+    per_rank = {}
+    for rank, evs in enumerate(rank_events):
+        out = []
+        for i, ev in enumerate(evs):
+            e = {"t": float(i), "seq": i + 1,
+                 "inc": (incs or {}).get(rank, 0)}
+            e.update(ev)
+            out.append(e)
+        per_rank[rank] = [_snap(rank, out)]
+    return per_rank
+
+
+def _clean_round():
+    """A consistent 2-survivor skip round: same membership, cut under
+    every offer, one retirement each, negotiation answered."""
+    r0 = [
+        {"e": "mode_decl", "pool": 1, "round": 1, "mode": "minimal",
+         "peers": [0, 2]},
+        {"e": "skip_offer", "pool": 1, "round": 1, "frontier": 18},
+        {"e": "skip_offer", "pool": 1, "round": 1, "frontier": 40,
+         "src": 2},
+        {"e": "skip_cut", "pool": 1, "round": 1, "prefix": 17},
+        {"e": "epoch_fence", "pool": 1, "epoch": 1},
+        {"e": "need_req", "pool": 1, "src": 2, "n": 1},
+        {"e": "need_ack", "pool": 1, "dst": 2, "ok": True},
+        {"e": "retired", "pool": 1},
+    ]
+    r2 = [
+        {"e": "mode_decl", "pool": 1, "round": 1, "mode": "minimal",
+         "peers": [0, 2]},
+        {"e": "skip_offer", "pool": 1, "round": 1, "frontier": 40},
+        {"e": "skip_cut", "pool": 1, "round": 1, "prefix": 17,
+         "src": 0},
+        {"e": "need_send", "pool": 1, "round": 1, "peers": [0]},
+        {"e": "need_round", "pool": 1, "round": 1, "outcome": "acked",
+         "peers": [0]},
+        {"e": "epoch_fence", "pool": 1, "epoch": 1},
+        {"e": "retired", "pool": 1},
+    ]
+    return _bundle(r0, [], r2)
+
+
+def test_audit_clean_reference_round():
+    assert journal_audit.audit(_clean_round()) == []
+
+
+def test_audit_flags_membership_disagreement():
+    b = _clean_round()
+    b[2][0]["events"][0]["peers"] = [0, 1, 2]   # divergent gang view
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("I1") for v in vs), vs
+
+
+def test_audit_flags_cut_above_offer():
+    b = _clean_round()
+    # rank 0's own offer drops below the agreed prefix
+    b[0][0]["events"][1]["frontier"] = 10
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("I2") and "exceeds" in v for v in vs), vs
+
+
+def test_audit_flags_cut_despite_full_vote():
+    b = _clean_round()
+    b[2][0]["events"][1]["frontier"] = -1
+    b[2][0]["events"][1]["full"] = "region-lane pool"
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("I2") and "full" in v for v in vs), vs
+
+
+def test_audit_flags_incarnation_regression():
+    b = _clean_round()
+    b[0][0]["events"][3]["inc"] = 1
+    b[0][0]["events"][4]["inc"] = 0    # regressed mid-file
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("I3") and "incarnation" in v for v in vs), vs
+
+
+def test_audit_flags_nonmonotone_epoch_fence():
+    b = _clean_round()
+    b[0][0]["events"].append({"e": "epoch_fence", "pool": 1, "epoch": 1,
+                              "t": 9.0, "seq": 99, "inc": 0})
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("I3") and "run_epoch" in v for v in vs), vs
+
+
+def test_audit_flags_double_retirement_outcome():
+    b = _clean_round()
+    b[0][0]["events"].append({"e": "retire_degraded", "pool": 1,
+                              "t": 9.0, "seq": 99, "inc": 0})
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("I4") for v in vs), vs
+
+
+def test_audit_flags_unanswered_need():
+    b = _clean_round()
+    b[0][0]["events"].pop(6)           # the need_ack vanishes
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("I5") and "unanswered" in v for v in vs), vs
+
+
+def test_audit_flags_silent_need_round():
+    b = _clean_round()
+    b[2][0]["events"].pop(4)           # need_send with no outcome
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("I5") and "no terminal outcome" in v
+               for v in vs), vs
+
+
+def test_audit_recycled_pool_id_across_incarnations_is_clean():
+    """Pool ids are a per-process counter: a restarted incarnation
+    legitimately reuses its predecessor's ids.  A rank that retired
+    pool 1, restarted (higher inc), and retired a NEW pool 1 must not
+    flag I3/I4 — the incarnation stamp disambiguates."""
+    first = [{"e": "epoch_fence", "pool": 1, "epoch": 1, "t": 1.0,
+              "seq": 1, "inc": 0},
+             {"e": "retired", "pool": 1, "t": 2.0, "seq": 2, "inc": 0}]
+    second = [{"e": "epoch_fence", "pool": 1, "epoch": 1, "t": 10.0,
+               "seq": 1, "inc": 1},
+              {"e": "need_req", "pool": 1, "src": 1, "t": 10.5,
+               "seq": 2, "inc": 1},
+              {"e": "need_ack", "pool": 1, "dst": 1, "ok": True,
+               "t": 10.6, "seq": 3, "inc": 1},
+              {"e": "retired", "pool": 1, "t": 11.0, "seq": 4,
+               "inc": 1}]
+    per_rank = {0: [_snap(0, first, inc=0), _snap(0, second, inc=1)]}
+    assert journal_audit.audit(per_rank) == []
+    # the true violations still flag WITHIN one incarnation
+    per_rank[0][1]["events"].append(
+        {"e": "retired", "pool": 1, "t": 12.0, "seq": 5, "inc": 1})
+    vs = journal_audit.audit(per_rank)
+    assert any(v.startswith("I4") for v in vs), vs
+
+
+def test_skip_rounds_attribute_replay_to_its_own_round():
+    """A pool whose round 1 fell back to full and whose round 2
+    agreed a cut must not report ghost replays in round 1."""
+    evs = [
+        {"e": "skip_offer", "pool": 1, "round": 1, "frontier": -1,
+         "full": "no prefix", "t": 1.0, "seq": 1, "inc": 0},
+        {"e": "skip_cut", "pool": 1, "round": 1, "prefix": 0,
+         "t": 1.1, "seq": 2, "inc": 0},
+        {"e": "skip_offer", "pool": 1, "round": 2, "frontier": 20,
+         "t": 5.0, "seq": 3, "inc": 0},
+        {"e": "skip_cut", "pool": 1, "round": 2, "prefix": 17,
+         "t": 5.1, "seq": 4, "inc": 0},
+        {"e": "replay_mode", "pool": 1, "mode": "skip", "round": 2,
+         "prefix": 17, "tasks": 9, "t": 5.2, "seq": 5, "inc": 0},
+        {"e": "retired", "pool": 1, "t": 6.0, "seq": 6, "inc": 0},
+    ]
+    rounds = {(r["pool"], r["round"]): r
+              for r in journal_audit.skip_rounds({0: [_snap(0, evs)]})}
+    assert rounds[(1, 1)]["replays"] == []
+    assert rounds[(1, 1)]["retired"] == []
+    assert len(rounds[(1, 2)]["replays"]) == 1
+    assert len(rounds[(1, 2)]["retired"]) == 1
+
+
+def test_disabled_journal_skips_fini_dump(tmp_path):
+    """A disabled journal must dump NOTHING at fini: a header-only
+    file would let chaos --audit-journal pass vacuously over zero
+    events."""
+    params.set("journal_enabled", 0)
+    params.set("journal_dir", str(tmp_path))
+    from parsec_tpu.core.context import Context
+    try:
+        with Context(nb_cores=1):
+            pass
+        assert os.listdir(str(tmp_path)) == []
+    finally:
+        params.unset("journal_enabled")
+        params.unset("journal_dir")
+
+
+def test_skip_round_reconstruction_and_timeline():
+    b = _clean_round()
+    rounds = journal_audit.skip_rounds(b)
+    assert len(rounds) == 1
+    r = rounds[0]
+    assert r["cut"]["prefix"] == 17
+    offers = {o["rank"]: o["frontier"] for o in r["offers"]}
+    assert offers == {0: 18, 2: 40}
+    assert len(r["retired"]) == 2
+    text = journal_audit.render_timeline(b)
+    assert "skip round pool=1" in text and "agreed cut 17" in text
+
+
+def test_chrome_export_instant_events(tmp_path):
+    out = str(tmp_path / "ctl.json")
+    n = journal_audit.write_chrome(_clean_round(), out)
+    doc = json.load(open(out))
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert len(evs) == n and n > 0
+    assert {e["pid"] for e in evs} == {0, 2}
+    assert all(e["ts"] >= 0 for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# runtime wiring: job port, flight recorder, autopsy, degradation
+# ---------------------------------------------------------------------------
+
+def _n_pool(n, name="jpool"):
+    from parsec_tpu.dsl.ptg.api import PTG, Range
+    p = PTG(name, N=n)
+    p.task("T", i=Range(0, n - 1)).body(lambda: None)
+    return p.build()
+
+
+def test_journal_op_on_job_server():
+    """The framed ``{"op": "journal"}`` pull returns this rank's
+    snapshot with the job lifecycle on the record."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.service.server import JobServer, request
+    from parsec_tpu.service.service import JobService
+    with Context(nb_cores=2) as ctx:
+        svc = JobService(context=ctx)
+        server = JobServer(svc, port=0)
+        try:
+            job = svc.submit(lambda: _n_pool(8), name="boxed")
+            assert job.wait(timeout=30)
+            reply = request(server.host, server.port, {"op": "journal"})
+        finally:
+            server.close()
+            svc.shutdown(timeout=10.0)
+        assert reply["ok"]
+        snap = reply["ranks"]["0"]
+        kinds = [e["e"] for e in snap["events"]]
+        assert "job_admit" in kinds and "job_start" in kinds \
+            and "job_done" in kinds
+        done = [e for e in snap["events"] if e["e"] == "job_done"]
+        assert done[0]["status"] == "done"
+        assert done[0]["job"] == job.job_id
+
+
+def test_flightrec_bundle_includes_journal(tmp_path):
+    """Every incident bundle carries the control-plane story next to
+    the data-plane ring."""
+    params.set("flightrec_enabled", 1)
+    params.set("flightrec_dir", str(tmp_path))
+    params.set("flightrec_min_interval_s", 0.0)
+    from parsec_tpu.core.context import Context
+    try:
+        with Context(nb_cores=2) as ctx:
+            ctx.journal.emit("epoch_fence", pool=7, epoch=1)
+            bundle = ctx.telemetry_incident("unit-test incident")
+            assert bundle == str(tmp_path)
+            jpath = os.path.join(bundle, "journal-rank0.jsonl")
+            # the dump runs on its own thread: poll until the CONTENT
+            # lands (existence alone races the in-progress append)
+            deadline = time.monotonic() + 10.0
+            found = False
+            while not found and time.monotonic() < deadline:
+                if os.path.exists(jpath):
+                    try:
+                        snaps = journal_audit.load_file(jpath)
+                        found = any(
+                            e["e"] == "epoch_fence" and e["pool"] == 7
+                            for s in snaps for e in s["events"])
+                    except (ValueError, OSError):
+                        pass   # torn mid-append read
+                if not found:
+                    time.sleep(0.05)
+            assert found
+    finally:
+        params.unset("flightrec_enabled")
+        params.unset("flightrec_dir")
+        params.unset("flightrec_min_interval_s")
+
+
+def test_autopsy_prints_clock_aligned_journal_tail():
+    from parsec_tpu.core.context import Context
+    with Context(nb_cores=2) as ctx:
+        ctx.journal.emit("retired", pool=3)
+        text = ctx.hang_autopsy()
+    assert "control-plane journal tail" in text
+    assert "retired" in text and "pool=3" in text
+
+
+def test_retire_degraded_counted_and_journaled():
+    """The PR 14 residual made observable: a completed pool whose
+    retirement handshake never concluded (coordinator unreachable)
+    falls back to the grace-window eviction — now counted in
+    parsec_recovery_retire_degraded_total and journaled."""
+    params.set("recovery_enable", 1)
+    params.set("recovery_completed_grace_s", 0.05)
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    try:
+        with Context(nb_cores=2) as ctx:
+            rec = ctx.recovery
+            assert rec is not None
+
+            class _StubCE:
+                nranks = 2
+                rank = 0
+                dead_peers = ()
+
+                def send_am(self, *a, **k):
+                    raise OSError("coordinator unreachable")
+
+            class _StubRDE:
+                ce = _StubCE()
+
+                def recovery_coordinator(self):
+                    return 1   # someone else — and unreachable
+
+            rec._rde = _StubRDE()
+            V = VectorTwoDimCyclic(mb=4, lm=16, nodes=1, myrank=0)
+            for m, _ in V.local_tiles():
+                V.data_of(m).copy_on(0).payload[:] = 0.0
+            tp = _n_pool(4, name="degrader")
+            tp.recovery_collections = [V]
+            ctx.add_taskpool(tp, start=True)
+            ctx.wait(timeout=30)
+            time.sleep(0.1)        # past the shrunk grace window
+            with rec._lock:
+                rec._sweep_locked()
+            assert rec.retire_degraded == 1
+            assert rec.stats()["retire_degraded"] == 1
+            kinds = [e["e"] for e in ctx.journal.tail(50)]
+            assert "retire_report" in kinds
+            assert "retire_degraded" in kinds
+            fams = {s["n"]: s["v"] for s in rec._collect()
+                    if s["t"] == "counter" and not s["l"]}
+            assert fams["parsec_recovery_retire_degraded_total"] == 1
+    finally:
+        params.unset("recovery_enable")
+        params.unset("recovery_completed_grace_s")
+
+
+# ---------------------------------------------------------------------------
+# cross-rank: the TAG_METRICS-lane journal pull
+# ---------------------------------------------------------------------------
+
+def _pull_worker(ctx, rank, nranks):
+    from parsec_tpu.prof.journal import cluster_journals
+    ctx.add_taskpool(_n_pool(6, name=f"wire{rank}"))
+    ctx.wait(timeout=60)
+    ctx.comm.ce.barrier(timeout=30)   # journaled on both ranks
+    if rank != 0:
+        # park long enough for rank 0's pull to find us alive
+        time.sleep(3.0)
+        return {"events": len(ctx.journal)}
+    per_rank = cluster_journals(ctx, timeout=5.0)
+    merged = merge_journals({r: s for r, s in per_rank.items()})
+    return {"ranks": sorted(per_rank),
+            "peer_kinds": sorted({e["e"] for e in merged
+                                  if e["rank"] == 1})}
+
+
+def test_two_rank_journal_pull_over_control_lane():
+    from parsec_tpu.comm.launch import run_distributed
+    res = run_distributed(_pull_worker, 2, timeout=180)
+    assert res[0]["ranks"] == [0, 1]
+    # the peer's barrier generations crossed the wire
+    assert "barrier" in res[0]["peer_kinds"], res
+
+
+# ---------------------------------------------------------------------------
+# slow acceptance: the recover catalog under --audit-journal
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_recover_catalog_journal_audit_clean():
+    """ISSUE 15 acceptance: the FULL 12-case recover catalog with
+    journaling armed holds every auditor invariant (run_case fails a
+    case on any violation — or on a silently-disarmed journal)."""
+    from tools.chaos import _RECOVER, CATALOG, run_case
+    cases = [c for c in CATALOG if c[0] in _RECOVER]
+    assert len(cases) == 12
+    failures = []
+    for i, (name, plan_t, wl, expect, env) in enumerate(cases):
+        ok, outcome, detail = run_case(
+            name, plan_t.format(s=i + 1), wl, expect, env,
+            timeout=120.0, audit_journal=True)
+        if not ok:
+            failures.append((name, outcome, detail[:300]))
+    assert not failures, failures
+
+
+@pytest.mark.slow
+def test_skip_agreement_round_reconstructs_from_bundle(tmp_path):
+    """ISSUE 15 acceptance: the 3-rank kill-dtd-minimal bundle
+    reconstructs the skip-agreement round END TO END — votes (every
+    survivor's offered cut) -> agreed cut -> ghost replay ->
+    retirement — on one merged clock, with zero violations."""
+    jdir = str(tmp_path / "bundle")
+    plan = ("seed=11;kill_rank=1@t+2.0s,mode=close;"
+            "delay_dispatch=key~_dtd_chain_step,ms=100")
+    keys = {"PARSEC_MCA_FAULT_PLAN": plan,
+            "PARSEC_MCA_JOURNAL_DIR": jdir,
+            "PARSEC_CHAOS_WAIT_S": "45",
+            "PARSEC_MCA_RECOVERY_ENABLE": "1"}
+    saved = {k: os.environ.get(k) for k in keys}
+    os.environ.update(keys)
+    try:
+        from tools.chaos import WORKLOADS
+        from parsec_tpu.comm.launch import run_distributed
+        res = run_distributed(WORKLOADS["dtd-minimal"], 3,
+                              timeout=120, tolerate_ranks=[1])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert res[1] is None, "the kill never fired — nothing recovered"
+    per_rank = journal_audit.load_bundle([jdir])
+    assert journal_audit.audit(per_rank) == []
+    rounds = [r for r in journal_audit.skip_rounds(per_rank)
+              if r["cut"] is not None and r["cut"]["prefix"] > 0]
+    assert rounds, "no agreed skip cut on the record"
+    r = rounds[0]
+    # votes: BOTH survivors' offers are on the record, and the agreed
+    # cut honors each (the auditor's I2, re-checked explicitly here)
+    offer_ranks = {o["rank"] for o in r["offers"]}
+    assert {0, 2} <= offer_ranks
+    assert all(r["cut"]["prefix"] <= o["frontier"]
+               for o in r["offers"] if o.get("full") is None)
+    # ghost replay on every survivor, then the retirement handshake
+    assert {rep["rank"] for rep in r["replays"]} == {0, 2}
+    assert len(r["retired"]) >= 1
+    # the protocol ORDER holds on the merged clock
+    offers_t = max(o["t"] for o in r["offers"])
+    assert offers_t <= r["cut"]["t"]
+    assert r["cut"]["t"] <= min(rep["t"] for rep in r["replays"])
+    assert min(rep["t"] for rep in r["replays"]) \
+        <= min(x["t"] for x in r["retired"])

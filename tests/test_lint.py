@@ -19,8 +19,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from tools.parseclint import FileCtx, Finding  # noqa: E402
 from tools.parseclint.passes import (assert_hazard, device_put,  # noqa: E402
                                      evloop_blocking, except_hygiene,
-                                     hot_path, lock_discipline,
-                                     mca_knobs, prom_metrics)
+                                     hot_path, journal_schema,
+                                     lock_discipline, mca_knobs,
+                                     prom_metrics)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -701,6 +702,111 @@ def test_assert_inline_suppression():
             assert q.flush()   # lint: ignore[PCL-ASSERT] test helper
     """
     assert not assert_hazard.check(_ctx(src))
+
+
+# ---------------------------------------------------------------------------
+# PCL-JRNL: control-plane journal schema drift
+# ---------------------------------------------------------------------------
+
+_JRNL_SCHEMA = """
+    EVENT_SCHEMA = {
+        "mode_decl": ("pool", "round", "mode", "peers"),
+        "retired": ("pool",),
+    }
+"""
+
+_JRNL_SCHEMA_REL = "parsec_tpu/prof/journal.py"
+
+
+def _jrnl_run(sources):
+    """sources: {rel: code}; the schema module above is always in
+    scope (the pass's existence gate)."""
+    ctxs = {rel: _ctx(src, rel=rel) for rel, src in sources.items()}
+    facts = [journal_schema.facts(c) for c in ctxs.values()]
+    return journal_schema.tree_check(facts, REPO, ctxs)
+
+
+def test_jrnl_flags_unknown_event_type():
+    """The encoded bug class: an emit whose type never entered the
+    schema table is an event journal_audit cannot attribute."""
+    fs = _jrnl_run({
+        _JRNL_SCHEMA_REL: _JRNL_SCHEMA,
+        "parsec_tpu/core/snip.py":
+            'jr.emit("mode_declared", pool=1, round=2)\n'})
+    assert _ids(fs) == ["PCL-JRNL"]
+    assert "mode_declared" in fs[0].message
+
+
+def test_jrnl_flags_round_scoped_emit_without_round():
+    """Round-scoped protocol emits must carry round= — an emit the
+    auditor cannot place in a round is one it cannot check."""
+    fs = _jrnl_run({
+        _JRNL_SCHEMA_REL: _JRNL_SCHEMA,
+        "parsec_tpu/core/snip.py":
+            'jr.emit("mode_decl", pool=1, mode="full", peers=[0])\n'})
+    assert _ids(fs) == ["PCL-JRNL"]
+    assert "round" in fs[0].message
+
+
+def test_jrnl_flags_starstar_hiding_required_fields():
+    fs = _jrnl_run({
+        _JRNL_SCHEMA_REL: _JRNL_SCHEMA,
+        "parsec_tpu/core/snip.py":
+            'jr.emit("retired", **fields)\n'})
+    assert _ids(fs) == ["PCL-JRNL"]
+    assert "**kwargs" in fs[0].message
+
+
+def test_jrnl_flags_non_literal_type_and_attr_receiver():
+    """Computed event types flag; the attribute-chain receiver form
+    (self.context.journal.emit) is recognized too."""
+    fs = _jrnl_run({
+        _JRNL_SCHEMA_REL: _JRNL_SCHEMA,
+        "parsec_tpu/core/snip.py": """
+            self.context.journal.emit(etype, pool=1)
+        """})
+    assert _ids(fs) == ["PCL-JRNL"]
+    assert "non-literal" in fs[0].message
+
+
+def test_jrnl_accepts_schema_conformant_emits():
+    fs = _jrnl_run({
+        _JRNL_SCHEMA_REL: _JRNL_SCHEMA,
+        "parsec_tpu/core/snip.py": """
+            jr.emit("mode_decl", pool=1, round=2, mode="minimal",
+                    peers=[0, 1], extra="free-form is fine")
+            ctx.journal.emit("retired", pool=1)
+        """})
+    assert fs == []
+
+
+def test_jrnl_partial_scan_is_silent():
+    """Without the schema module in the scanned set the cross-check
+    stays off (the schema universe is incomplete)."""
+    fs = _jrnl_run({
+        "parsec_tpu/core/snip.py": 'jr.emit("bogus_event", pool=1)\n'})
+    assert fs == []
+
+
+def test_jrnl_inline_suppression():
+    fs = _jrnl_run({
+        _JRNL_SCHEMA_REL: _JRNL_SCHEMA,
+        "parsec_tpu/core/snip.py":
+            'jr.emit("oddball")  '
+            '# lint: ignore[PCL-JRNL] prototype event\n'})
+    assert fs == []
+
+
+def test_jrnl_real_schema_covers_every_tree_emit():
+    """Meta-gate on the REAL schema: every required-field tuple in
+    prof/journal.py is well-formed and the live EVENT_SCHEMA parses
+    out of the AST exactly as the runtime dict."""
+    import ast as _ast
+    from parsec_tpu.prof.journal import EVENT_SCHEMA
+    with open(os.path.join(REPO, _JRNL_SCHEMA_REL)) as fh:
+        tree = _ast.parse(fh.read())
+    parsed = journal_schema._schema_from_tree(tree)
+    assert parsed == {k: list(v) for k, v in EVENT_SCHEMA.items()}
 
 
 # ---------------------------------------------------------------------------

@@ -40,10 +40,12 @@ import sys
 #: clamps there), where relative change is undefined and any ratio or
 #: cap scheme turns noise into a discontinuity.  Their derived
 #: vs_baseline is skipped for the same reason — the value IS the gate.
-ABSOLUTE_DELTA = ("telemetry_overhead", "overhead_us")
+ABSOLUTE_DELTA = ("telemetry_overhead", "journal_overhead",
+                  "overhead_us")
 
 #: metrics where SMALLER is better (everything else: bigger is better)
 LOWER_IS_BETTER = ("task_rtt", "tracer_overhead", "telemetry_overhead",
+                   "journal_overhead",
                    "backward_error", "recovery_makespan_ratio",
                    "factorization_residual",
                    # bw/rtt protocol-mix guards (the r6 event-loop

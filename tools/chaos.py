@@ -821,16 +821,40 @@ _CHAOS_ENV = ("PARSEC_MCA_FAULT_PLAN", "PARSEC_CHAOS_WAIT_S",
               "PARSEC_MCA_COMM_RDV_RETRY_S",
               "PARSEC_MCA_COMM_TRANSPORT",
               "PARSEC_MCA_RECOVERY_ENABLE",
-              "PARSEC_MCA_RECOVERY_MAX_ATTEMPTS")
+              "PARSEC_MCA_RECOVERY_MAX_ATTEMPTS",
+              "PARSEC_MCA_JOURNAL_DIR")
 
 
-def run_case(name, plan, workload, expect, env, timeout):
+def _audit_journals(jdir: str):
+    """Run the offline invariant auditor (tools/journal_audit.py) over
+    one case's per-rank journal bundle.  Returns (violations, nevents)
+    — a missing bundle reads as zero events, and the caller treats
+    zero EVENTS (not just zero files) as a disarmed black box: a
+    header-only dump must not let an audit pass vacuously."""
+    from tools import journal_audit
+    try:
+        per_rank = journal_audit.load_bundle([jdir])
+    except FileNotFoundError:
+        return [], 0
+    nevents = sum(len(s.get("events", ()))
+                  for snaps in per_rank.values() for s in snaps)
+    return journal_audit.audit(per_rank), nevents
+
+
+def run_case(name, plan, workload, expect, env, timeout,
+             audit_journal=False):
     """One seeded plan against one workload; returns (ok, outcome,
     detail).  Harness-private env keys: ``_NRANKS`` (gang size,
     default 2) and ``_TOLERATE`` (comma-separated ranks whose failure
     is the EXPECTED kill — recovery cases require the survivors to
     complete with validated numbers while the victim's own error is
-    ignored)."""
+    ignored).  ``audit_journal`` arms the control-plane journal for
+    the run (PARSEC_MCA_JOURNAL_DIR, a fresh bundle per case) and
+    runs tools/journal_audit.py over it afterwards: any invariant
+    violation fails the case even if the workload outcome matched."""
+    import shutil
+    import tempfile
+
     from parsec_tpu.comm.launch import run_distributed
 
     env = dict(env)
@@ -840,6 +864,10 @@ def run_case(name, plan, workload, expect, env, timeout):
     saved = {k: os.environ.get(k) for k in _CHAOS_ENV}
     os.environ["PARSEC_MCA_FAULT_PLAN"] = plan
     os.environ.update(env)
+    jdir = None
+    if audit_journal:
+        jdir = tempfile.mkdtemp(prefix="parsec-journal-")
+        os.environ["PARSEC_MCA_JOURNAL_DIR"] = jdir
     try:
         try:
             res = run_distributed(WORKLOADS[workload], nranks,
@@ -882,7 +910,34 @@ def run_case(name, plan, workload, expect, env, timeout):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-    return outcome == expect, outcome, detail
+    ok = outcome == expect
+    if jdir is not None:
+        try:
+            violations, jevents = _audit_journals(jdir)
+            if ok and jevents == 0:
+                # the run held its invariant but journaled ZERO
+                # events: the black box was silently disarmed (env
+                # not inherited, journal_enabled=0 leaked, dump path
+                # broken) — an un-audited pass must not read as an
+                # audited one
+                ok = False
+                outcome = f"{outcome}+journal-missing"
+                detail = (f"zero journal events under {jdir} "
+                          f"(journal disarmed?) | {detail}")
+            if violations:
+                ok = False
+                outcome = f"{outcome}+journal-violations"
+                detail = (f"journal audit ({jevents} event(s)): "
+                          + "; ".join(violations[:6])
+                          + (f" (+{len(violations) - 6} more)"
+                             if len(violations) > 6 else "")
+                          + f" | {detail}")
+        except Exception as exc:   # the auditor must not mask the run
+            ok = False
+            outcome = f"{outcome}+journal-audit-error"
+            detail = f"journal audit failed: {exc!r} | {detail}"
+        shutil.rmtree(jdir, ignore_errors=True)
+    return ok, outcome, detail
 
 
 def run_soak(n: int, timeout: float) -> int:
@@ -940,6 +995,12 @@ def main(argv=None):
                          "numerics (plus survivor exhaustion)")
     ap.add_argument("--timeout", type=float, default=90.0,
                     help="per-run harness deadline (hang detector)")
+    ap.add_argument("--audit-journal", action="store_true",
+                    help="arm the control-plane journal for every run "
+                         "(PARSEC_MCA_JOURNAL_DIR per case) and run "
+                         "tools/journal_audit.py over the bundle "
+                         "afterwards — invariant violations fail the "
+                         "case even when the workload outcome matched")
     ap.add_argument("--ab-minimal", action="store_true",
                     help="minimal-vs-full replay A/B on the acceptance "
                          "kill: asserts tasks_reexecuted(minimal) < "
@@ -990,7 +1051,8 @@ def main(argv=None):
         plan = plan_t.format(s=i + 1)
         t0 = time.monotonic()
         ok, outcome, detail = run_case(name, plan, wl, expect, env,
-                                       args.timeout)
+                                       args.timeout,
+                                       audit_journal=args.audit_journal)
         dt = time.monotonic() - t0
         status = "PASS" if ok else "FAIL"
         print(f"[{status}] seed={i + 1} {name:20s} [{wl}] "

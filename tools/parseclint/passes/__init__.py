@@ -7,8 +7,9 @@ the driver).  Adding a pass = adding a module here and listing it in
 
 from tools.parseclint.passes import (assert_hazard, device_put,
                                      evloop_blocking, except_hygiene,
-                                     hot_path, lock_discipline,
-                                     mca_knobs, prom_metrics)
+                                     hot_path, journal_schema,
+                                     lock_discipline, mca_knobs,
+                                     prom_metrics)
 
 ALL_PASSES = (
     lock_discipline,
@@ -17,6 +18,7 @@ ALL_PASSES = (
     device_put,
     mca_knobs,
     prom_metrics,
+    journal_schema,
     except_hygiene,
     assert_hazard,
 )

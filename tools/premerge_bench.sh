@@ -38,8 +38,16 @@
 # shows in all of them; the JSON records both the ratio and
 # overhead_us, and bench_guard compares them by absolute delta).
 #
+# r16 adds the JOURNAL-OVERHEAD gate (control-plane black box,
+# prof/journal.py): the tasks probe armed vs off through bench.py's
+# journal mode, bounded ABSOLUTE at $journal_bound (default 0.3
+# us/task).  The journal has no per-task emit sites by construction —
+# this leg proves the C run_quantum fast path never crosses it.  The
+# chaos smoke below additionally runs --audit-journal (per-case
+# journal bundles through tools/journal_audit.py's invariant auditor).
+#
 # Usage:  sh tools/premerge_bench.sh [threshold] [trace_bound_us] \
-#             [telemetry_bound_us] [native_margin]
+#             [telemetry_bound_us] [native_margin] [journal_bound_us]
 #         threshold:   relative regression that fails (default 0.15)
 #         trace_bound_us: max ABSOLUTE tracing cost in us/task
 #             (default 8.0).  r14 changed this gate from a ratio to an
@@ -227,12 +235,55 @@ else
     rc=1
 fi
 rm -f "$tel"
+echo "== premerge probe: journal overhead (control-plane black box armed) =="
+# r16: the control-plane journal is always-on; its emit sites are
+# control-plane only (recovery rounds, retirement handshakes,
+# barriers, job lifecycle — NO per-task emits), so the tasks probe
+# armed-vs-off must read ~0 us/task.  The absolute bound proves the C
+# run_quantum fast path never crosses the journal.
+journal_bound="${5:-0.3}"
+jnl="/tmp/premerge_journal_$$.json"
+if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=journal \
+     python "$repo/bench.py" > "$jnl" 2>/dev/null; then
+    if ! python - "$jnl" "$journal_bound" <<'EOF'
+import json, sys
+def last_json(path):
+    for line in reversed(open(path).read().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"premerge: no JSON in {path}")
+obj = last_json(sys.argv[1])
+cost_us = obj.get("overhead_us")
+bound = float(sys.argv[2])
+if cost_us is None:
+    print("premerge: journal probe JSON carries no overhead_us "
+          "(every pair skipped?)")
+    sys.exit(1)
+print(f"premerge: journal cost {cost_us:.3f} us/task "
+      f"(bound {bound} us; ratio {obj['value']:+.1%}; off "
+      f"{obj.get('tasks_off')} -> armed {obj.get('tasks_on')} tasks/s)")
+sys.exit(1 if cost_us > bound else 0)
+EOF
+    then
+        rc=1
+    fi
+else
+    echo "premerge: journal probe FAILED to run"
+    rc=1
+fi
+rm -f "$jnl"
 echo "== premerge probe: chaos (seeded fault plans, no-hang invariant) =="
 # 8 seeds = one pass over the quick catalog, which now includes the
 # shm-transport kill, the recv-reorder legs, AND the r12 recovery
 # cases (kill-close-recover / kill-dtd-recover: kill_rank plans that
-# must end in COMPLETED jobs with validated numbers on the survivor)
-if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 8 --quick; then
+# must end in COMPLETED jobs with validated numbers on the survivor).
+# r16 arms --audit-journal: every smoke case runs with the
+# control-plane journal on and tools/journal_audit.py's invariant
+# auditor over the per-case bundle afterwards — a protocol-invariant
+# violation fails premerge even when the workload outcome matched.
+if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --seeds 8 --quick \
+     --audit-journal; then
     rc=1
 fi
 echo "== premerge probe: recovery minimal-vs-full replay A/B =="
