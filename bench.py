@@ -1039,6 +1039,77 @@ def run_recovery_bench():
     return ratio, extras
 
 
+def run_fabric_bench(n_jobs: int = 0):
+    """Many-small-jobs serving throughput through the ServingFabric
+    (service/fabric.py): one warm mesh, a stream of independent small
+    chain jobs, jobs/s as the value and the p50/p99
+    admission->completion latency in the extras — the serving-shape
+    metric of the multi-tenant fabric (ISSUE 16).  The run is
+    journal-audited: any F1/F2/F3 fabric-invariant violation fails the
+    probe rather than reporting a number a broken fabric produced."""
+    if not n_jobs:
+        n_jobs = int(os.environ.get("PARSEC_BENCH_FABRIC_JOBS", 48))
+    nt = int(os.environ.get("PARSEC_BENCH_FABRIC_NT", 8))
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    from parsec_tpu.service.fabric import ServingFabric
+
+    def chain_factory(i):
+        def factory():
+            A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+            A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+            p = PTG(f"fj{i}", NT=nt)
+            p.task("S", k=Range(0, nt - 1)) \
+                .affinity(lambda k, A=A: A(0, 0)) \
+                .flow("T", "RW",
+                      IN(DATA(lambda A=A: A(0, 0)),
+                         when=lambda k: k == 0),
+                      IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                         when=lambda k: k > 0),
+                      OUT(TASK("S", "T",
+                               lambda k, NT=nt: dict(k=k + 1)),
+                          when=lambda k, NT=nt: k < NT - 1),
+                      OUT(DATA(lambda A=A: A(0, 0)),
+                          when=lambda k, NT=nt: k == NT - 1)) \
+                .body(lambda T: T + 1.0)
+            return p.build()
+        return factory
+
+    log(f"fabric config: jobs={n_jobs} nt={nt}")
+    with ServingFabric(nb_cores=4, max_active=8,
+                       max_pending=n_jobs + 8) as svc:
+        warm = [svc.submit(chain_factory(-1 - i), app="fabwarm")
+                for i in range(4)]
+        for j in warm:
+            j.wait(timeout=60.0)
+        t0 = time.perf_counter()
+        jobs = [svc.submit(chain_factory(i), app="fabbench")
+                for i in range(n_jobs)]
+        for j in jobs:
+            if not j.wait(timeout=120.0):
+                raise RuntimeError(f"fabric bench: {j} never finished")
+        dt = time.perf_counter() - t0
+        lats = sorted(j.finished_at - j.submitted_at for j in jobs)
+        bundle = {0: [svc.context.journal.snapshot()]}
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import journal_audit
+    violations = journal_audit.audit(bundle)
+    if violations:
+        raise RuntimeError(
+            f"fabric bench: journal audit found {len(violations)} "
+            f"violation(s): {violations[:3]}")
+    p50 = lats[len(lats) // 2]
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+    extras = {"fabric": {
+        "jobs": n_jobs,
+        "p50_latency_s": round(p50, 4),
+        "p99_latency_s": round(p99, 4),
+        "audit": "clean",
+    }}
+    return n_jobs / dt, extras
+
+
 #: secondary §6 probes: mode -> (runner, metric name, unit, self-declared
 #: target, "higher is better").  Targets documented in BENCH.md.
 _AUX_MODES = {
@@ -1054,6 +1125,8 @@ _AUX_MODES = {
     "tracer": (run_tracer_bench, "tracer_overhead", "us/task", 1.0, False),
     "recovery": (run_recovery_bench, "recovery_makespan_ratio", "ratio",
                  2.0, False),
+    "fabric": (run_fabric_bench, "fabric_jobs_per_s", "jobs/s",
+               10.0, True),
 }
 
 

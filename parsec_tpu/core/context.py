@@ -344,6 +344,13 @@ class Context:
         if event in self._pins and cb in self._pins[event]:
             self._pins[event].remove(cb)
 
+    def accelerator_spaces(self) -> list:
+        """Memory-space indices of the enabled accelerators — the pool
+        the serving fabric's mesh carver (service/fabric.py) allocates
+        per-tenant device subsets from.  Space 0 (host) never appears:
+        carving governs accelerator placement only."""
+        return [d.space for d in self.device_registry.accelerators]
+
     def flush_ici(self) -> None:
         """Drain deferred wavefront placements (comm/ici.py defer_place)
         whose batching window expired.  Best-effort prefetch: failures

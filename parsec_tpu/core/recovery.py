@@ -1248,6 +1248,12 @@ class RecoveryCoordinator:
                 with self._lock:
                     self._active.discard(tp.taskpool_id)
                 ctx.record_pool_error(tp, exc)
+        # COORDINATOR SUCCESSION for the retirement handshake: a rank
+        # dying mid-handshake (the coordinator with collected reports,
+        # or a member that never reported) would silently degrade
+        # retirement to the grace window — re-run the round over the
+        # shrunken live set so it completes without degradation
+        self._succeed_retirements(rank)
         dt = time.monotonic() - t0
         self.duration_hist.observe(dt)
         self.counts["completed" if ok else "failed"] += 1
@@ -1258,6 +1264,38 @@ class RecoveryCoordinator:
         self._notify_services("done" if ok else "failed", rank)
         warning("rank %d: recovery for dead rank %d %s in %.2fs",
                 ctx.rank, rank, "completed" if ok else "FAILED", dt)
+
+    def _succeed_retirements(self, dead: int) -> None:
+        """Retirement-handshake succession after a death: every
+        survivor re-reports its locally-complete, unretired,
+        not-restarting pools.  When the dead rank was the handshake
+        coordinator (every coordinator is ``min(live)``, so ``dead <
+        new coordinator`` identifies exactly that case) the NEW
+        coordinator re-collects quorum from scratch — the old one took
+        the collected reports down with it.  When a non-coordinator
+        member died before reporting, the re-reports force the
+        coordinator to re-evaluate quorum over the SHRUNKEN live set
+        (report-time evaluation alone would wait for a report that can
+        never come).  Idempotent on the collector side: a re-added
+        report is a set re-add."""
+        rde = self._rde
+        ce = rde.ce if rde is not None else None
+        if ce is None or ce.nranks <= 1:
+            return
+        coord = rde.recovery_coordinator()
+        succession = dead < coord      # the dead rank WAS coordinator
+        with self._lock:
+            pools = [spec["tp"] for tpid, spec in self._specs.items()
+                     if spec["completed_at"] is not None
+                     and not getattr(spec["tp"], "retired", False)
+                     and not spec["tp"].cancelled
+                     and tpid not in self._active]
+        jr = self.context.journal
+        for tp in pools:
+            if succession and jr is not None:
+                jr.emit("retire_succession", pool=tp.taskpool_id,
+                        coord=coord, dead=dead)
+            self._report_retire(tp)
 
     def _restart_pool(self, tp: Taskpool, dead: int, target: int) -> int:
         """Rewind + restore + re-execute one pool.  Returns the local

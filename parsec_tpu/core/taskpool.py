@@ -155,6 +155,12 @@ class Taskpool:
         #: another survivor may still need its re-executed partition;
         #: a retired one is never resurrected by recovery
         self.retired = False
+        #: serving-fabric carve stamp (service/fabric.py): the memory-
+        #: space indices this pool's tasks may execute on.  None =
+        #: unrestricted (the whole warm mesh); a frozenset restricts
+        #: DeviceRegistry.best_device to exactly those accelerator
+        #: spaces, so concurrent tenants run on disjoint device subsets
+        self.device_spaces: Optional[frozenset] = None
 
     # -- construction ------------------------------------------------------
     def add_task_class(self, tc: TaskClass) -> TaskClass:

@@ -132,12 +132,20 @@ class DeviceRegistry:
         """Pick the execution device for a task (reference:
         parsec_get_best_device, device.c:79-140): honor the owner/preferred
         device of the task's written data when it is an accelerator,
-        otherwise the enabled accelerator with the least weighted load."""
+        otherwise the enabled accelerator with the least weighted load.
+
+        A pool carrying a serving-fabric carve stamp
+        (``Taskpool.device_spaces``) restricts every choice — affinity
+        hints included — to its carved subset, so concurrent tenants
+        never share an exclusively-placed device."""
+        allowed = getattr(task.taskpool, "device_spaces", None)
         accs = self.accelerators
+        if allowed is not None:
+            accs = [d for d in accs if d.space in allowed]
         if not accs:
             return None
         dev = self._coaffinity_device(task)
-        if dev is not None:
+        if dev is not None and (allowed is None or dev.space in allowed):
             return dev
         for flow in task.task_class.flows:
             if not (flow.access & ACCESS_WRITE):
@@ -148,7 +156,8 @@ class DeviceRegistry:
             datum = copy.data
             pref = datum.preferred_device
             if pref is not None and 1 <= pref < len(self.devices) \
-                    and self.devices[pref].enabled:
+                    and self.devices[pref].enabled \
+                    and (allowed is None or pref in allowed):
                 return self.devices[pref]
             # residency affinity: the accelerator already holding the
             # newest valid copy of the written datum wins, avoiding a
@@ -158,7 +167,8 @@ class DeviceRegistry:
                 if sp >= 1 and sp < len(self.devices) \
                         and c.coherency != Coherency.INVALID \
                         and c.version == v and c.payload is not None \
-                        and self.devices[sp].enabled:
+                        and self.devices[sp].enabled \
+                        and (allowed is None or sp in allowed):
                     return self.devices[sp]
         return min(accs, key=lambda d: d.load / d.weight)
 

@@ -110,11 +110,13 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     "need_req": ("pool", "src"),
     "need_ack": ("pool", "dst", "ok"),
     "need_round": ("pool", "round", "outcome", "peers"),
-    # retirement handshake (incl. the grace-window degradation)
+    # retirement handshake (incl. the grace-window degradation and the
+    # coordinator-succession round that avoids it)
     "retire_report": ("pool", "coord"),
     "retire_recv": ("pool", "src"),
     "retired": ("pool",),
     "retire_degraded": ("pool",),
+    "retire_succession": ("pool", "coord"),
     # rejoin incarnation fencing (TAG_REJOIN)
     "rejoin_req": ("src", "epoch", "ok"),
     "rejoin_done": ("epoch",),
@@ -130,6 +132,19 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     "job_done": ("job", "status"),
     "job_cancel": ("job",),
     "service_state": ("peer", "state"),
+    # serving-fabric decisions (service/fabric.py): admission quotes,
+    # placement/release of carved device subsets, elastic resizes,
+    # preemption round-trips.  The auditor's F-invariants replay these:
+    # exclusive subsets disjoint at all times (F1), exactly one
+    # placement outcome per admitted job (F2), every preemption
+    # resumed or cancelled (F3).
+    "fabric_quote": ("job", "eta"),
+    "fabric_admit": ("job", "verdict"),
+    "fabric_place": ("job", "devices"),
+    "fabric_resize": ("job", "devices", "delta"),
+    "fabric_release": ("job",),
+    "fabric_preempt": ("job", "by"),
+    "fabric_resume": ("job",),
 }
 
 

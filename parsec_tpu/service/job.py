@@ -92,6 +92,30 @@ class JobHandle:
         #: termination callbacks must be absorbed below the service —
         #: JobService._emit_done test-and-sets this)
         self._done_emitted = False
+        # -- serving-fabric extensions (service/fabric.py); inert under
+        # -- the plain JobService ---------------------------------------
+        #: declared completion SLO in seconds from submission (None =
+        #: best-effort); the fabric quotes a makespan at submit and
+        #: queues/rejects/deprioritizes against this
+        self.slo: Optional[float] = None
+        #: device ask: how many exclusive accelerators to carve (0 =
+        #: temporal sharing of the unreserved remainder) and the
+        #: elastic ceiling the fabric may grow the subset to
+        self.devices_want: int = 0
+        self.devices_max: int = 0
+        #: the carved memory-space subset while placed (None = shared)
+        self.devices: Optional[tuple] = None
+        #: preemptible round-trip state: a resumable job keeps its
+        #: factory across a preemption (the pool is cancelled, the job
+        #: re-queued PENDING, datarepo snapshots ride the recovery
+        #: substrate) and counts how many times that happened
+        self.resumable = False
+        self.preemptions = 0
+        self.preempted_at: Optional[float] = None
+        #: admission record: the fabric's quoted makespan (seconds) and
+        #: verdict ("admit" | "queue" | "deprioritize" | "reject")
+        self.quote_eta: Optional[float] = None
+        self.verdict: Optional[str] = None
         self._done = threading.Event()
         self._lock = threading.Lock()
 
@@ -178,6 +202,12 @@ class JobHandle:
             "error": (None if self._exc is None
                       else f"{type(self._exc).__name__}: {self._exc}"),
             "failed_rank": self.failed_rank,
+            "slo": self.slo,
+            "devices": (list(self.devices)
+                        if self.devices is not None else None),
+            "preemptions": self.preemptions,
+            "quote_eta": self.quote_eta,
+            "verdict": self.verdict,
         }
 
     def __repr__(self):

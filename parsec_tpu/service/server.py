@@ -14,7 +14,13 @@ Requests are one JSON object; every request gets one JSON reply with an
 
     {"op": "submit", "app": "gemm", "params": {...}, "priority": 5,
      "deadline": 30.0, "client": "cli"}      -> {"ok": true, "job": 7}
-    {"op": "status", "job": 7}               -> {"ok": true, "info": {...}}
+                      (under a ServingFabric front the request may also
+                       carry "slo", "devices", "devices_max",
+                       "resumable" and "slo_policy"; the reply then
+                       adds the admission quote: "quote_eta",
+                       "verdict" — see service/fabric.py)
+    {"op": "status", "job": 7}               -> {"ok": true, "info": {...},
+                                                 "queue_position": n|null}
     {"op": "status"}                         -> {"ok": true, "status": {...}}
                       (the LIVE surface: per-job progress, online
                        exec/queue/comm/idle split, stragglers, dagsim
@@ -48,6 +54,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from parsec_tpu.service.fabric import ServingFabric
 from parsec_tpu.service.job import AdmissionError, JobError
 from parsec_tpu.service.service import JobService
 from parsec_tpu.utils.mca import params
@@ -357,9 +364,15 @@ class JobServer:
             return self._op_submit(req)
         if op == "status":
             if req.get("job") is not None:
-                # per-job record (the original op shape)
+                # per-job record (the original op shape); under a
+                # ServingFabric front a PENDING job also learns its
+                # 0-based dispatch-order position in the queue
                 job = self._job_of(req)
-                return {"ok": True, "info": job.info()}
+                reply = {"ok": True, "info": job.info()}
+                qp = getattr(self.service, "queue_position", None)
+                if callable(qp):
+                    reply["queue_position"] = qp(job.job_id)
+                return reply
             # job-less status: the LIVE streaming surface — per-job DAG
             # progress, the online exec/queue/comm/idle split, straggler
             # list and the dagsim ETA, aggregated cross-rank over the
@@ -423,18 +436,34 @@ class JobServer:
         # client must fail THIS request, not poison the deadline sweep
         deadline = req.get("deadline")
         timeout = req.get("timeout")
+        kw: Dict[str, Any] = dict(
+            priority=int(req.get("priority", 0)),
+            deadline=None if deadline is None else float(deadline),
+            client=str(req.get("client", "")),
+            name=str(req.get("name", "") or f"{app}"),
+            block=bool(req.get("block", False)),
+            timeout=None if timeout is None else float(timeout))
+        if isinstance(self.service, ServingFabric):
+            # fabric-only admission fields; a plain JobService front
+            # silently ignores them (its submit has no such kwargs)
+            slo = req.get("slo")
+            devices = req.get("devices")
+            kw.update(
+                slo=None if slo is None else float(slo),
+                devices=None if devices is None else int(devices),
+                devices_max=int(req.get("devices_max", 0) or 0),
+                resumable=bool(req.get("resumable", False)),
+                app=str(app),
+                slo_policy=str(req.get("slo_policy", "") or ""))
         try:
-            job = self.service.submit(
-                factory,
-                priority=int(req.get("priority", 0)),
-                deadline=None if deadline is None else float(deadline),
-                client=str(req.get("client", "")),
-                name=str(req.get("name", "") or f"{app}"),
-                block=bool(req.get("block", False)),
-                timeout=None if timeout is None else float(timeout))
+            job = self.service.submit(factory, **kw)
         except AdmissionError as exc:
             return {"ok": False, "rejected": True, "error": str(exc)}
-        return {"ok": True, "job": job.job_id, "name": job.name}
+        reply = {"ok": True, "job": job.job_id, "name": job.name}
+        if getattr(job, "verdict", None) is not None:
+            reply["quote_eta"] = job.quote_eta
+            reply["verdict"] = job.verdict
+        return reply
 
     def close(self) -> None:
         self._stop = True
@@ -466,10 +495,14 @@ def request(host: str, port: int, obj: Dict[str, Any],
 
 
 def serve(port: Optional[int] = None, host: str = "127.0.0.1",
+          fabric: bool = False,
           **service_kwargs) -> Tuple[JobService, JobServer]:
     """Bring up a resident service + server pair (blocking callers use
-    ``serve_forever``)."""
-    service = JobService(**service_kwargs)
+    ``serve_forever``).  ``fabric=True`` fronts a ServingFabric —
+    mesh carving, SLO quotes, preemption — instead of the plain
+    temporally-shared JobService."""
+    cls = ServingFabric if fabric else JobService
+    service = cls(**service_kwargs)
     server = JobServer(service, host=host, port=port)
     return service, server
 
@@ -498,13 +531,18 @@ def main(argv=None) -> None:
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--cores", type=int, default=None,
                     help="worker streams for the warm context")
+    ap.add_argument("--fabric", action="store_true",
+                    help="front a ServingFabric (mesh carving, SLO "
+                         "quotes, preemption) instead of the plain "
+                         "temporally-shared JobService")
     args, rest = ap.parse_known_args(argv)
     if rest:
         params.parse_cmdline(rest)
     kw = {}
     if args.cores is not None:
         kw["nb_cores"] = args.cores
-    serve_forever(port=args.port, host=args.host, **kw)
+    serve_forever(port=args.port, host=args.host, fabric=args.fabric,
+                  **kw)
 
 
 if __name__ == "__main__":

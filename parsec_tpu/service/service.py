@@ -213,13 +213,12 @@ class JobService:
                 return True
             now = time.monotonic()
             self._sweep_deadlines(now)
-            if self._pending and len(self._running) < self._max_active:
-                job = max(self._pending,
-                          key=lambda j: self._score(j, now))
+            job = self._pick_job(now)
+            if job is not None:
                 self._pending.remove(job)
                 self._running[job.job_id] = job
                 self._space.notify_all()
-            if job is None:
+            else:
                 self._work.wait(self._tick)
         if job is not None:
             # launch off-thread: a slow factory (tile allocation at
@@ -229,6 +228,16 @@ class JobService:
                              name=f"job-launch-{job.job_id}",
                              daemon=True).start()
         return False
+
+    def _pick_job(self, now_mono: float) -> Optional[JobHandle]:
+        """Select the next pending job to dispatch (lock held); None
+        keeps the dispatcher waiting.  The base policy is aged-priority
+        order under the active cap; the serving fabric overrides this
+        with placement-aware admission (service/fabric.py)."""
+        if self._pending and len(self._running) < self._max_active:
+            return max(self._pending,
+                       key=lambda j: self._score(j, now_mono))
+        return None
 
     def _sweep_deadlines(self, now_mono: float) -> None:
         """Expire deadlines (lock held; monotonic clock).  Pool
@@ -251,7 +260,10 @@ class JobService:
     def _launch(self, job: JobHandle) -> None:
         try:
             made = job.factory()
-            job.factory = None      # one-shot; drop the closure early
+            if not getattr(job, "resumable", False):
+                job.factory = None  # one-shot; drop the closure early
+                # (a resumable job keeps its factory: a fabric
+                # preemption re-queues it and re-runs the factory)
             tp, result_fn = (made if isinstance(made, tuple) else (made,
                                                                    None))
             job._result_fn = result_fn
@@ -264,6 +276,7 @@ class JobService:
                 tp.cancel()
                 with self._lock:
                     self._running.pop(job.job_id, None)
+                    self._release_job(job)
                     self._prune_history()
                     self._work.notify_all()
                 self._emit_done(job)
@@ -287,6 +300,7 @@ class JobService:
             job._to(JobStatus.FAILED)
             with self._lock:
                 self._running.pop(job.job_id, None)
+                self._release_job(job)
                 self._work.notify_all()
             self._emit_done(job)
 
@@ -333,9 +347,16 @@ class JobService:
                     self.context.taskpools.pop(sub.taskpool_id, None)
         with self._lock:
             self._running.pop(job.job_id, None)
+            self._release_job(job)
             self._prune_history()
             self._work.notify_all()
         self._emit_done(job)
+
+    def _release_job(self, job: JobHandle) -> None:
+        """Hook (lock held) fired whenever a job leaves the running
+        set, whatever path removed it.  The base service holds no
+        placements; the serving fabric overrides this to return the
+        job's carved device subset to the free list."""
 
     def _prune_history(self) -> None:
         """Bound the job index (lock held): a resident service must not

@@ -1,0 +1,480 @@
+"""Serving-fabric tests (ISSUE 16): mesh carving, gang dispatch of
+concurrent tenants, SLO-driven predictive admission, preemption
+round-trips, device-death elasticity, and the F1/F2/F3 fabric
+invariants of the offline journal auditor
+(service/fabric.py, tools/journal_audit.py)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+from parsec_tpu.service.fabric import (FabricProfiles, MeshCarver,
+                                       ServingFabric)
+from parsec_tpu.service.job import AdmissionError, JobStatus
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from tools import journal_audit  # noqa: E402
+
+
+def _fab_chain(nt, delay=0.0, name="chain", device=None):
+    """Job factory: own 1-tile collection + nt-deep increment chain;
+    result() is the final tile value (== nt when every task ran —
+    including after a preempt-then-resume restart, which re-runs the
+    factory and rebuilds the collection from zero)."""
+    def factory():
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+        A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+
+        def body(T, k):
+            if delay:
+                time.sleep(delay)
+            return T + 1.0
+
+        p = PTG(name, NT=nt)
+        tb = p.task("S", k=Range(0, nt - 1)) \
+            .affinity(lambda k, A=A: A(0, 0)) \
+            .flow("T", "RW",
+                  IN(DATA(lambda A=A: A(0, 0)), when=lambda k: k == 0),
+                  IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                     when=lambda k: k > 0),
+                  OUT(TASK("S", "T", lambda k, NT=nt: dict(k=k + 1)),
+                      when=lambda k, NT=nt: k < NT - 1),
+                  OUT(DATA(lambda A=A: A(0, 0)),
+                      when=lambda k, NT=nt: k == NT - 1))
+        if device:
+            tb.body(lambda T: T + 1.0, device=device)
+        else:
+            tb.body(body)
+
+        def result():
+            return float(np.asarray(
+                A.data_of(0, 0).pull_to_host().payload)[0, 0])
+        return p.build(), result
+    return factory
+
+
+def _wait_progress(svc, job, min_tasks=1, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        done = svc.gauges.job_task_counts(job.job_id)["tasks_retired"]
+        if job.status() == JobStatus.RUNNING and done >= min_tasks:
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"{job} made no progress")
+
+
+def _bundle_of(svc):
+    """This service's journal as an audit bundle."""
+    return {0: [svc.context.journal.snapshot()]}
+
+
+def _events(svc, kinds=None):
+    evs = svc.context.journal.tail(4096)
+    if kinds is None:
+        return evs
+    return [e for e in evs if e.get("e") in kinds]
+
+
+# ---------------------------------------------------------------------------
+# MeshCarver: the free-list allocator
+# ---------------------------------------------------------------------------
+
+def test_carver_disjoint_leases_and_free_list_reuse():
+    c = MeshCarver(range(1, 9))          # spaces 1..8
+    a = c.carve(1, 3)
+    b = c.carve(2, 3)
+    d = c.carve(3, 2)
+    assert a and b and d
+    assert not (set(a) & set(b)) and not (set(a) & set(d)) \
+        and not (set(b) & set(d))
+    assert c.free_count() == 0
+    # exhausted: the next ask fails, so does a double-carve
+    assert c.carve(4, 1) is None
+    assert c.carve(1, 1) is None          # owner already holds a lease
+    # release returns devices to the free list; they are reused
+    assert set(c.release(2)) == set(b)
+    e = c.carve(5, 3)
+    assert set(e) == set(b)
+    assert c.lease(2) == ()
+    assert c.release(99) == ()            # unknown owner: no-op
+
+
+def test_carver_best_fit_contiguous_and_scattered_fallback():
+    c = MeshCarver(range(8))
+    # leave two holes: [0,1] and [4..7] (sizes 2 and 4)
+    c.carve(1, 8)
+    c.release(1)
+    a = c.carve(1, 2)                     # takes [0,1]
+    c.carve(2, 2)                         # [2,3]
+    c.release(1)
+    c.carve(3, 4)                         # [4..7]
+    # best fit: a 2-ask picks the SMALL hole [0,1], not a slice of a
+    # bigger one
+    lease = c.carve(4, 2)
+    assert lease == (0, 1), lease
+    c.release(4)
+    c.release(2)                          # free = {0,1} + {2,3} = [0..3]
+    assert c.fragmentation() == 0.0       # one contiguous hole
+    c.carve(5, 1)                         # take 0 -> free {1,2,3}
+    c.release(5)
+    # fragmentation metric reacts to shattering
+    c2 = MeshCarver(range(6))
+    c2.carve(1, 6)
+    c2.release(1)
+    for owner, s in ((10, 1), (11, 3), (12, 5)):
+        c2._free.discard(s)
+        c2._leases[owner] = [s]
+    assert c2.fragmentation() > 0.5
+    # scattered fallback: no run of 3 exists, the ask still carves
+    lease = c2.carve(20, 3)
+    assert lease == (0, 2, 4)
+
+
+def test_carver_grow_shrink_evict():
+    c = MeshCarver(range(8))
+    c.carve(1, 2)                         # [0,1]
+    # grow prefers adjacency
+    added = c.grow(1, 2)
+    assert added == (2, 3)
+    assert c.lease(1) == (0, 1, 2, 3)
+    # shrink returns highest indices first
+    assert c.shrink(1, 2) == (2, 3)
+    assert c.lease(1) == (0, 1)
+    # evicting a leased device removes it from the mesh entirely
+    assert c.evict(1) == 1                # owner 1 shrank
+    assert 1 not in c.spaces
+    assert c.lease(1) == (0,)
+    assert c.evict(5) is None             # free device: no owner
+    assert 5 not in c.spaces
+    # shrinking to nothing drops the lease
+    assert c.shrink(1, 1) == (0,)
+    assert c.lease(1) == ()
+
+
+# ---------------------------------------------------------------------------
+# FabricProfiles: the learned quote
+# ---------------------------------------------------------------------------
+
+def test_profiles_quote_learns_and_scales():
+    p = FabricProfiles()
+    assert p.quote("never-seen", 4) is None
+    p.observe("a", makespan=8.0, chips=2, totals={"S": 16},
+              means={"S": 1.0})
+    q1, q2, q8 = p.quote("a", 1), p.quote("a", 2), p.quote("a", 8)
+    assert q1 is not None and q2 is not None and q8 is not None
+    # more chips never quotes slower
+    assert q1 >= q2 >= q8
+    # at the measured gang size the quote tracks the measured makespan
+    # (dagsim list-scheduling model; generous model tolerance)
+    assert 0.1 * 8.0 <= q2 <= 10.0 * 8.0
+    # no class mix: linear strong-scaling fallback
+    p.observe("b", makespan=6.0, chips=2, totals=None, means={})
+    assert p.quote("b", 4) == pytest.approx(3.0)
+    assert p.quote("b", 1) == pytest.approx(12.0)
+    # EWMA folding moves the estimate toward the new measurement
+    before = p.quote("b", 2)
+    p.observe("b", makespan=2.0, chips=2, totals=None, means={})
+    assert p.quote("b", 2) < before
+
+
+# ---------------------------------------------------------------------------
+# the fabric end-to-end (8 virtual XLA devices; tests/conftest.py)
+# ---------------------------------------------------------------------------
+
+def test_fabric_concurrent_tenants_on_disjoint_subsets():
+    """≥3 concurrent jobs: two exclusive tenants on carved disjoint
+    subsets plus a shared-remainder tenant, truly co-running; every
+    decision journaled and the fabric invariants audit clean."""
+    with ServingFabric(nb_cores=2, max_active=8) as svc:
+        if len(svc._carver.spaces) < 6:
+            pytest.skip("needs >=6 accelerator spaces")
+        a = svc.submit(_fab_chain(40, delay=0.01, name="ta"),
+                       devices=3, client="tenantA")
+        b = svc.submit(_fab_chain(40, delay=0.01, name="tb"),
+                       devices=3, client="tenantB")
+        s = svc.submit(_fab_chain(40, delay=0.01, name="ts"),
+                       devices=0, client="tenantS")
+        # all three run CONCURRENTLY at some instant
+        deadline = time.monotonic() + 15.0
+        seen = 0
+        while time.monotonic() < deadline:
+            seen = max(seen, sum(j.status() == JobStatus.RUNNING
+                                 for j in (a, b, s)))
+            if seen == 3:
+                break
+            time.sleep(0.005)
+        assert seen == 3
+        assert a.result(timeout=60.0) == 40.0
+        assert b.result(timeout=60.0) == 40.0
+        assert s.result(timeout=60.0) == 40.0
+        places = _events(svc, {"fabric_place"})
+        excl = [e for e in places if not e.get("shared")]
+        shared = [e for e in places if e.get("shared")]
+        assert len(excl) == 2 and len(shared) == 1
+        sets = [set(e["devices"]) for e in excl]
+        assert len(sets[0]) == 3 and len(sets[1]) == 3
+        assert not (sets[0] & sets[1])
+        assert journal_audit.audit(_bundle_of(svc)) == []
+
+
+def test_fabric_exclusive_subset_confines_device_execution():
+    """The carve stamp reaches best_device: a 1-device tenant's device
+    tasks execute ONLY on its leased accelerator."""
+    with ServingFabric(nb_cores=2, max_active=4) as svc:
+        accs = svc.context.device_registry.accelerators
+        if len(accs) < 2:
+            pytest.skip("needs >=2 accelerators")
+        job = svc.submit(_fab_chain(8, name="pin", device="tpu"),
+                         devices=1)
+        assert job.result(timeout=60.0) == 8.0
+        place = _events(svc, {"fabric_place"})[-1]
+        lease = set(place["devices"])
+        assert len(lease) == 1
+        used = {d.space for d in accs if d.stats.executed_tasks > 0}
+        assert used and used <= lease, (used, lease)
+
+
+def test_fabric_quote_vs_measured_makespan():
+    """A second submission of a profiled app gets a makespan quote in
+    the same decade as the measured first run."""
+    with ServingFabric(nb_cores=2, max_active=4) as svc:
+        first = svc.submit(_fab_chain(25, delay=0.005, name="calib"),
+                           app="calib")
+        assert first.result(timeout=60.0) == 25.0
+        measured = first.finished_at - first.started_at
+        assert measured > 0
+        # the profile folds in _release_job, just after the terminal
+        # transition wakes result() — poll for it
+        deadline = time.monotonic() + 5.0
+        while svc._profiles.quote("calib", svc._chips_shared) is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        again = svc.submit(_fab_chain(25, delay=0.005, name="calib2"),
+                           app="calib", slo=3600.0)
+        assert again.quote_eta is not None
+        assert 0.1 * measured <= again.quote_eta <= 10.0 * measured, \
+            (again.quote_eta, measured)
+        assert again.verdict == "admit"
+        assert again.result(timeout=60.0) == 25.0
+        quotes = _events(svc, {"fabric_quote"})
+        assert any(e.get("eta") is not None for e in quotes)
+        assert journal_audit.audit(_bundle_of(svc)) == []
+
+
+def test_fabric_over_slo_policies():
+    """An over-SLO quote rejects / deprioritizes / queues per policy,
+    with the verdicts journaled and F2 holding (a rejected job never
+    places)."""
+    with ServingFabric(nb_cores=2, max_active=4) as svc:
+        svc._profiles.observe("slowapp", makespan=500.0, chips=1,
+                              totals={"S": 10}, means={"S": 50.0})
+        with pytest.raises(AdmissionError):
+            svc.submit(_fab_chain(3, name="rej"), app="slowapp",
+                       slo=0.5, slo_policy="reject")
+        depri = svc.submit(_fab_chain(3, name="dep"), app="slowapp",
+                           slo=0.5, slo_policy="deprioritize")
+        queued = svc.submit(_fab_chain(3, name="que"), app="slowapp",
+                            slo=0.5)          # default policy: queue
+        assert depri.verdict == "deprioritize"
+        assert depri.priority < 0             # the penalty applied
+        assert queued.verdict == "queue"
+        assert depri.result(timeout=60.0) == 3.0
+        assert queued.result(timeout=60.0) == 3.0
+        verdicts = {e["verdict"] for e in _events(svc, {"fabric_admit"})}
+        assert {"reject", "deprioritize", "queue"} <= verdicts
+        assert journal_audit.audit(_bundle_of(svc)) == []
+
+
+def test_fabric_queue_position():
+    with ServingFabric(nb_cores=2, max_active=1,
+                       aging_weight=0.0) as svc:
+        busy = svc.submit(_fab_chain(80, delay=0.01, name="busy"))
+        _wait_progress(svc, busy)
+        lo = svc.submit(_fab_chain(3, name="lo"), priority=1)
+        hi = svc.submit(_fab_chain(3, name="hi"), priority=5)
+        assert svc.queue_position(hi.job_id) == 0
+        assert svc.queue_position(lo.job_id) == 1
+        assert svc.queue_position(busy.job_id) is None
+        for j in (busy, lo, hi):
+            assert j.result(timeout=60.0) is not None
+
+
+def test_fabric_preempt_then_resume_roundtrip():
+    """A latency-critical tenant preempts a lower-priority resumable
+    tenant holding the whole mesh; the victim re-queues, resumes after
+    the critical job drains, and still produces the right answer.
+    fabric_preempt + fabric_resume are journaled and F1/F2/F3 audit
+    clean."""
+    with ServingFabric(nb_cores=2, max_active=4,
+                       aging_weight=0.0) as svc:
+        nmesh = len(svc._carver.spaces)
+        if nmesh < 2:
+            pytest.skip("needs a carveable mesh")
+        victim = svc.submit(_fab_chain(250, delay=0.01, name="victim"),
+                            priority=0, devices=nmesh, resumable=True)
+        _wait_progress(svc, victim, min_tasks=2)
+        urgent = svc.submit(_fab_chain(5, name="urgent"), priority=10,
+                            devices=2, slo=600.0)
+        assert urgent.result(timeout=60.0) == 5.0
+        assert victim.result(timeout=180.0) == 250.0
+        assert victim.preemptions >= 1
+        assert svc.preemptions >= 1
+        kinds = [e["e"] for e in _events(svc)]
+        assert "fabric_preempt" in kinds
+        assert "fabric_resume" in kinds
+        # the resume leg re-placed the victim: one outcome per epoch
+        assert journal_audit.audit(_bundle_of(svc)) == []
+
+
+def test_fabric_device_death_shrinks_owner_only():
+    """Chaos: kill a device inside ONE tenant's carved subset (the
+    mesh-level analog of a rank kill).  The owner's subset shrinks in
+    place and its job completes on what is left; the other tenants are
+    unaffected; the resize is journaled and the audit stays clean."""
+    with ServingFabric(nb_cores=2, max_active=8) as svc:
+        if len(svc._carver.spaces) < 6:
+            pytest.skip("needs >=6 accelerator spaces")
+        a = svc.submit(_fab_chain(120, delay=0.01, name="ka"),
+                       devices=3, client="tenantA")
+        b = svc.submit(_fab_chain(30, delay=0.01, name="kb"),
+                       devices=3, client="tenantB")
+        s = svc.submit(_fab_chain(30, delay=0.01, name="ks"),
+                       devices=0, client="tenantS")
+        _wait_progress(svc, a, min_tasks=2)
+        assert a.devices is not None and len(a.devices) == 3
+        dead = a.devices[0]
+        svc.context.device_registry.get(dead).enabled = False
+        owner = svc.device_dead(dead)
+        assert owner == a.job_id
+        assert a.devices is not None and dead not in a.devices
+        assert len(a.devices) == 2
+        assert a.result(timeout=120.0) == 120.0
+        assert b.result(timeout=60.0) == 30.0
+        assert s.result(timeout=60.0) == 30.0
+        resize = [e for e in _events(svc, {"fabric_resize"})
+                  if e.get("cause") == "device_dead"]
+        assert resize and resize[-1]["delta"] == -1
+        assert journal_audit.audit(_bundle_of(svc)) == []
+
+
+# ---------------------------------------------------------------------------
+# the auditor's fabric invariants on hand-built bundles
+# ---------------------------------------------------------------------------
+
+def _snap(rank, events):
+    return {"rank": rank, "inc": 0, "nranks": 1, "wall": 0.0,
+            "perf": 0.0, "clock": {}, "events": events}
+
+
+def _fab_bundle(events):
+    out = []
+    for i, ev in enumerate(events):
+        e = {"t": float(i), "seq": i + 1, "inc": 0}
+        e.update(ev)
+        out.append(e)
+    return {0: [_snap(0, out)]}
+
+
+def test_audit_clean_fabric_roundtrip():
+    b = _fab_bundle([
+        {"e": "fabric_admit", "job": 1, "verdict": "admit"},
+        {"e": "fabric_place", "job": 1, "devices": [1, 2],
+         "shared": False},
+        {"e": "fabric_admit", "job": 2, "verdict": "admit"},
+        {"e": "fabric_preempt", "job": 1, "by": 2},
+        {"e": "fabric_release", "job": 1, "devices": [1, 2],
+         "cause": "preempt"},
+        {"e": "fabric_place", "job": 2, "devices": [1, 2],
+         "shared": False},
+        {"e": "fabric_release", "job": 2, "devices": [1, 2],
+         "cause": "done"},
+        {"e": "job_done", "job": 2, "status": "done"},
+        {"e": "fabric_resume", "job": 1},
+        {"e": "fabric_place", "job": 1, "devices": [1, 2],
+         "shared": False},
+        {"e": "fabric_release", "job": 1, "devices": [1, 2],
+         "cause": "done"},
+        {"e": "job_done", "job": 1, "status": "done"},
+    ])
+    assert journal_audit.audit(b) == []
+
+
+def test_audit_flags_overlapping_exclusive_subsets():
+    b = _fab_bundle([
+        {"e": "fabric_admit", "job": 1, "verdict": "admit"},
+        {"e": "fabric_admit", "job": 2, "verdict": "admit"},
+        {"e": "fabric_place", "job": 1, "devices": [1, 2],
+         "shared": False},
+        {"e": "fabric_place", "job": 2, "devices": [2, 3],
+         "shared": False},
+    ])
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("F1") and "overlapping" in v
+               for v in vs), vs
+
+
+def test_audit_shared_placement_never_conflicts():
+    b = _fab_bundle([
+        {"e": "fabric_admit", "job": 1, "verdict": "admit"},
+        {"e": "fabric_admit", "job": 2, "verdict": "admit"},
+        {"e": "fabric_place", "job": 1, "devices": [1, 2],
+         "shared": False},
+        {"e": "fabric_place", "job": 2, "devices": [],
+         "shared": True},
+    ])
+    assert journal_audit.audit(b) == []
+
+
+def test_audit_flags_double_placement_without_resume():
+    b = _fab_bundle([
+        {"e": "fabric_admit", "job": 1, "verdict": "admit"},
+        {"e": "fabric_place", "job": 1, "devices": [1],
+         "shared": False},
+        {"e": "fabric_release", "job": 1, "devices": [1],
+         "cause": "done"},
+        {"e": "fabric_place", "job": 1, "devices": [1],
+         "shared": False},
+    ])
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("F2") and "epoch" in v for v in vs), vs
+
+
+def test_audit_flags_rejected_job_that_placed():
+    b = _fab_bundle([
+        {"e": "fabric_admit", "job": 9, "verdict": "reject"},
+        {"e": "fabric_place", "job": 9, "devices": [1],
+         "shared": False},
+    ])
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("F2") and "REJECTED" in v for v in vs), vs
+
+
+def test_audit_flags_unresolved_preemption():
+    b = _fab_bundle([
+        {"e": "fabric_admit", "job": 1, "verdict": "admit"},
+        {"e": "fabric_place", "job": 1, "devices": [1],
+         "shared": False},
+        {"e": "fabric_preempt", "job": 1, "by": 2},
+        {"e": "fabric_release", "job": 1, "devices": [1],
+         "cause": "preempt"},
+    ])
+    vs = journal_audit.audit(b)
+    assert any(v.startswith("F3") for v in vs), vs
+    # a terminal job_done after the preempt resolves it (cancelled
+    # while preempted)
+    b2 = _fab_bundle([
+        {"e": "fabric_admit", "job": 1, "verdict": "admit"},
+        {"e": "fabric_place", "job": 1, "devices": [1],
+         "shared": False},
+        {"e": "fabric_preempt", "job": 1, "by": 2},
+        {"e": "fabric_release", "job": 1, "devices": [1],
+         "cause": "preempt"},
+        {"e": "job_done", "job": 1, "status": "cancelled"},
+    ])
+    assert journal_audit.audit(b2) == []

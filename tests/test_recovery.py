@@ -771,6 +771,52 @@ def test_single_rank_pool_retires_at_completion():
         params.set("recovery_enable", 0)
 
 
+def test_retirement_succession_on_coordinator_death():
+    """Coordinator succession (r17): the handshake coordinator dying
+    with the collected reports must NOT degrade retirement to the
+    grace window — survivors re-report to the new lowest live rank,
+    which re-collects quorum over the shrunken live set."""
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.taskpool import Taskpool, TaskpoolState
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    params.set("recovery_enable", 1)
+    ctx = Context(nb_cores=1)
+    try:
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=8, ln=8, nodes=3,
+                              myrank=1, name="Asucc")
+        tp = Taskpool("succ")
+        tp.recovery_collections = [A]
+        ctx.add_taskpool(tp)
+        rec = ctx.recovery
+        sent = []
+        rec._rde = _stub_rde(1, [0, 2], sent)   # we are rank 1 of 3
+        tp.state = TaskpoolState.DONE
+        rec._pool_done(tp)
+        # report went to the original coordinator (rank 0), who now
+        # dies with it — the pool must still be restartable
+        assert sent and sent[-1] == (0, {"k": "retire",
+                                         "tp": tp.taskpool_id})
+        assert not tp.retired
+        rec._rde = _stub_rde(1, [2], sent)      # rank 0 died
+        rec._rde.ce.dead_peers.add(0)
+        rec._succeed_retirements(0)
+        # this rank became coordinator and re-recorded its own report;
+        # quorum over the live set {1, 2} still waits on rank 2
+        assert not tp.retired
+        evs = [ev for ev in ctx.journal.tail(256)
+               if ev.get("e") == "retire_succession"]
+        assert evs and evs[-1]["pool"] == tp.taskpool_id \
+            and evs[-1]["coord"] == 1
+        # rank 2's succession re-report completes quorum -> retired
+        rec._on_recover_msg(2, {"k": "retire", "tp": tp.taskpool_id})
+        assert tp.retired and rec.retirements == 1
+        assert (2, {"k": "retired", "tp": tp.taskpool_id}) in sent
+        tp.cancel()
+    finally:
+        ctx.fini()
+        params.set("recovery_enable", 0)
+
+
 def test_refired_completion_emits_exactly_one_job_done():
     """Service seam: a recovery restart re-firing a completed pool's
     termination callbacks is absorbed below the service — exactly ONE

@@ -9,6 +9,14 @@ Submit named app jobs to a warm runtime from another process:
     # from anywhere else
     python tools/job_client.py submit gemm --set n=512 --set nb=128 \
         --priority 5 --wait
+
+    # serving-fabric tenancy (server started with --fabric): declare a
+    # completion SLO, ask for an exclusive 2-device subset elastic to
+    # 4, opt into preemption; the reply prints the quoted makespan and
+    # the admission verdict
+    python tools/job_client.py submit gemm --set n=512 --slo 30 \
+        --devices 2 --devices-max 4 --resumable
+
     python tools/job_client.py status 1
     python tools/job_client.py result 1
     python tools/job_client.py cancel 1
@@ -59,6 +67,24 @@ def main(argv=None) -> int:
     p.add_argument("--block", action="store_true",
                    help="backpressure-wait for queue room instead of "
                         "failing when the pending queue is full")
+    p.add_argument("--slo", type=float, default=None,
+                   help="fabric: declared completion SLO in seconds "
+                        "from submission; the server quotes a makespan "
+                        "and queues/deprioritizes/rejects against it")
+    p.add_argument("--devices", type=int, default=None,
+                   help="fabric: exclusive accelerator subset to carve "
+                        "(0 = temporal sharing of the remainder)")
+    p.add_argument("--devices-max", type=int, default=0,
+                   help="fabric: elastic ceiling the subset may grow "
+                        "to when devices free up")
+    p.add_argument("--resumable", action="store_true",
+                   help="fabric: allow mid-DAG preemption; the job is "
+                        "re-queued and resumed from its materialized "
+                        "tiles")
+    p.add_argument("--slo-policy", default="",
+                   choices=("", "queue", "deprioritize", "reject"),
+                   help="fabric: override the server's over-SLO policy "
+                        "for this submit")
     p.add_argument("--wait", action="store_true",
                    help="block for and print the job result")
     p.add_argument("--timeout", type=float, default=600.0,
@@ -93,6 +119,17 @@ def main(argv=None) -> int:
                "priority": args.priority, "deadline": args.deadline,
                "client": args.client, "name": args.name,
                "block": args.block}
+        # fabric admission fields (ignored by a plain JobService front)
+        if args.slo is not None:
+            req["slo"] = args.slo
+        if args.devices is not None:
+            req["devices"] = args.devices
+        if args.devices_max:
+            req["devices_max"] = args.devices_max
+        if args.resumable:
+            req["resumable"] = True
+        if args.slo_policy:
+            req["slo_policy"] = args.slo_policy
         if args.block:
             # bound the server-side backpressure wait: an unbounded wait
             # outlives the client's socket timeout and admits a job no
@@ -100,6 +137,12 @@ def main(argv=None) -> int:
             req["timeout"] = args.timeout
         reply = rpc(req, timeout=args.timeout + 10.0)
         print(json.dumps(reply, indent=2))
+        if reply.get("verdict") is not None or reply.get("rejected"):
+            eta = reply.get("quote_eta")
+            print(f"quote: eta="
+                  f"{'n/a' if eta is None else f'{eta:.3f}s'} "
+                  f"verdict={reply.get('verdict') or 'reject'}",
+                  file=sys.stderr)
         if not reply.get("ok"):
             return 1
         if args.wait:
@@ -119,6 +162,9 @@ def main(argv=None) -> int:
     else:
         reply = rpc(req)
     print(json.dumps(reply, indent=2))
+    if args.cmd == "status" and reply.get("queue_position") is not None:
+        print(f"queue position: {reply['queue_position']}",
+              file=sys.stderr)
     return 0 if reply.get("ok") else 1
 
 

@@ -34,7 +34,16 @@ now assertable from evidence):
   I5  every need-negotiation request is ANSWERED or explicitly
       degraded: per (rank, pool) the need_req count equals the
       need_ack count, and every requester round carries a terminal
-      outcome (acked / nacked / widened / exhausted).
+      outcome (acked / nacked / widened / exhausted);
+  F1  serving-fabric mesh carving (service/fabric.py): replaying
+      fabric_place / fabric_resize / fabric_release chronologically,
+      the EXCLUSIVE device subsets of distinct jobs are disjoint at
+      every instant;
+  F2  exactly one placement outcome per admitted job per admission
+      epoch: per job, count(fabric_place) - count(fabric_resume) is
+      0 or 1, and a REJECTED job records no placement at all;
+  F3  every preemption resolves: a fabric_preempt is followed by a
+      fabric_resume or a terminal job_done for that job.
 
 Usage:
     python tools/journal_audit.py <bundle-dir-or-files> --timeline
@@ -268,6 +277,83 @@ def audit(per_rank: Dict[int, List[dict]]) -> List[str]:
         violations.append(
             f"I5 rank {rank} pool={pool}: need round {rnd} was sent "
             "but records no terminal outcome")
+
+    # F1: exclusive subsets disjoint at every instant — replay the
+    # placement stream chronologically, tracking job -> device set per
+    # (rank, incarnation) fabric (the fabric is rank-local; a resize
+    # event carries the subset AFTER the change)
+    holdings: Dict[Tuple, Dict[Tuple, set]] = defaultdict(dict)
+    for ev in events:
+        e = ev.get("e")
+        if e not in ("fabric_place", "fabric_resize", "fabric_release"):
+            continue
+        fab = (ev["rank"], ev.get("inc", 0))
+        jkey = (fab, ev.get("job"))
+        if e == "fabric_release":
+            holdings[fab].pop(jkey, None)
+            continue
+        if e == "fabric_place" and (ev.get("shared")
+                                    or not ev.get("devices")):
+            continue                       # temporal sharing: no claim
+        devs = set(ev.get("devices") or ())
+        for other, held in holdings[fab].items():
+            if other != jkey and held & devs:
+                violations.append(
+                    f"F1 rank {ev['rank']}: jobs {other[1]} and "
+                    f"{ev.get('job')} hold overlapping exclusive "
+                    f"devices {sorted(held & devs)} at t={ev['t']:.6f}")
+        if devs:
+            holdings[fab][jkey] = devs
+        else:
+            holdings[fab].pop(jkey, None)  # shrunk to nothing
+
+    # F2: one placement outcome per admitted job per admission epoch
+    # (a resume opens a new epoch); a rejected job never places
+    admits: Dict[Tuple, str] = {}
+    places: Dict[Tuple, int] = defaultdict(int)
+    resumes: Dict[Tuple, int] = defaultdict(int)
+    for ev in events:
+        key = (ev["rank"], ev.get("inc", 0), ev.get("job"))
+        e = ev.get("e")
+        if e == "fabric_admit":
+            admits[key] = ev.get("verdict")
+        elif e == "fabric_place":
+            places[key] += 1
+        elif e == "fabric_resume":
+            resumes[key] += 1
+    for key, verdict in sorted(admits.items()):
+        n = places[key] - resumes[key]
+        if verdict == "reject":
+            if places[key]:
+                violations.append(
+                    f"F2 rank {key[0]} job={key[2]}: REJECTED but "
+                    f"records {places[key]} placement(s)")
+        elif n not in (0, 1):
+            violations.append(
+                f"F2 rank {key[0]} job={key[2]}: {places[key]} "
+                f"placement(s) over {resumes[key]} resume(s) — "
+                "expected one outcome per admission epoch")
+    for key in sorted(set(places) - set(admits)):
+        violations.append(
+            f"F2 rank {key[0]} job={key[2]}: placed with no admission "
+            "record")
+
+    # F3: every preemption resolves — resumed, or terminal job_done
+    # after the preemption (a cancelled-while-preempted job)
+    outstanding: Dict[Tuple, float] = {}
+    for ev in events:
+        key = (ev["rank"], ev.get("inc", 0), ev.get("job"))
+        e = ev.get("e")
+        if e == "fabric_preempt":
+            outstanding[key] = ev["t"]
+        elif e == "fabric_resume":
+            outstanding.pop(key, None)
+        elif e == "job_done" and key in outstanding:
+            outstanding.pop(key, None)
+    for (rank, _inc, job), t in sorted(outstanding.items()):
+        violations.append(
+            f"F3 rank {rank} job={job}: preempted at t={t:.6f} but "
+            "never resumed nor terminal")
     return violations
 
 

@@ -71,6 +71,16 @@
 # The shm transport gets its own rtt probe through bench_guard (the
 # same-host ring must keep beating the loopback-TCP artifact).
 #
+# r17 adds the FABRIC smoke (multi-tenant serving fabric,
+# service/fabric.py): the bench fabric probe (many small jobs/s with
+# p50/p99 admission->completion latency, self-auditing its journal)
+# goes through bench_guard like every probe, and a carved-subset smoke
+# runs 3 concurrent tenants on disjoint exclusive device subsets of an
+# 8-device CPU mesh plus one temporal-sharing job, then replays the
+# journal through tools/journal_audit.py's F1/F2/F3 fabric invariants
+# (disjoint subsets always, one placement outcome per admission,
+# preemptions resolve).
+#
 # r9 prepends the PARSECLINT gate: the project static analyzer
 # (tools/parseclint — lock discipline, event-loop blocking calls,
 # device_put aliasing, MCA knob drift, containment exception hygiene,
@@ -273,6 +283,104 @@ else
     rc=1
 fi
 rm -f "$jnl"
+echo "== premerge probe: fabric serving (jobs/s + latency, self-audited) =="
+fab="/tmp/premerge_fabric_$$.json"
+if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=fabric \
+     python "$repo/bench.py" > "$fab" 2>/dev/null; then
+    if ! python "$repo/tools/bench_guard.py" "$fab" --repo "$repo" \
+         --threshold "$threshold"; then
+        rc=1
+    fi
+else
+    echo "premerge: fabric probe FAILED to run"
+    rc=1
+fi
+rm -f "$fab"
+echo "== premerge probe: fabric carved-subset smoke (3 tenants, audited) =="
+# three concurrent tenants on disjoint exclusive 2-device subsets of an
+# 8-device CPU mesh plus one temporal-sharing job; every placement is
+# journaled and the bundle must pass journal_audit's F1/F2/F3 fabric
+# invariants.  Concurrency is asserted from the journal itself: the
+# third exclusive placement lands before any of the three releases.
+if ! JAX_PLATFORMS=cpu \
+     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     REPO="$repo" python - <<'EOF'
+import os, sys, time
+repo = os.environ["REPO"]
+sys.path.insert(0, repo)
+sys.path.insert(0, os.path.join(repo, "tools"))
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+from parsec_tpu.service.fabric import ServingFabric
+import journal_audit
+
+NT = 12
+
+def chain_factory(i):
+    def factory():
+        A = TwoDimBlockCyclic(mb=4, nb=4, lm=4, ln=4)
+        A.data_of(0, 0).copy_on(0).payload[:] = 0.0
+        p = PTG(f"smoke{i}", NT=NT)
+        p.task("S", k=Range(0, NT - 1)) \
+            .affinity(lambda k, A=A: A(0, 0)) \
+            .flow("T", "RW",
+                  IN(DATA(lambda A=A: A(0, 0)), when=lambda k: k == 0),
+                  IN(TASK("S", "T", lambda k: dict(k=k - 1)),
+                     when=lambda k: k > 0),
+                  OUT(TASK("S", "T", lambda k: dict(k=k + 1)),
+                      when=lambda k: k < NT - 1),
+                  OUT(DATA(lambda A=A: A(0, 0)),
+                      when=lambda k: k == NT - 1)) \
+            .body(lambda T: (time.sleep(0.02), T + 1.0)[1])
+        return p.build()
+    return factory
+
+with ServingFabric(nb_cores=4, max_active=8) as svc:
+    mesh = len(svc.context.accelerator_spaces())
+    if mesh < 7:
+        raise SystemExit(
+            f"premerge: fabric smoke wants an 8-device mesh, got {mesh}")
+    excl = [svc.submit(chain_factory(i), devices=2, name=f"excl{i}")
+            for i in range(3)]
+    shared = svc.submit(chain_factory(9), name="shared")
+    for j in excl + [shared]:
+        if not j.wait(timeout=120.0):
+            raise SystemExit(f"premerge: fabric smoke job {j} hung")
+    bundle = {0: [svc.context.journal.snapshot()]}
+
+evs = bundle[0][0]["events"]
+excl_ids = {j.job_id for j in excl}
+placed = {}          # job -> index of its first exclusive placement
+released = []        # indices of releases of the three tenants
+for idx, ev in enumerate(evs):
+    if (ev.get("e") == "fabric_place" and not ev.get("shared")
+            and ev.get("job") in excl_ids):
+        placed.setdefault(ev["job"], idx)
+        if len(ev.get("devices") or ()) != 2:
+            raise SystemExit(f"premerge: tenant {ev['job']} placed on "
+                             f"{ev.get('devices')} (wanted 2 devices)")
+    elif ev.get("e") == "fabric_release" and ev.get("job") in excl_ids:
+        released.append(idx)
+if len(placed) != 3:
+    raise SystemExit(f"premerge: {len(placed)}/3 tenants placed "
+                     "exclusively")
+if not any(ev.get("e") == "fabric_place" and ev.get("shared")
+           and ev.get("job") == shared.job_id for ev in evs):
+    raise SystemExit("premerge: temporal-sharing job never placed")
+if released and max(placed.values()) > min(released):
+    raise SystemExit("premerge: tenants never held their subsets "
+                     "concurrently (3rd placement after 1st release)")
+violations = journal_audit.audit(bundle)
+if violations:
+    raise SystemExit("premerge: fabric journal audit FAILED: "
+                     + "; ".join(violations[:3]))
+print(f"premerge: fabric smoke 3 exclusive tenants + 1 shared on "
+      f"{mesh}-device mesh, concurrent placements, audit clean")
+EOF
+then
+    echo "premerge: fabric carved-subset smoke FAILED"
+    rc=1
+fi
 echo "== premerge probe: chaos (seeded fault plans, no-hang invariant) =="
 # 8 seeds = one pass over the quick catalog, which now includes the
 # shm-transport kill, the recv-reorder legs, AND the r12 recovery
