@@ -762,6 +762,26 @@ def _tasks_budget(ctx, total_us: float, k: int = 4000):
             "progress_us": round(max(0.0, total_us - construction), 3)}
 
 
+def _bail_snapshot():
+    """Current per-reason fast-path bailout counters ({} when the C
+    extension is absent) — benches report the DELTA across their timed
+    window so a coverage regression (tasks silently popping back to
+    Python) shows in the JSON next to the throughput it cost."""
+    try:
+        from parsec_tpu.native import load_schedext
+        se = load_schedext()
+        if se is not None and hasattr(se, "bailout_stats"):
+            return dict(se.bailout_stats())
+    except Exception:
+        pass
+    return {}
+
+
+def _bail_delta(before, after):
+    return {k: after[k] - before.get(k, 0) for k in after
+            if after[k] - before.get(k, 0)}
+
+
 def run_tasks_bench(n: int = 20000):
     """Empty-body task throughput, tasks/s — the DAG-scheduling
     efficiency proxy (insert+wait over n no-op tasks; every runtime
@@ -785,10 +805,12 @@ def run_tasks_bench(n: int = 20000):
             tr = install_causal_tracer(ctx, prof)
         ctx.add_taskpool(_empty_pool(n // 10))   # warm
         ctx.wait()
+        bail0 = _bail_snapshot()
         t0 = time.perf_counter()
         ctx.add_taskpool(_empty_pool(n))
         ctx.wait()
         dt = time.perf_counter() - t0
+        bailouts = _bail_delta(bail0, _bail_snapshot())
         budget = _tasks_budget(ctx, dt / n * 1e6)
         if mod is not None:
             mod.uninstall(ctx)
@@ -797,7 +819,149 @@ def run_tasks_bench(n: int = 20000):
                   1 if ctx.scheduler.name == "native" else 0}
         doorbell = {"suppressed": ctx._db_suppressed}
     return n / dt, {"native": native, "budget": budget,
-                    "doorbell": doorbell}
+                    "doorbell": doorbell, "bailouts": bailouts}
+
+
+def _chain_pool(nc: int, nb: int):
+    """``nc`` independent RW data chains of length ``nb`` — the
+    NON-trivial throughput workload: every task carries a real data
+    flow (FromDesc binding at k==0, FromTask + local ToTask delivery
+    walk inside each chain), so the whole prepare/release/complete
+    machinery is on the clock, not just pop+hook."""
+    from parsec_tpu.dsl.ptg import DATA, IN, OUT, PTG, Range, TASK
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    A = VectorTwoDimCyclic(1, nc).from_array(np.zeros(nc, np.float32))
+    g = PTG("chains", NC=nc, NB=nb)
+    g.task("S", c=Range(0, nc - 1), k=Range(0, nb - 1)) \
+        .affinity(lambda c, k: A(c)) \
+        .flow("T", "RW",
+              IN(DATA(lambda c, k: A(c)), when=lambda c, k: k == 0),
+              IN(TASK("S", "T", lambda c, k: dict(c=c, k=k - 1)),
+                 when=lambda c, k: k > 0),
+              OUT(TASK("S", "T", lambda c, k: dict(c=c, k=k + 1)),
+                  when=lambda c, k, NB=nb: k < nb - 1)) \
+        .body(lambda T, c, k: T.__iadd__(1.0) and None)
+    return g.build(), A
+
+
+def run_ntasks_bench(n: int = 12000):
+    """NON-trivial task throughput, tasks/s: independent RW chains
+    where every task binds real data and releases a local successor —
+    the workload the r17 extended C progress chain (per-class binding
+    tables + C-side delivery walk) exists for.  The trivial probe
+    (``tasks``) bounds pure scheduling; this probe bounds the full
+    dataflow path.  ``bailouts`` in the JSON must stay empty on the
+    native path — any non-zero reason means tasks fell back to Python
+    and the number no longer measures the C chain."""
+    from parsec_tpu.core.context import Context
+    nb = int(os.environ.get("PARSEC_BENCH_CHAIN_LEN", 24))
+    nc = max(1, n // nb)
+    n = nc * nb
+    with Context(nb_cores=int(os.environ.get("PARSEC_BENCH_CORES", 4))) \
+            as ctx:
+        wp, _ = _chain_pool(max(1, nc // 10), nb)   # warm
+        ctx.add_taskpool(wp)
+        ctx.wait()
+        tp, A = _chain_pool(nc, nb)
+        bail0 = _bail_snapshot()
+        t0 = time.perf_counter()
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        dt = time.perf_counter() - t0
+        bailouts = _bail_delta(bail0, _bail_snapshot())
+        native = {"sched_native":
+                  1 if ctx.scheduler.name == "native" else 0}
+        # every chain ran end to end: the throughput number is only
+        # valid if the dataflow actually happened
+        vals = np.asarray(A(0).resolve().copy_on(0).payload)
+        if not np.allclose(vals, float(nb)):
+            raise RuntimeError(
+                f"ntasks bench: chain results wrong (want {nb}, got "
+                f"{vals[:4]}...) — throughput number is invalid")
+    return n / dt, {"native": native, "bailouts": bailouts,
+                    "chains": {"nc": nc, "nb": nb}, "host": _host_info()}
+
+
+def _agg_worker(ctx, rank: int, nranks: int, n: int):
+    """Per-rank body of the aggregate probe: the trivial headline
+    workload with a live RemoteDepEngine attached — every task has
+    zero remote successors, so r17 comm-attached fast-complete must
+    keep them ALL on the C chain (bailouts delta reports whether it
+    did)."""
+    ctx.add_taskpool(_empty_pool(max(200, n // 10)))   # warm
+    ctx.wait(timeout=120)
+    bail0 = _bail_snapshot()
+    t0 = time.perf_counter()
+    ctx.add_taskpool(_empty_pool(n))
+    ctx.wait(timeout=300)
+    dt = time.perf_counter() - t0
+    return (n / dt, dt, _bail_delta(bail0, _bail_snapshot()),
+            1 if ctx.scheduler.name == "native" else 0)
+
+
+def run_aggregate_bench(n: int = 12000):
+    """Multi-rank AGGREGATE task throughput over shm, tasks/s — the
+    first whole-host scheduling-capacity number: N same-host ranks
+    (self-scaled to the core count, floor 2 so the 1-core CI container
+    still exercises the comm-attached path) each run the trivial
+    workload with comm attached; the headline is the sum of per-rank
+    rates, with per-rank scaling efficiency vs a solo comm-attached
+    rank riding along.  On an oversubscribed host efficiency measures
+    time-slicing fairness, not speedup — the JSON records the core
+    inventory so readers can tell."""
+    from parsec_tpu.comm.launch import run_distributed
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    nranks = int(os.environ.get("PARSEC_BENCH_AGG_RANKS",
+                                max(2, min(cores, 8))))
+    nb_cores = max(1, cores // nranks)
+    prior = os.environ.get("PARSEC_MCA_COMM_TRANSPORT")
+    os.environ["PARSEC_MCA_COMM_TRANSPORT"] = "shm"
+    try:
+        solo = run_distributed(_agg_worker, 1, args=(n,),
+                               nb_cores=nb_cores, timeout=600)
+        res = run_distributed(_agg_worker, nranks, args=(n,),
+                              nb_cores=nb_cores, timeout=600)
+    finally:
+        if prior is None:
+            os.environ.pop("PARSEC_MCA_COMM_TRANSPORT", None)
+        else:
+            os.environ["PARSEC_MCA_COMM_TRANSPORT"] = prior
+    rates = [r[0] for r in res]
+    # multi-core-only leg: the true scaling curve needs >= 1 core per
+    # rank; on a smaller host the probe still runs as an N=2 smoke
+    # (the comm-attached C-chain coverage is what it checks there) and
+    # the JSON says WHY the scaling number is not a scaling number
+    skipped = {}
+    if cores < nranks:
+        skipped["full_scale"] = (
+            f"{cores} core(s) < {nranks} ranks: N=2 smoke only — "
+            "ranks time-slice, scaling_efficiency measures fairness, "
+            "not speedup")
+    # aggregate over the SLOWEST rank's wall time, not a sum of rates:
+    # on an oversubscribed host the ranks' windows differ wildly and a
+    # rate sum double-counts the slices — this is the number a user
+    # sending nranks*n tasks at the host actually experiences
+    aggregate = nranks * n / max(r[1] for r in res)
+    solo_rate = solo[0][0]
+    eff = (aggregate / nranks / solo_rate) if solo_rate else 0.0
+    bailouts: dict = {}
+    for r in res:
+        for k, v in r[2].items():
+            bailouts[k] = bailouts.get(k, 0) + v
+    return aggregate, {
+        "ranks": nranks,
+        "nb_cores_per_rank": nb_cores,
+        "per_rank_tasks_s": [round(r, 1) for r in rates],
+        "solo_tasks_s": round(solo_rate, 1),
+        "scaling_efficiency": round(eff, 4),
+        "native": {"sched_native": res[0][3]},
+        "bailouts": bailouts,
+        "host": _host_info(),
+        **({"skipped": skipped} if skipped else {}),
+    }
 
 
 def _overhead_probe(knobs, label: str, n: int = 20000):
@@ -1116,6 +1280,10 @@ _AUX_MODES = {
     "rtt": (run_rtt_bench, "task_rtt", "us/hop", 1000.0, False),
     "bw": (run_bw_bench, "dataflow_bandwidth", "MB/s", 1000.0, True),
     "tasks": (run_tasks_bench, "task_throughput", "tasks/s", 10000.0, True),
+    "ntasks": (run_ntasks_bench, "task_throughput_nontrivial", "tasks/s",
+               10000.0, True),
+    "aggregate": (run_aggregate_bench, "aggregate_task_throughput",
+                  "tasks/s", 20000.0, True),
     "telemetry": (run_telemetry_bench, "telemetry_overhead", "ratio",
                   0.05, False),
     "journal": (run_journal_bench, "journal_overhead", "ratio",

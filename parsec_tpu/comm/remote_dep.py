@@ -154,6 +154,13 @@ class RemoteDepEngine:
         self.ce = ce
         self.context = context
         context.comm = self
+        # the inline-poll auto probe must see the affinity of NOW: a
+        # fabric-carved worker is re-pinned between Context build and
+        # comm attach, and a stale 1-core reading would never arm the
+        # spare-core poll (context._recompute_db_spin)
+        recompute = getattr(context, "_recompute_db_spin", None)
+        if recompute is not None:
+            recompute()
         self.rank = ce.rank
         self.nranks = ce.nranks
         self.eager = int(params.get("comm_eager_limit", 65536))

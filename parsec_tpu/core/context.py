@@ -190,21 +190,7 @@ class Context:
         #: per-worker termdet batch, the inlined-poll window, and the
         #: waiting-flag counter ring_doorbell suppresses against
         self._termdet_batch = max(1, int(params.get("termdet_batch", 64)))
-        try:
-            import os as _os
-            ncores = len(_os.sched_getaffinity(0))
-        except (AttributeError, OSError):
-            import os as _os
-            ncores = _os.cpu_count() or 1
-        # the spin needs a spare core: on a 1-core host a polling
-        # worker steals the GIL/CPU from the very comm loop whose
-        # delivery it is waiting for (measured: shm rtt 694 -> 1000
-        # us/hop with the spin forced on 1 core — BENCH.md r14);
-        # auto mode (1) arms it only with a spare core, 2 forces
-        ip = int(params.get("comm_inline_poll", 1))
-        self._db_spin_s = (
-            max(0, int(params.get("doorbell_coalesce_us", 150))) * 1e-6
-            if ip == 2 or (ip == 1 and ncores > 1) else 0.0)
+        self._recompute_db_spin()
         self._db_waiters = 0          # GIL-atomic int (plain reads)
         self._db_suppressed = 0       # doorbells coalesced away (stats)
 
@@ -323,6 +309,32 @@ class Context:
         self._device_spans = (self._causal_tracer is not None
                               or (fr is not None
                                   and "device" in fr.classes))
+
+    def _recompute_db_spin(self) -> None:
+        """Arm (or re-arm) the inlined comm-poll window from the
+        CURRENT core affinity.  The spin needs a spare core: on a
+        1-core host a polling worker steals the GIL/CPU from the very
+        comm loop whose delivery it is waiting for (measured: shm rtt
+        694 -> 1000 us/hop with the spin forced on 1 core — BENCH.md
+        r14); auto mode (1) arms it only with a spare core, 2 forces.
+
+        Called from ``__init__`` AND whenever a comm engine attaches
+        (comm/remote_dep.py): a fabric-carved worker is re-pinned
+        after its Context was built, so the auto probe must read
+        ``sched_getaffinity`` at attach time — an import-time or
+        init-time reading of 1 core on a multi-core host would never
+        arm the spare-core poll.  Workers pick the new window up on
+        their next idle pass (worker_loop re-reads per wait)."""
+        try:
+            import os as _os
+            ncores = len(_os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            import os as _os
+            ncores = _os.cpu_count() or 1
+        ip = int(params.get("comm_inline_poll", 1))
+        self._db_spin_s = (
+            max(0, int(params.get("doorbell_coalesce_us", 150))) * 1e-6
+            if ip == 2 or (ip == 1 and ncores > 1) else 0.0)
 
     def telemetry_incident(self, reason: str):
         """Fire the flight recorder's incident dump (no-op unarmed).

@@ -450,7 +450,6 @@ def worker_loop(es) -> None:
     misses = 0
     done_since = 0
     n = 0
-    spin_s = ctx._db_spin_s
     while not ctx.finished:
         sel_fired = False
         if quantum is not None:
@@ -479,6 +478,11 @@ def worker_loop(es) -> None:
                 done_since = 0
             misses += 1
             ctx.flush_ici()
+            # re-read per idle moment, not cached at loop start: a comm
+            # engine attaching after workers spin up (fabric-carved
+            # meshes attach lazily) re-probes the core count and flips
+            # this on — the running workers must see it
+            spin_s = ctx._db_spin_s
             if misses <= 2 and spin_s > 0 and ctx.comm is not None:
                 # worker-inlined comm poll (comm_inline_poll): cover
                 # the just-went-idle window before paying a condvar

@@ -835,12 +835,22 @@ class DTDTaskpool(Taskpool):
         payloads (the DTD twin of the r13 stale-body version taint).
         A body that FAILED may have mutated its tiles PARTWAY: those
         bytes match no version at all, so the pool latches unskippable
-        instead of claiming the write landed."""
+        instead of claiming the write landed.
+
+        The position is completion evidence too: the landed map must
+        never run AHEAD of the frontier.  A body straddling the fence
+        (claimed pre-restart, completed post-fence) that advanced
+        applied_ver without recording its position would leave NO rank
+        holding the frontier's cut bytes — the agreement would cut
+        prefix 0 and force a full replay on a fully-completed write."""
         if failed:
             if state.local_writes and self._skip_note is None:
                 self._skip_note = "stale body failed mid-write"
             return
         self._advance_applied(state.local_writes)
+        if self._lineage is not None and state.insert_pos is not None:
+            with self._window:
+                self._pos_done.add(state.insert_pos)
 
     def _advance_applied(self, local_writes) -> None:
         """A completed body's WHOLE-COVERING writes are LANDED values:

@@ -714,6 +714,21 @@ class RuntimeMetrics:
                                   st.get("pops", 0)))
         out.append(gauge_sample("parsec_sched_native_pending",
                                 st.get("pending", 0)))
+        # per-reason fast-path bailouts: the attribution for "why is the
+        # C chain not taking my tasks" — a comm_buffered or non_trivial
+        # spike localizes a coverage regression without a bench rerun
+        try:
+            from parsec_tpu.native import load_schedext
+            se = load_schedext()
+            bail_fn = getattr(se, "bailout_stats", None)
+            if bail_fn is not None:
+                for reason, n in sorted(bail_fn().items()):
+                    if n:
+                        out.append(counter_sample(
+                            "parsec_sched_native_bailouts_total", n,
+                            {"reason": reason}))
+        except Exception:
+            pass
         return out
 
     def _collect_devices(self) -> List[dict]:

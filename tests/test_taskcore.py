@@ -18,7 +18,7 @@ import pytest
 
 from parsec_tpu import Context
 from parsec_tpu.data.matrix import VectorTwoDimCyclic
-from parsec_tpu.dsl.ptg import DATA, IN, OUT, PTG, Range, TASK
+from parsec_tpu.dsl.ptg import DATA, IN, NEW, OUT, PTG, Range, TASK
 from parsec_tpu.native import load_schedext
 from parsec_tpu.utils.mca import params
 
@@ -33,11 +33,24 @@ _EVENTS = ("select", "exec_begin", "exec_end", "complete_exec",
            "task_discard")
 
 
+def _bail_delta(before):
+    after = se.bailout_stats()
+    return {k: after[k] - before.get(k, 0) for k in after
+            if after[k] - before.get(k, 0)}
+
+
 def _mixed_run(native: int):
-    """One mixed DAG — a trivial CTL class (the C chain's fast path)
-    plus an RW data chain (the Python fallback path) — returning every
-    observable the parity property compares."""
+    """One mixed DAG — a trivial CTL class (the C chain's r14 fast
+    path) plus an RW data chain (the r17 EXTENDED chain: FromDesc
+    binding, FromTask inputs, local ToTask delivery walks all C-side)
+    — returning every observable the parity property compares.
+
+    ICI is disabled for BOTH legs: the conftest's virtual 8-device
+    mesh attaches an IciEngine, whose deferred-placement walk rides
+    release_deps and (correctly) gates the extended chain off — this
+    property is about the chain, so make it eligible."""
     params.set("sched_native", native)
+    params.set("comm_ici_enabled", 0)
     try:
         order = []
         events = []       # list.append is GIL-atomic across workers
@@ -62,6 +75,7 @@ def _mixed_run(native: int):
                       when=lambda k, NB=NB: k < NB - 1)) \
             .body(chain_body)
         tp = g.build()
+        bail0 = se.bailout_stats()
         with Context(nb_cores=2) as ctx:
             assert (ctx.scheduler.name == "native") == bool(native)
             for ev in _EVENTS:
@@ -74,8 +88,9 @@ def _mixed_run(native: int):
         return {"order": order, "value": val, "counts": counts,
                 "nb_tasks": tp.nb_tasks,
                 "pending": tp.nb_pending_actions,
-                "total": NE + NB}
+                "total": NE + NB, "bailouts": _bail_delta(bail0)}
     finally:
+        params.unset("comm_ici_enabled")
         params.unset("sched_native")
 
 
@@ -96,6 +111,10 @@ def test_native_vs_python_parity_property():
     assert nat["counts"]["exec_begin"] == nat["total"]
     assert nat["counts"]["exec_end"] == nat["total"]
     assert nat["counts"]["task_discard"] == 0
+    # r17: the RW chain is C-chain-covered end to end — not one task
+    # fell back to Python (the coverage property the bailout counters
+    # exist to gate)
+    assert nat["bailouts"] == {}
 
 
 def _lineage_run(native: int):
@@ -142,6 +161,179 @@ def test_lineage_ring_parity():
     assert _lineage_run(1) == _lineage_run(0)
 
 
+def _new_binding_run(native: int):
+    """NEW-arena scratch binding through the extended chain: MAKE binds
+    a fresh arena block (CK_NEW), fills it, and hands it to USE over a
+    ToTask edge; USE folds it into a FromDesc-bound RW tile in place.
+    Every binding kind the r17 prepare covers, in one DAG.  ICI off as
+    in ``_mixed_run`` (the virtual test mesh would gate the chain)."""
+    params.set("sched_native", native)
+    params.set("comm_ici_enabled", 0)
+    try:
+        NI = 4
+        A = VectorTwoDimCyclic(1, NI).from_array(
+            np.zeros(NI, np.float32))
+        g = PTG("newbind", NI=NI)
+        g.arena("tmp", (2,))
+        g.task("MAKE", i=Range(0, NI - 1)) \
+            .affinity(lambda i: A(i)) \
+            .flow("W", "WRITE",
+                  IN(NEW("tmp")),
+                  OUT(TASK("USE", "W", lambda i: dict(i=i)))) \
+            .body(lambda W: np.full_like(W, 3.0))
+        g.task("USE", i=Range(0, NI - 1)) \
+            .affinity(lambda i: A(i)) \
+            .flow("W", "READ",
+                  IN(TASK("MAKE", "W", lambda i: dict(i=i)))) \
+            .flow("T", "RW", IN(DATA(lambda i: A(i)))) \
+            .body(lambda W, T: T.__iadd__(float(np.sum(W))) and None)
+        tp = g.build()
+        bail0 = se.bailout_stats()
+        with Context(nb_cores=2) as ctx:
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=30)
+        vals = [float(np.asarray(A(i).resolve().copy_on(0).payload)[0])
+                for i in range(NI)]
+        return {"vals": vals, "nb_tasks": tp.nb_tasks,
+                "pending": tp.nb_pending_actions,
+                "bailouts": _bail_delta(bail0)}
+    finally:
+        params.unset("comm_ici_enabled")
+        params.unset("sched_native")
+
+
+def test_new_arena_binding_parity():
+    nat = _new_binding_run(1)
+    py = _new_binding_run(0)
+    assert nat["vals"] == py["vals"] == [6.0] * 4
+    assert nat["nb_tasks"] == py["nb_tasks"] == 0
+    assert nat["pending"] == py["pending"] == 0
+    assert nat["bailouts"] == {}
+
+
+def _shm_mix_worker(ctx, rank, nranks):
+    """Per-rank body of the 2-rank interleave property: a cross-rank
+    RW chain (remote activations, Python path by design), a rank-LOCAL
+    RW chain and trivial CTL tasks (both C-chain-eligible even with
+    the RemoteDepEngine attached — r17 comm-attached fast-complete),
+    all in one taskpool."""
+    import numpy as np
+    from parsec_tpu.data.matrix import VectorTwoDimCyclic
+    from parsec_tpu.dsl.ptg.api import DATA, IN, OUT, PTG, Range, TASK
+    from parsec_tpu.native import load_schedext
+    se_ = load_schedext()
+    NT, NB, NE = 8, 6, 24
+    V = VectorTwoDimCyclic(mb=4, lm=NT * 4, nodes=nranks, myrank=rank)
+    for m, _ in V.local_tiles():
+        V.data_of(m).copy_on(0).payload[:] = 0.0
+    L = VectorTwoDimCyclic(mb=1, lm=nranks, nodes=nranks, myrank=rank,
+                           name="L")
+    for m, _ in L.local_tiles():
+        L.data_of(m).copy_on(0).payload[:] = 0.0
+    events = []
+    g = PTG("mix", NT=NT, NB=NB, NE=NE)
+    g.task("E", i=Range(0, NE - 1)) \
+        .affinity(lambda i, L=L, nr=nranks: L(i % nr)) \
+        .flow("x", "CTL").body(lambda: None)
+    g.task("S", c=Range(0, nranks - 1), k=Range(0, NB - 1)) \
+        .affinity(lambda c, k, L=L: L(c)) \
+        .flow("T", "RW",
+              IN(DATA(lambda c, k, L=L: L(c)), when=lambda c, k: k == 0),
+              IN(TASK("S", "T", lambda c, k: dict(c=c, k=k - 1)),
+                 when=lambda c, k: k > 0),
+              OUT(TASK("S", "T", lambda c, k: dict(c=c, k=k + 1)),
+                  when=lambda c, k, NB=NB: k < NB - 1)) \
+        .body(lambda T: T + 1.0)
+    g.task("R", k=Range(0, NT - 1)) \
+        .affinity(lambda k, V=V: V(k)) \
+        .flow("T", "RW",
+              IN(DATA(lambda k, V=V: V(k)), when=lambda k: k == 0),
+              IN(TASK("R", "T", lambda k: dict(k=k - 1)),
+                 when=lambda k: k > 0),
+              OUT(TASK("R", "T", lambda k: dict(k=k + 1)),
+                  when=lambda k, NT=NT: k < NT - 1),
+              OUT(DATA(lambda k, V=V: V(k)))) \
+        .body(lambda T: T + 1.0)
+    tp = g.build()
+    for ev in ("select", "exec_begin", "exec_end", "complete_exec",
+               "task_discard"):
+        ctx.pins_register(ev, lambda es, e, t: events.append(e))
+    bail0 = dict(se_.bailout_stats()) if se_ else {}
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=60)
+    bail = {}
+    if se_ is not None:
+        after = se_.bailout_stats()
+        bail = {k: after[k] - bail0.get(k, 0) for k in after
+                if after[k] - bail0.get(k, 0)}
+    cross = {m: float(np.asarray(
+        V.data_of(m).pull_to_host().payload)[0])
+        for m, _ in V.local_tiles()}
+    local = {m: float(np.asarray(L.data_of(m).copy_on(0).payload)[0])
+             for m, _ in L.local_tiles()}
+    counts = {ev: events.count(ev) for ev in set(events)} \
+        if events else {}
+    return {"cross": cross, "local": local, "counts": counts,
+            "nb_tasks": tp.nb_tasks, "pending": tp.nb_pending_actions,
+            "bailouts": bail,
+            "native": 1 if ctx.scheduler.name == "native" else 0}
+
+
+def _shm_mix(native: int):
+    from parsec_tpu.comm.launch import run_distributed
+    env = {"PARSEC_MCA_SCHED_NATIVE": str(native),
+           "PARSEC_MCA_COMM_TRANSPORT": "shm",
+           # the conftest's 8-device virtual mesh would attach an
+           # IciEngine in the children and gate the extended chain
+           "PARSEC_MCA_COMM_ICI_ENABLED": "0"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        return run_distributed(_shm_mix_worker, 2, timeout=120)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_two_rank_shm_fast_complete_interleave():
+    """Comm-attached fast-complete under real remote traffic: local
+    trivial + local data-chain tasks ride the C chain while the
+    cross-rank chain's activations interleave through the shm
+    transport — identical results, per-rank PINS counts, and termdet
+    finals vs the Python path, and the ONLY bailouts on the native
+    legs are the cross-rank chain's own (plan-time comm_buffered /
+    writeback), never the local classes'."""
+    nat = _shm_mix(1)
+    py = _shm_mix(0)
+    for r in range(2):
+        assert nat[r]["native"] == 1 and py[r]["native"] == 0
+        # identical DAG results per rank
+        assert nat[r]["cross"] == py[r]["cross"]
+        assert nat[r]["local"] == py[r]["local"]
+        # local chains accumulated NB increments in place
+        assert list(nat[r]["local"].values()) == [6.0]
+        # per-rank PINS parity and drained termdet on both paths
+        assert nat[r]["counts"] == py[r]["counts"]
+        assert nat[r]["nb_tasks"] == py[r]["nb_tasks"] == 0
+        assert nat[r]["pending"] == py[r]["pending"] == 0
+        # the ONLY tasks that left the C chain are the 4 cross-rank R
+        # tasks this rank owns: a remote ToTask successor bails at
+        # plan time (comm_buffered), the final writeback task bails
+        # statically — the 24/2 E and 6 S tasks contributed ZERO,
+        # which is the comm-attached fast-complete property
+        bail = nat[r]["bailouts"]
+        assert sum(bail.values()) == 4, bail
+        assert bail.get("comm_buffered", 0) >= 3, bail
+    # cross-rank chain value: tile k ends at k+1, merged across ranks
+    merged = {}
+    for r in nat:
+        merged.update(r["cross"])
+    assert merged == {k: float(k + 1) for k in range(8)}
+
+
 def test_taskcore_object_contract():
     """vt.build_one's TaskCore matches Task field-for-field for the
     attributes every runtime layer reads, shares the process-global
@@ -181,8 +373,10 @@ def test_taskcore_object_contract():
 
 
 def test_nontrivial_class_has_no_trivial_vtable():
-    """Data flows, multiple incarnations, or a DTD release hook must
-    keep the class off the C progress chain (construction stays)."""
+    """Data flows keep a class off the TRIVIAL chain, but a single-cpu
+    class with binding-table-coverable flows is extended-chain
+    (cchain) eligible since r17; multiple incarnations keep a class
+    off both chains (construction stays)."""
     from parsec_tpu.core.task import (Dep, FromDesc, RW, TaskClass)
     from parsec_tpu.core.taskpool import ParameterizedTaskpool
     params.set("sched_native", 1)
@@ -194,6 +388,13 @@ def test_nontrivial_class_has_no_trivial_vtable():
             body=lambda es, task: None))
         vt = tc.native_vt()
         assert vt is not None and not vt.trivial
+        assert vt.cchain == 1
+        tc2 = tp.add_task_class(TaskClass(
+            "D2", params=[("i", lambda g, l: range(2))],
+            incarnations=[("cpu", lambda es, task: None),
+                          ("tpu", lambda es, task: None)]))
+        vt2 = tc2.native_vt()
+        assert vt2 is None or (not vt2.trivial and vt2.cchain == 0)
     finally:
         params.unset("sched_native")
 
@@ -272,9 +473,10 @@ def test_doorbell_suppression_no_lost_wakeup():
 
 @pytest.mark.slow
 def test_chaos_kill_with_c_task_core_active():
-    """A mid-run rank kill with the C task core explicitly active: the
+    """A mid-run rank kill with the C task core explicitly active —
+    including the r17 extended chain, which sched_native=1 arms: the
     recover catalog's minimal-replay case must still pass (lineage
-    recorded from completions while sched_native=1 — the C chain's
+    recorded from completions while sched_native=1 — BOTH C chains'
     lineage gate defers those pools to the recording path)."""
     env = dict(os.environ)
     env["PARSEC_MCA_SCHED_NATIVE"] = "1"

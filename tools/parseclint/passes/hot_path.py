@@ -49,9 +49,17 @@ from tools.parseclint.passes.evloop_blocking import _Index, FuncKey
 
 PASS_ID = "PCL-HOT"
 
-#: the scheduler-core chain, rooted by module-level name
+#: the scheduler-core chain, rooted by module-level name.  r17 adds
+#: the engine functions the extended C chain now has C-resident twins
+#: for (prepare_input / release_deps' delivery walk / deliver_dep) and
+#: the native-path containment helpers: per-task lock or dict work
+#: creeping into a Python twin silently diverges it from the C chain
+#: it must stay byte-identical with — and still costs every bailed-out
+#: task.
 _ROOT_NAMES = frozenset(("task_progress", "complete_execution",
-                         "execute", "schedule", "worker_loop"))
+                         "execute", "schedule", "worker_loop",
+                         "deliver_dep", "release_deps", "prepare_input",
+                         "_native_body_failed", "_native_hook_return"))
 
 #: lock-ish context-manager / attribute name shapes
 _LOCKY = re.compile(r"(?:^|_)(?:lock|cond|mutex|sem(?:aphore)?)\d*$",

@@ -61,6 +61,10 @@
 #             preserves the old contract's magnitude while surviving
 #             base speedups; bench telemetry mode reports both)
 #         native_margin: min native/fallback tasks ratio (default 1.05)
+#         ntasks_margin (arg 6): min native/fallback ratio on the
+#             NON-trivial (data-carrying chain) probe (default 1.3) —
+#             the r17 extended-chain gate; the same leg fails on ANY
+#             native-path bailout (coverage, not just speed)
 # r11 adds the NATIVE-vs-PYTHON pairing: the tasks probe (which runs
 # with the native scheduler hot path by default) is re-run with
 # PARSEC_MCA_SCHED_NATIVE=0 — the fallback line goes through
@@ -202,6 +206,103 @@ else
 fi
 rm -f "$fb"
 rm -f "$tasks_off" "$on"
+echo "== premerge probe: native-vs-python A/B (ntasks, data-carrying chains) =="
+# r17: the EXTENDED C progress chain (per-class binding tables +
+# C-side local delivery walk) gets its own paired A/B on the
+# non-trivial probe — native must beat the fallback by
+# >= $ntasks_margin (default 1.3) AND report ZERO bailouts (any
+# non-empty reason means data tasks silently popped back to Python
+# and the number no longer measures the chain).
+ntasks_margin="${6:-1.3}"
+nt_nat="/tmp/premerge_ntasks_$$.json"
+nt_fb="/tmp/premerge_ntasks_fb_$$.json"
+if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=ntasks \
+     python "$repo/bench.py" > "$nt_nat" 2>/dev/null \
+   && JAX_PLATFORMS=cpu PARSEC_BENCH_APP=ntasks \
+     PARSEC_MCA_SCHED_NATIVE=0 python "$repo/bench.py" > "$nt_fb" \
+     2>/dev/null; then
+    if ! python "$repo/tools/bench_guard.py" "$nt_nat" --repo "$repo" \
+         --threshold "$threshold"; then
+        rc=1
+    fi
+    if ! python - "$nt_nat" "$nt_fb" "$ntasks_margin" <<'EOF'
+import json, sys
+def last_json(path):
+    for line in reversed(open(path).read().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"premerge: no JSON in {path}")
+nat, fb = last_json(sys.argv[1]), last_json(sys.argv[2])
+margin = float(sys.argv[3])
+active = (nat.get("native") or {}).get("sched_native")
+bail = nat.get("bailouts") or {}
+ratio = nat["value"] / fb["value"] if fb["value"] else float("inf")
+print(f"premerge: non-trivial chain A/B {fb['value']:.0f} -> "
+      f"{nat['value']:.0f} tasks/s (x{ratio:.2f}, need >= x{margin}; "
+      f"native active: {active}; bailouts: {bail or 'none'})")
+if active != 1:
+    print("premerge: NATIVE PATH INACTIVE in the ntasks probe "
+          "(build degraded?) — a no-op extended chain fails pre-merge")
+    sys.exit(1)
+if bail:
+    print("premerge: UNEXPECTED BAILOUTS on the native ntasks probe — "
+          "data tasks fell back to Python; the extended chain lost "
+          "coverage")
+    sys.exit(1)
+sys.exit(0 if ratio >= margin else 1)
+EOF
+    then
+        rc=1
+    fi
+else
+    echo "premerge: ntasks probe FAILED to run"
+    rc=1
+fi
+rm -f "$nt_nat" "$nt_fb"
+echo "== premerge probe: aggregate multi-rank throughput (shm) =="
+# r17: N same-host ranks over shm, each with a live RemoteDepEngine —
+# comm-attached fast-complete must keep every (purely local) task on
+# the C chain: zero comm_buffered bailouts, on top of the bench_guard
+# diff of the aggregate headline.  Self-scales N to the core count
+# (N=2 smoke on a 1-core host, with the skip reason in the JSON).
+agg="/tmp/premerge_aggregate_$$.json"
+if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=aggregate \
+     python "$repo/bench.py" > "$agg" 2>/dev/null; then
+    if ! python "$repo/tools/bench_guard.py" "$agg" --repo "$repo" \
+         --threshold "$threshold"; then
+        rc=1
+    fi
+    if ! python - "$agg" <<'EOF'
+import json, sys
+def last_json(path):
+    for line in reversed(open(path).read().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"premerge: no JSON in {path}")
+obj = last_json(sys.argv[1])
+bail = obj.get("bailouts") or {}
+skip = obj.get("skipped") or {}
+print(f"premerge: aggregate {obj['value']:.0f} tasks/s over "
+      f"{obj.get('ranks')} ranks (eff {obj.get('scaling_efficiency')}; "
+      f"bailouts: {bail or 'none'}"
+      + (f"; skipped: {skip}" if skip else "") + ")")
+if bail.get("comm_buffered"):
+    print("premerge: comm_buffered bailouts in the aggregate probe — "
+          "comm-attached fast-complete regressed (local tasks left "
+          "the C chain because a comm engine was attached)")
+    sys.exit(1)
+sys.exit(0)
+EOF
+    then
+        rc=1
+    fi
+else
+    echo "premerge: aggregate probe FAILED to run"
+    rc=1
+fi
+rm -f "$agg"
 echo "== premerge probe: shm transport rtt =="
 shmout="/tmp/premerge_shm_rtt_$$.json"
 if JAX_PLATFORMS=cpu PARSEC_BENCH_APP=rtt PARSEC_MCA_COMM_TRANSPORT=shm \
