@@ -438,6 +438,13 @@ class CommEngine:
         #: only by the single thread running check_peer_timeouts
         self.hb_rebase_total = 0
         self._hb_rebases: Dict[int, int] = {}
+        #: heartbeat inter-arrival tracking (predictive health plane,
+        #: prof/health.py): per-peer EWMA of TAG_HB gaps plus a
+        #: mean-absolute-deviation jitter estimate.  Written only on
+        #: the comm receive thread (_hb_cb); read at scrape time
+        #: (hb_stats) — a degrading-but-alive peer shows up here as
+        #: gap inflation long before the silence timeout fires
+        self._hb_arrivals: Dict[int, Dict[str, float]] = {}
 
     def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
         """cb(src_rank, payload) runs on the comm receive thread."""
@@ -799,7 +806,39 @@ class CommEngine:
     # -- active failure detection: heartbeats + silence timeout ---------
     # lint: on-loop (AM callback)
     def _hb_cb(self, src: int, payload: Any) -> None:
-        pass   # receipt alone is the signal (_note_heard at the framer)
+        # receipt alone is the LIVENESS signal (_note_heard at the
+        # framer); the arrival TIME additionally feeds the health
+        # plane: per-peer inter-arrival EWMA + jitter, folded here at
+        # heartbeat cadence (a handful of floats per period — nowhere
+        # near the task hot path) and read by prof/health.py scrapes
+        now = time.monotonic()
+        st = self._hb_arrivals.get(src)
+        if st is None:
+            self._hb_arrivals[src] = {"at": now, "ewma": 0.0,
+                                      "jit": 0.0, "n": 0.0}
+            return
+        gap = now - st["at"]
+        st["at"] = now
+        if st["n"] < 1.0:
+            st["ewma"] = gap
+        else:
+            st["ewma"] += 0.3 * (gap - st["ewma"])
+            st["jit"] += 0.3 * (abs(gap - st["ewma"]) - st["jit"])
+        st["n"] += 1.0
+
+    def hb_stats(self) -> Dict[int, Dict[str, float]]:
+        """Per-peer heartbeat inter-arrival estimates for the health
+        plane: smoothed gap (``ewma_s``), mean-absolute-deviation
+        jitter (``jitter_s``), sample count and current silence age.
+        Scrape-time accessor; the fold itself runs in _hb_cb."""
+        now = time.monotonic()
+        out: Dict[int, Dict[str, float]] = {}
+        for r, st in list(self._hb_arrivals.items()):
+            out[r] = {"ewma_s": round(st["ewma"], 6),
+                      "jitter_s": round(st["jit"], 6),
+                      "n": int(st["n"]),
+                      "age_s": round(now - st["at"], 6)}
+        return out
 
     def _note_heard(self, src: Optional[int]) -> None:
         if src is not None:

@@ -163,6 +163,12 @@ class FlightRecorder(CausalTracer):
         self._last_inc = -float("inf")   # guarded-by: _inc_lock
         self.incidents = 0
         self.last_bundle: Optional[str] = None
+        #: comm-engine counter baseline for the incident WINDOW: the
+        #: bundle records stats deltas since arm (or the previous
+        #: dump), not lifetime totals — a straggler incident carries
+        #: its own comm context
+        self._comm_base: Optional[Dict[str, float]] = None
+        self._comm_base_at = time.monotonic()
 
     # -- lifecycle (override: only the cheap hooks) ----------------------
     def install(self, context) -> "FlightRecorder":
@@ -185,6 +191,8 @@ class FlightRecorder(CausalTracer):
         if "deps" in self.classes:
             context.pins_register("deliver_dep", self._deliver_dep)
         self.attach_comm(context.comm)
+        self._comm_base = self._comm_scalars()
+        self._comm_base_at = time.monotonic()
         return self
 
     def uninstall(self, context) -> None:
@@ -289,6 +297,7 @@ class FlightRecorder(CausalTracer):
         self.profile.add_information("flightrec_reason", reason)
         out = os.path.join(self.bundle_dir, f"rank{self.rank}.ptt")
         self.profile.dump(out)
+        self._dump_health(reason)
         with open(os.path.join(self.bundle_dir, "incidents.jsonl"),
                   "a") as fh:
             fh.write(json.dumps({
@@ -301,6 +310,62 @@ class FlightRecorder(CausalTracer):
                 "bundle %s (%s)", self.rank, len(self.profile),
                 self.bundle_dir, reason)
         return self.bundle_dir
+
+    def _comm_scalars(self) -> Dict[str, float]:
+        """Numeric comm-engine counters (best-effort snapshot)."""
+        ctx = self.context
+        comm = getattr(ctx, "comm", None) if ctx is not None else None
+        if comm is None:
+            return {}
+        try:
+            st = comm.stats()
+        except Exception:
+            return {}
+        return {k: float(v) for k, v in st.items()
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)}
+
+    def _dump_health(self, reason: str) -> None:
+        """Write ``health-rank<N>.json`` next to the ring: the health
+        plane's per-rank score time-series (prof/health.py) plus the
+        comm-engine stats DELTAS for the incident window — the two
+        planes that say why the incident happened, not just what.
+        Best-effort: neither plane being armed skips the file."""
+        cur = self._comm_scalars()
+        base = self._comm_base or {}
+        now = time.monotonic()
+        delta = {k: round(v - base.get(k, 0.0), 6)
+                 for k, v in cur.items() if v != base.get(k, 0.0)}
+        series: Dict[Any, Any] = {}
+        scores: Dict[Any, Any] = {}
+        m = getattr(self.context, "metrics", None) \
+            if self.context is not None else None
+        hm = getattr(m, "_health", None) if m is not None else None
+        if hm is not None:
+            try:
+                series = hm.series_snapshot()
+                scores = hm.snapshot()
+            except Exception:
+                pass
+        if not cur and not series:
+            return
+        doc = {"rank": self.rank, "reason": reason, "wall": time.time(),
+               "comm_window_s": round(now - self._comm_base_at, 3),
+               "comm_delta": delta,
+               "health": {str(r): ent for r, ent in scores.items()},
+               "health_series": {str(r): pts
+                                 for r, pts in series.items()}}
+        try:
+            with open(os.path.join(self.bundle_dir,
+                                   f"health-rank{self.rank}.json"),
+                      "w") as fh:
+                json.dump(doc, fh)
+        except OSError as exc:
+            warning("flight recorder: health snapshot failed: %s", exc)
+            return
+        # rebase: the NEXT incident's window starts here
+        self._comm_base = cur
+        self._comm_base_at = now
 
     def _broadcast(self, reason: str) -> None:
         ctx = self.context

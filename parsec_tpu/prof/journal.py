@@ -145,6 +145,17 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     "fabric_release": ("job",),
     "fabric_preempt": ("job", "by"),
     "fabric_resume": ("job",),
+    # predictive health plane (prof/health.py + service/fabric.py):
+    # scored state transitions and the drain decisions they justify.
+    # The auditor's H1 invariant replays these: every health_drain
+    # preceded by recorded below-threshold evidence for the same rank
+    # (a transition out of "ok"), and no drained rank placement-
+    # targeted while the drain is in force.  ``peer`` not ``rank``:
+    # merge_journals stamps ``rank`` (the OBSERVING rank) onto every
+    # merged event, so the observed rank must ride another key.
+    "health_transition": ("peer", "frm", "to", "score"),
+    "health_drain": ("peer", "score", "thr"),
+    "health_undrain": ("peer", "score"),
 }
 
 

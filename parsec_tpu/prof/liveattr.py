@@ -839,10 +839,13 @@ def _job_entry(job, merged: dict, comm_total: float,
 
 
 def build_status(context, service=None,
-                 sections: Optional[Dict[int, dict]] = None) -> dict:
+                 sections: Optional[Dict[int, dict]] = None,
+                 health_sections: Optional[Dict[int, dict]] = None) -> dict:
     """Assemble the status document from merged per-rank sections.
     Degrades rather than fails: a job whose pieces cannot be read
-    still appears with what is known."""
+    still appears with what is known.  ``health_sections`` are the
+    per-rank ``__health__`` records riding the same pull; merged
+    (prof/health.merge_health) into the document's ``health`` block."""
     merged = merge_sections(sections or {})
     done_total = sum(r["done"] for r in merged["recs"].values())
     comm_total = merged["comm_s"]
@@ -876,6 +879,12 @@ def build_status(context, service=None,
            "stragglers": merged["anomalies"],
            "stragglers_total": sum(merged["strag"].values()),
            "comm": {"per_peer_delay_s": merged["per_peer_delay_s"]}}
+    if health_sections:
+        try:
+            from parsec_tpu.prof.health import merge_health
+            doc["health"] = merge_health(health_sections)
+        except Exception:   # degrade, never drop the scrape
+            pass
     if service is not None:
         try:
             doc["service"] = service.stats()
@@ -893,9 +902,17 @@ def cluster_status(context, service=None, aggregate: bool = True,
     wire tags)."""
     m = getattr(context, "metrics", None)
     la = getattr(m, "_la", None) if m is not None else None
+    hm = getattr(m, "_health", None) if m is not None else None
     sections: Dict[int, dict] = {}
+    health_sections: Dict[int, dict] = {}
     if la is not None:
         sections[context.rank] = la.section()
+    if hm is not None:
+        try:
+            hm.refresh()
+            health_sections[context.rank] = hm.section()
+        except Exception:
+            pass
     comm = getattr(context, "comm", None)
     ce = getattr(comm, "ce", None) if comm is not None else None
     if aggregate and ce is not None and context.nranks > 1:
@@ -903,9 +920,12 @@ def cluster_status(context, service=None, aggregate: bool = True,
             for rank, samples in ce.gather_metrics(
                     timeout=timeout).items():
                 for s in samples:
-                    if s.get("t") == "section" \
-                            and s.get("n") == "__liveattr__":
+                    if s.get("t") != "section":
+                        continue
+                    if s.get("n") == "__liveattr__":
                         sections[int(rank)] = s.get("doc") or {}
+                    elif s.get("n") == "__health__":
+                        health_sections[int(rank)] = s.get("doc") or {}
         except Exception:   # degrade to the local view, never fail
             pass
-    return build_status(context, service, sections)
+    return build_status(context, service, sections, health_sections)

@@ -379,6 +379,9 @@ class RuntimeMetrics:
         #: online attribution engine (prof/liveattr.py) riding THESE
         #: hooks — it registers no PINS callbacks of its own
         self._la = None
+        #: predictive health plane (prof/health.py): scrape-time
+        #: fusion of the existing counters — no hooks, no hot path
+        self._health = None
         #: the stride-advertising wrapper _complete registers through
         #: (built at install; the native quantum reads its stride)
         self._complete_cb = None
@@ -389,6 +392,11 @@ class RuntimeMetrics:
         """The online attribution engine, or None when disarmed."""
         return self._la
 
+    @property
+    def health(self):
+        """The predictive health monitor, or None when disarmed."""
+        return self._health
+
     def install(self, context) -> "RuntimeMetrics":
         self.rank = context.rank
         self.context = context
@@ -397,6 +405,9 @@ class RuntimeMetrics:
         if int(params.get("liveattr_enable", 1)):
             from parsec_tpu.prof.liveattr import LiveAttr
             self._la = LiveAttr(self)
+        if int(params.get("health_enable", 1)):
+            from parsec_tpu.prof.health import HealthMonitor
+            self._health = HealthMonitor(self)
         # ONE hooked hot-path event by default: every additional PINS
         # dispatch with a live callback costs ~0.5us/task on the tasks
         # probe — two hooks alone would eat the whole armed budget
@@ -443,6 +454,7 @@ class RuntimeMetrics:
         self.context = None
         self._la = None   # cached per-TaskClass recs detect the
         #                   staleness through their rec.la identity
+        self._health = None
 
     def attach_service(self, service) -> None:
         """Job-service gauges (pending/running/degraded + the bounded
@@ -626,6 +638,18 @@ class RuntimeMetrics:
             try:
                 out.append({"n": "__liveattr__", "t": "section",
                             "l": {}, "doc": la.section()})
+            except Exception:   # the side channel must not kill scrape
+                pass
+        hm = self._health
+        if hm is not None:
+            # per-rank health gauges + the __health__ status section —
+            # the fold itself is rate-limited inside refresh(), so a
+            # scrape storm costs one dict walk, not one re-score
+            try:
+                hm.refresh()
+                out.extend(hm.samples())
+                out.append({"n": "__health__", "t": "section",
+                            "l": {}, "doc": hm.section()})
             except Exception:   # the side channel must not kill scrape
                 pass
         out.extend(self._collect_comm())

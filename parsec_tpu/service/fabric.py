@@ -83,6 +83,23 @@ params.register("fabric_elastic", 1,
 params.register("fabric_profile_alpha", 0.5,
                 "EWMA fold factor of the learned per-app makespan "
                 "profiles the admission quote extrapolates from")
+params.register("fabric_health_enable", 1,
+                "consume the predictive health plane (prof/health.py): "
+                "admission quotes inflate against the gang's minimum "
+                "health, and a sustained below-threshold rank is "
+                "pre-emptively DRAINED before the heartbeat detector "
+                "declares it dead (0 ignores health entirely)")
+params.register("fabric_drain_score", 0.5,
+                "smoothed health score below which a rank becomes a "
+                "drain candidate (the health plane's 'critical' "
+                "threshold by default)")
+params.register("fabric_drain_sustain_s", 3.0,
+                "seconds a rank must stay below fabric_drain_score "
+                "before the drain fires — one bad fold must not shed "
+                "a rank")
+params.register("fabric_undrain_score", 0.8,
+                "smoothed score a DRAINED rank must recover to before "
+                "it rejoins the placement gang")
 
 
 # ---------------------------------------------------------------------------
@@ -339,6 +356,8 @@ class ServingFabric(JobService):
     _carver: Optional[MeshCarver] = None
     _elastic = False
     _preempt_enable = False
+    _health_enable = False
+    _health_drained: frozenset = frozenset()
 
     def __init__(self, context=None, **kw):
         super().__init__(context, **kw)
@@ -362,6 +381,20 @@ class ServingFabric(JobService):
         #: into DONE through _finish (guarded-by: _lock)
         self._preempted: Dict[int, int] = {}
         self.preemptions = 0
+        #: predictive health consumption (prof/health.py): ranks the
+        #: fabric pre-emptively drained, and the below-threshold
+        #: stopwatch feeding the sustain window (guarded-by: _lock)
+        self._drain_score = float(params.get("fabric_drain_score", 0.5))
+        self._drain_sustain = float(params.get("fabric_drain_sustain_s",
+                                               3.0))
+        self._undrain_score = float(params.get("fabric_undrain_score",
+                                               0.8))
+        self._health_drained = set()
+        self._below_since: Dict[int, float] = {}
+        self._health_next = 0.0
+        self.drains = 0
+        self._health_enable = bool(int(params.get(
+            "fabric_health_enable", 1)))
 
     # -- submission: quote + verdict --------------------------------------
     def submit(self, factory, *, priority: int = 0,
@@ -384,6 +417,14 @@ class ServingFabric(JobService):
         key = app or name or getattr(factory, "__name__", "job")
         chips = want if want > 0 else self._chips_shared
         quote = self._profiles.quote(key, chips)
+        # predictive admission against the health plane: a quote onto
+        # a DEGRADING gang inflates by the worst live rank's smoothed
+        # score, so the existing SLO policy machinery deprioritizes /
+        # queues / rejects placements a degrading rank would slow —
+        # before anything fails
+        health = self._gang_health()
+        if quote is not None and health < 1.0:
+            quote = round(quote / max(health, 0.05), 6)
         policy = slo_policy or self._slo_policy
         verdict = "admit"
         eff_priority = int(priority)
@@ -395,7 +436,7 @@ class ServingFabric(JobService):
                 jr = getattr(self.context, "journal", None)
                 if jr is not None:
                     jr.emit("fabric_quote", job=jid, eta=quote, app=key,
-                            chips=chips, slo=float(slo))
+                            chips=chips, slo=float(slo), health=health)
                     jr.emit("fabric_admit", job=jid, verdict="reject",
                             eta=quote, slo=float(slo))
                 raise AdmissionError(
@@ -424,7 +465,7 @@ class ServingFabric(JobService):
         jr = getattr(self.context, "journal", None)
         if jr is not None:
             jr.emit("fabric_quote", job=job.job_id, eta=quote, app=key,
-                    chips=chips, slo=job.slo)
+                    chips=chips, slo=job.slo, health=health)
             jr.emit("fabric_admit", job=job.job_id, verdict=verdict,
                     eta=quote, slo=job.slo)
         return job
@@ -439,6 +480,7 @@ class ServingFabric(JobService):
         lower-priority resumable tenant is preempted mid-DAG."""
         if self._carver is None:        # dispatcher beat __init__
             return None
+        self._health_tick(now_mono)
         if self._pending and len(self._running) < self._max_active:
             order = sorted(self._pending,
                            key=lambda j: self._score(j, now_mono),
@@ -463,7 +505,11 @@ class ServingFabric(JobService):
 
     def _place(self, job: JobHandle, lease) -> None:
         """Record one placement outcome (lock held).  A re-placement
-        after a preemption is the RESUME leg of the round-trip."""
+        after a preemption is the RESUME leg of the round-trip.  The
+        ``ranks`` field stamps the gang the placement targets — live
+        ranks minus the health-drained set — which is exactly what
+        the auditor's H1 invariant replays: a drained rank must never
+        appear in a subsequent placement's gang."""
         jr = getattr(self.context, "journal", None)
         if job.preempted_at is not None:
             job.preempted_at = None
@@ -473,12 +519,129 @@ class ServingFabric(JobService):
             job.devices = tuple(lease)
             if jr is not None:
                 jr.emit("fabric_place", job=job.job_id,
-                        devices=list(lease), shared=False)
+                        devices=list(lease), shared=False,
+                        ranks=self._gang_ranks())
         else:
             job.devices = None
             if jr is not None:
                 jr.emit("fabric_place", job=job.job_id, devices=[],
-                        shared=True)
+                        shared=True, ranks=self._gang_ranks())
+
+    # -- predictive health: deprioritize, then drain before death ---------
+    def _gang_ranks(self) -> List[int]:
+        """The placement-target gang: the context's ranks minus dead
+        peers minus the health-drained set."""
+        ctx = self.context
+        comm = getattr(ctx, "comm", None)
+        ce = getattr(comm, "ce", None) if comm is not None else None
+        dead = getattr(ce, "dead_peers", None) or set()
+        return [r for r in range(max(1, int(getattr(ctx, "nranks", 1))))
+                if r not in dead and r not in self._health_drained]
+
+    def _health_monitor(self):
+        m = getattr(self.context, "metrics", None)
+        return getattr(m, "_health", None) if m is not None else None
+
+    def _gang_health(self) -> float:
+        """Minimum smoothed health score across the live gang (1.0
+        with no monitor / no observations).  Drained ranks are no
+        longer placement targets, so they stop taxing quotes."""
+        if not self._health_enable:
+            return 1.0
+        hm = self._health_monitor()
+        if hm is None:
+            return 1.0
+        try:
+            snap = hm.snapshot()
+        except Exception:
+            return 1.0
+        vals = [e["ewma"] for r, e in snap.items()
+                if r not in self._health_drained]
+        return round(min(vals), 4) if vals else 1.0
+
+    def _health_tick(self, now: float) -> None:
+        """One rate-limited health consumption pass (lock held, on
+        the dispatcher tick — never the task hot path): start/stop
+        the below-threshold stopwatch per rank, fire the pre-emptive
+        drain once the score stays below ``fabric_drain_score`` for
+        ``fabric_drain_sustain_s``, lift it on sustained recovery."""
+        if not self._health_enable or now < self._health_next:
+            return
+        self._health_next = now + 0.25
+        hm = self._health_monitor()
+        if hm is None:
+            return
+        try:
+            snap = hm.refresh()
+        except Exception:
+            return
+        my = int(getattr(self.context, "rank", 0))
+        for r, ent in snap.items():
+            if r == my:
+                continue        # a rank cannot drain itself
+            ewma = float(ent.get("ewma", 1.0))
+            if r in self._health_drained:
+                if ewma >= self._undrain_score:
+                    self._undrain(r, ewma)
+                continue
+            if ewma < self._drain_score:
+                since = self._below_since.setdefault(r, now)
+                if now - since >= self._drain_sustain:
+                    self._drain(r, ewma, hm)
+            else:
+                self._below_since.pop(r, None)
+
+    # holds-lock: _lock
+    def _drain(self, rank: int, ewma: float, hm) -> None:
+        """Journaled pre-emptive drain: the decision carries its
+        below-threshold evidence (the score series tail), the rank
+        leaves the placement gang, and resumable tenants migrate off
+        it through the existing preempt/resume machinery (their
+        resume leg re-places onto the post-drain gang; the recovery
+        plane's shrink path remains the backstop if the rank does
+        die).  Fires strictly before the heartbeat detector: the
+        whole point is to beat ``comm_peer_timeout_s``."""
+        self._health_drained.add(rank)
+        self._below_since.pop(rank, None)
+        self.drains += 1
+        evidence = []
+        try:
+            evidence = hm.evidence(rank)
+        except Exception:
+            pass
+        jr = getattr(self.context, "journal", None)
+        if jr is not None:
+            jr.emit("health_drain", peer=rank, score=round(ewma, 4),
+                    thr=self._drain_score,
+                    sustain_s=round(self._drain_sustain, 3),
+                    evidence=evidence)
+        debug_verbose(1, "fabric: pre-emptive drain of rank %d "
+                      "(score %.3f < %.3f sustained)", rank, ewma,
+                      self._drain_score)
+        self._migrate_off(rank)
+
+    # holds-lock: _lock
+    def _migrate_off(self, rank: int) -> None:
+        """Migrate what can move: resumable running tenants preempt
+        (cancel + re-queue with factory intact — the datarepo
+        snapshot substrate keeps their materialized tiles) so their
+        resume placement lands on the post-drain gang.  Non-resumable
+        tenants run to completion — a drain stops NEW placement, it
+        does not kill in-flight work."""
+        for job in list(self._running.values()):
+            if getattr(job, "resumable", False) \
+                    and job.taskpool is not None \
+                    and job.status() == JobStatus.RUNNING:
+                self._preempt(job, job)
+
+    # holds-lock: _lock
+    def _undrain(self, rank: int, ewma: float) -> None:
+        self._health_drained.discard(rank)
+        jr = getattr(self.context, "journal", None)
+        if jr is not None:
+            jr.emit("health_undrain", peer=rank, score=round(ewma, 4))
+        debug_verbose(1, "fabric: rank %d recovered (score %.3f); "
+                      "drain lifted", rank, ewma)
 
     def _pick_victim(self, job: JobHandle) -> Optional[JobHandle]:
         """Lowest-priority RUNNING tenant that is resumable, holds an
@@ -652,6 +815,9 @@ class ServingFabric(JobService):
                 "leases": {str(o): list(l) for o, l in
                            self._carver.leases().items()},
                 "preemptions": self.preemptions,
+                "drains": self.drains,
+                "drained_ranks": sorted(self._health_drained),
+                "gang_health": self._gang_health(),
             }
         return st
 

@@ -46,6 +46,16 @@ Directives (``;``-separated; fields ``,``-separated):
                   right before a matching task's body runs instead —
                   the deterministic straggler injector the liveattr
                   anomaly tests replay (prof/liveattr.py)
+``degrade``       ``rank=<r>,ms=<cap>,ramp=<sec>[,at=<sec>]`` — a rank
+                  that is DYING, not dead: starting ``at`` seconds after
+                  arming, every task body and every outbound frame
+                  (heartbeats included) on rank ``r`` gains a delay that
+                  ramps linearly from 0 to ``ms`` over ``ramp`` seconds,
+                  with seeded ±10%% jitter.  Keep ``ms`` well under the
+                  peer heartbeat timeout: the rank must stay ALIVE so
+                  only the predictive health plane (prof/health.py) —
+                  not the liveness detector — can see it.  This is the
+                  drain-before-death validation workload
 
 Field forms: ``tag:NAME`` (frame tag; default = any app tag),
 ``pm=<substr>`` (substring of ``repr(payload)``), ``p=<prob>``,
@@ -102,7 +112,7 @@ _RECV_KINDS = ("delay_recv",)
 
 class _Directive:
     __slots__ = ("kind", "tag", "p", "n", "ms", "rank", "at_s", "mode",
-                 "key", "pm", "fired", "lock")
+                 "key", "pm", "ramp", "fired", "lock")
 
     def __init__(self, kind: str):
         self.kind = kind
@@ -115,6 +125,7 @@ class _Directive:
         self.mode = "close"
         self.key: Optional[str] = None
         self.pm: Optional[str] = None
+        self.ramp = 10.0
         self.fired = 0
         self.lock = threading.Lock()
 
@@ -169,6 +180,10 @@ def _parse_field(d: _Directive, field: str) -> None:
             d.pm = v
         elif k == "rank":
             d.rank = int(v)
+        elif k == "ramp":
+            d.ramp = float(v.rstrip("s"))
+        elif k == "at":
+            d.at_s = float(v.rstrip("s"))
         else:
             raise ValueError(f"unknown fault-plan field {k!r}")
         return
@@ -200,6 +215,22 @@ class FaultPlan:
         return [d for d in self.directives if d.kind in kinds]
 
 
+def _ramp_ms(d: _Directive, t0: float, rng: random.Random) -> float:
+    """Current delay of a ``degrade`` directive: linear ramp from 0 at
+    ``t0 + at_s`` to ``ms`` at ``t0 + at_s + ramp``, then held at the
+    cap, with seeded ±10% jitter (the jitter IS a signal — inter-arrival
+    variance is what a scrape-time health fold can see even after the
+    ramp plateaus and the mean gap renormalizes)."""
+    el = time.monotonic() - t0 - d.at_s
+    if el <= 0.0:
+        return 0.0
+    frac = min(1.0, el / max(d.ramp, 1e-9))
+    val = d.ms * frac
+    if val <= 0.0:
+        return 0.0
+    return val * (0.9 + 0.2 * rng.random())
+
+
 class CommFaults:
     """Per-engine (per-rank) comm-fault state: a seeded RNG plus the
     plan's frame and kill directives.  Created by ``comm_faults`` at
@@ -212,6 +243,9 @@ class CommFaults:
         self.recv_dirs = plan.of_kind(*_RECV_KINDS)
         self.kill = next((d for d in plan.of_kind("kill_rank")
                           if d.rank == rank), None)
+        self.degrade = next((d for d in plan.of_kind("degrade")
+                             if d.rank is None or d.rank == rank), None)
+        self._t0 = time.monotonic()
 
     def frame_action(self, tag: int, dst: int,
                      payload: Any) -> Optional[Tuple[str, float]]:
@@ -230,6 +264,14 @@ class CommFaults:
                 text = repr(payload)[:512] if payload is not None else ""
             if d.take(self.rng, text):
                 return (d.kind[:-6], d.ms)   # strip "_frame"
+        # degrade: every outbound frame — heartbeats included — gains
+        # the ramped delay.  Explicit frame directives take precedence
+        # above so composed plans keep their drop/dup/trunc semantics.
+        dg = self.degrade
+        if dg is not None:
+            ms = _ramp_ms(dg, self._t0, self.rng)
+            if ms >= 1.0:
+                return ("delay", ms)
         return None
 
     def recv_delay_ms(self, tag: int, src: int,
@@ -262,6 +304,9 @@ class RuntimeFaults:
         self.rng = random.Random(plan.seed + 1000 * rank + 7)
         self.task_dirs = plan.of_kind("fail_task")
         self.disp_dirs = plan.of_kind("delay_dispatch")
+        self.degrade = next((d for d in plan.of_kind("degrade")
+                             if d.rank is None or d.rank == rank), None)
+        self._t0 = time.monotonic()
 
     def task_fault(self, task) -> bool:
         for d in self.task_dirs:
@@ -287,6 +332,11 @@ class RuntimeFaults:
                 continue
             if d.take(self.rng) and d.ms > 0:
                 time.sleep(d.ms * 1e-3)
+        dg = self.degrade
+        if dg is not None:
+            ms = _ramp_ms(dg, self._t0, self.rng)
+            if ms >= 1.0:
+                time.sleep(ms * 1e-3)
 
 
 def arm(spec: str) -> FaultPlan:
@@ -320,23 +370,32 @@ def refresh() -> None:
 def comm_faults(rank: int) -> Optional[CommFaults]:
     """The transport's per-rank fault view, or None (no armed plan or no
     comm directives — the transport then skips every per-frame check)."""
+    global _RANK
+    _RANK = rank   # the transport learns the rank first; runtime() reuses it
     plan = _PLAN
     if plan is None:
         return None
     cf = CommFaults(plan, rank)
-    if not cf.frame_dirs and not cf.recv_dirs and cf.kill is None:
+    if not cf.frame_dirs and not cf.recv_dirs and cf.kill is None \
+            and cf.degrade is None:
         return None
     return cf
 
 
-def runtime(rank: int = 0) -> Optional[RuntimeFaults]:
+#: this process's rank as last reported by the transport (degrade
+#: directives scope by rank on the TASK side too, and the task hooks
+#: have no rank argument — the transport always constructs first)
+_RANK = 0
+
+
+def runtime(rank: Optional[int] = None) -> Optional[RuntimeFaults]:
     global _RUNTIME
     plan = _PLAN
     if plan is None:
         return None
     with _lock:
         if _RUNTIME is None:
-            _RUNTIME = RuntimeFaults(plan, rank)
+            _RUNTIME = RuntimeFaults(plan, _RANK if rank is None else rank)
         return _RUNTIME
 
 

@@ -426,6 +426,189 @@ def rejoin_scenario(transport="shm", timeout=150.0):
 
 
 # ---------------------------------------------------------------------------
+# degrade -> drain-before-death scenario (the predictive health plane's
+# validation workload, ISSUE 19): rank 1 is DYING, not dead — a seeded
+# ramped degrade inflates its task latencies and every outbound frame
+# (heartbeats included) while staying far under the death timeout.  The
+# fabric on rank 0 must journal a pre-emptive health_drain with its
+# below-threshold evidence, stop placing onto rank 1, and the heartbeat
+# detector must NEVER fire — then the offline auditor (incl. the H1
+# health invariant) replays the whole decision trail clean.
+# ---------------------------------------------------------------------------
+
+def _health_job_factory():
+    """Tiny local 4-task pool: enough to produce real fabric_place
+    records (with their gang stamps) around the drain."""
+    from parsec_tpu.dsl.ptg.api import PTG, Range
+    p = PTG("hjob", N=4)
+    p.task("T", i=Range(0, 3)).body(lambda: None)
+    return p.build()
+
+
+def _degrade_proc(rank, nranks, port_base, outq):
+    import time as _time
+    import traceback
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        from parsec_tpu.comm.engine import make_ce
+        from parsec_tpu.comm.remote_dep import RemoteDepEngine
+        from parsec_tpu.core.context import Context
+
+        run_s = float(os.environ.get("PARSEC_CHAOS_DEGRADE_RUN_S", "45"))
+        ce = make_ce(rank, nranks, port_base)
+        ctx = Context(nb_cores=2, rank=rank, nranks=nranks)
+        rde = RemoteDepEngine(ce, ctx)
+        ce.barrier()
+        t0 = _time.monotonic()
+        if rank != 0:
+            # the degrading rank: idle but ALIVE.  The armed fault
+            # plan's ramped degrade directive is doing the work — every
+            # outbound frame (TAG_HB included) gains a growing, seeded-
+            # jittered delay that stays far below comm_peer_timeout_s
+            while _time.monotonic() - t0 < run_s + 8.0:
+                _time.sleep(0.2)
+            outq.put((rank, None, "ok"))
+            return
+
+        # rank 0: the health consumer — fabric + monitor + auditor
+        from parsec_tpu.service.fabric import ServingFabric
+        from tools import journal_audit
+
+        svc = ServingFabric(ctx)
+        hm = getattr(ctx.metrics, "_health", None)
+        assert hm is not None, "health plane disarmed on the consumer"
+        # one placement BEFORE the degradation bites: its gang stamp
+        # must carry BOTH ranks (the healthy baseline the audit's
+        # drained-placement check contrasts against)
+        pre = svc.submit(_health_job_factory, name="pre-degrade")
+        pre_ok = pre.wait(timeout=30)
+        assert pre_ok, "pre-degrade job never finished"
+
+        deadline = t0 + run_s
+        drained_at = None
+        while _time.monotonic() < deadline:
+            if svc.drains >= 1:
+                drained_at = round(_time.monotonic() - t0, 1)
+                break
+            _time.sleep(0.2)
+        checks = []
+        if drained_at is None:
+            snap = hm.snapshot().get(1, {})
+            checks.append(f"drain never fired within {run_s}s "
+                          f"(rank 1 health: {snap!r})")
+        # drain-before-DEATH: the liveness detector must never have
+        # seen anything — the rank is slow, not silent
+        if 1 in ce.dead_peers:
+            checks.append("rank 1 declared DEAD — the drain did not "
+                          "beat the heartbeat detector")
+        st = svc.stats()["fabric"]
+        if drained_at is not None and st["drained_ranks"] != [1]:
+            checks.append(f"drained_ranks={st['drained_ranks']!r}, "
+                          "expected [1]")
+        # one placement AFTER the drain: its gang stamp must exclude
+        # the drained rank (the H1 invariant audited below)
+        if drained_at is not None:
+            post = svc.submit(_health_job_factory, name="post-drain")
+            if not post.wait(timeout=30):
+                checks.append("post-drain job never finished")
+        events = ctx.journal.snapshot()["events"]
+        if any(e.get("e") == "peer_dead" and e.get("peer") == 1
+               for e in events):
+            checks.append("peer_dead journaled for the degrading rank")
+        drains = [e for e in events if e.get("e") == "health_drain"]
+        if drained_at is not None:
+            if not drains:
+                checks.append("health_drain missing from the journal")
+            elif not drains[0].get("evidence"):
+                checks.append("health_drain carries no evidence")
+        places = [e for e in events if e.get("e") == "fabric_place"]
+        if drained_at is not None and \
+                not any(e.get("ranks") == [0] for e in places):
+            checks.append("no post-drain placement with gang [0] "
+                          f"(placements: {[e.get('ranks') for e in places]!r})")
+        violations = journal_audit.audit({0: [ctx.journal.snapshot()]})
+        if violations:
+            checks.append("journal audit: " + "; ".join(violations[:4]))
+        svc.shutdown(timeout=5.0)
+        if checks:
+            outq.put((rank, "; ".join(checks), None))
+        else:
+            outq.put((rank, None,
+                      f"drained rank 1 at t+{drained_at}s "
+                      f"(evidence pts={len(drains[0]['evidence'])}, "
+                      f"placements={len(places)}, "
+                      f"events={len(events)})"))
+    except Exception:
+        outq.put((rank, traceback.format_exc(), None))
+
+
+def degrade_scenario(seed=7, timeout=120.0):
+    """Run the seeded degrade -> drain-before-death case; returns
+    (ok, detail).  Replayable: the ramp's jitter stream is seeded, so
+    the same seed degrades the same way."""
+    import multiprocessing as mp
+    from parsec_tpu.comm.launch import _probe_port_base
+    keys = _CHAOS_ENV + ("PARSEC_MCA_COMM_CLOCK_PROBE_S",
+                         "PARSEC_MCA_FABRIC_DRAIN_SCORE",
+                         "PARSEC_MCA_FABRIC_DRAIN_SUSTAIN_S",
+                         "PARSEC_MCA_HEALTH_DEGRADED",
+                         "PARSEC_MCA_HEALTH_INTERVAL_S",
+                         "PARSEC_CHAOS_DEGRADE_RUN_S")
+    saved = {k: os.environ.get(k) for k in keys}
+    os.environ.update({
+        # the dying-not-dead plan: frame+task delays ramp 0 -> 5 s over
+        # 10 s starting at t+4 s — far under the 30 s death timeout
+        "PARSEC_MCA_FAULT_PLAN":
+            f"seed={seed};degrade=rank=1,ms=5000,ramp=10,at=4",
+        "PARSEC_MCA_COMM_PEER_TIMEOUT_S": "30",
+        # heartbeat cadence rides min(clock_probe, timeout/3): probe at
+        # 0.3 s so the gap/jitter baseline learns fast and the jitter
+        # penalty reads against a tight cadence
+        "PARSEC_MCA_COMM_CLOCK_PROBE_S": "0.3",
+        "PARSEC_MCA_HEALTH_INTERVAL_S": "0.5",
+        # evidence strictly precedes the drain: the 'degraded'
+        # transition fires at 0.9, the drain only below 0.85 sustained
+        # (healthy ranks sit at 1.0 — the margin is against fold noise,
+        # not against health)
+        "PARSEC_MCA_HEALTH_DEGRADED": "0.9",
+        "PARSEC_MCA_FABRIC_DRAIN_SCORE": "0.85",
+        "PARSEC_MCA_FABRIC_DRAIN_SUSTAIN_S": "2.0",
+    })
+    try:
+        base = _probe_port_base(2)
+        mpctx = mp.get_context("spawn")
+        outq = mpctx.Queue()
+        procs = [mpctx.Process(target=_degrade_proc,
+                               args=(r, 2, base, outq), daemon=True)
+                 for r in range(2)]
+        for p in procs:
+            p.start()
+        results, errs = {}, []
+        try:
+            for _ in range(2):
+                rank, err, res = outq.get(timeout=timeout)
+                if err is not None:
+                    errs.append(f"rank {rank}: {err}")
+                results[rank] = res
+        except Exception as exc:
+            errs.append(f"harness: {exc!r}")
+        finally:
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    ok = not errs and set(results) == {0, 1} and results[1] == "ok" \
+        and results[0] is not None
+    return ok, "; ".join(errs) if errs else str(results[0])
+
+
+# ---------------------------------------------------------------------------
 # minimal-vs-full replay A/B (the premerge --ab-minimal leg and the
 # bench recovery mode both drive this)
 # ---------------------------------------------------------------------------
@@ -1008,6 +1191,16 @@ def main(argv=None):
     ap.add_argument("--rejoin", default="",
                     help="run the kill->restart->TAG_REJOIN scenario "
                          "on one transport (threads/evloop/shm)")
+    ap.add_argument("--degrade", action="store_true",
+                    help="run the seeded degrade -> drain-before-death "
+                         "scenario: a ramped slowdown on rank 1 must "
+                         "trigger a journaled, evidence-carrying "
+                         "pre-emptive fabric drain STRICTLY before the "
+                         "heartbeat detector fires, and the offline "
+                         "audit (incl. the H1 health invariant) must "
+                         "replay clean")
+    ap.add_argument("--degrade-seed", type=int, default=7,
+                    help="seed of the degrade ramp's jitter stream")
     ap.add_argument("--only", default="",
                     help="comma-separated catalog entry names")
     ap.add_argument("--transport", default="",
@@ -1026,6 +1219,12 @@ def main(argv=None):
                                      timeout=max(args.timeout, 150.0))
         print(f"[{'PASS' if ok else 'FAIL'}] rejoin-{args.rejoin}: "
               f"{detail}")
+        return 0 if ok else 1
+    if args.degrade:
+        ok, detail = degrade_scenario(seed=args.degrade_seed,
+                                      timeout=max(args.timeout, 120.0))
+        print(f"[{'PASS' if ok else 'FAIL'}] degrade-drain: "
+              f"{detail[:400]}")
         return 0 if ok else 1
 
     catalog = CATALOG

@@ -43,7 +43,14 @@ now assertable from evidence):
       epoch: per job, count(fabric_place) - count(fabric_resume) is
       0 or 1, and a REJECTED job records no placement at all;
   F3  every preemption resolves: a fabric_preempt is followed by a
-      fabric_resume or a terminal job_done for that job.
+      fabric_resume or a terminal job_done for that job;
+  H1  health decisions are evidence-backed (prof/health.py): every
+      pre-emptive drain is PRECEDED by recorded below-threshold
+      evidence for that rank (a health_transition out of "ok") and
+      carries a score strictly below its own threshold — and no rank
+      is both drained and placement-targeted while the drain is in
+      force (replaying health_drain / health_undrain / fabric_place
+      chronologically per fabric).
 
 Usage:
     python tools/journal_audit.py <bundle-dir-or-files> --timeline
@@ -354,6 +361,48 @@ def audit(per_rank: Dict[int, List[dict]]) -> List[str]:
         violations.append(
             f"F3 rank {rank} job={job}: preempted at t={t:.6f} but "
             "never resumed nor terminal")
+
+    # H1: drains evidence-backed, drained ranks never placement
+    # targets — chronological replay per (rank, incarnation) fabric.
+    # ``peer`` is the OBSERVED rank (merge stamps ``rank`` with the
+    # observer).  Placements without a ``ranks`` gang stamp predate
+    # the health plane and are skipped.
+    below_seen: Dict[Tuple, set] = defaultdict(set)
+    drained: Dict[Tuple, set] = defaultdict(set)
+    for ev in events:
+        e = ev.get("e")
+        if e not in ("health_transition", "health_drain",
+                     "health_undrain", "fabric_place"):
+            continue
+        fab = (ev["rank"], ev.get("inc", 0))
+        if e == "health_transition":
+            if ev.get("to") != "ok":
+                below_seen[fab].add(ev.get("peer"))
+            else:
+                below_seen[fab].discard(ev.get("peer"))
+        elif e == "health_drain":
+            peer = ev.get("peer")
+            if peer not in below_seen[fab]:
+                violations.append(
+                    f"H1 rank {ev['rank']} peer={peer}: drained at "
+                    f"t={ev['t']:.6f} with no preceding below-threshold "
+                    "evidence (no health_transition out of 'ok')")
+            score, thr = ev.get("score"), ev.get("thr")
+            if score is not None and thr is not None \
+                    and float(score) >= float(thr):
+                violations.append(
+                    f"H1 rank {ev['rank']} peer={peer}: drain score "
+                    f"{score} is not below its threshold {thr}")
+            drained[fab].add(peer)
+        elif e == "health_undrain":
+            drained[fab].discard(ev.get("peer"))
+        elif e == "fabric_place" and ev.get("ranks") is not None:
+            hit = drained[fab] & set(ev.get("ranks") or ())
+            if hit:
+                violations.append(
+                    f"H1 rank {ev['rank']} job={ev.get('job')}: "
+                    f"placement targets drained rank(s) {sorted(hit)} "
+                    f"at t={ev['t']:.6f}")
     return violations
 
 

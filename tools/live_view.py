@@ -65,6 +65,35 @@ def _fmt_split(att: dict) -> str:
                     for k in ("exec", "queue", "comm", "idle"))
 
 
+def _trend_arrow(trend: float) -> str:
+    return "↑" if trend > 0.02 else "↓" if trend < -0.02 else "→"
+
+
+def render_health(doc: dict) -> str:
+    """One-line per-rank health strip from the status document's
+    ``health`` block (prof/health.py merge_health): smoothed score,
+    trend arrow, and — when a rank left 'ok' — its state and how long
+    it has been there.  Empty string when the plane is disarmed."""
+    ranks = (doc.get("health") or {}).get("ranks") or {}
+    if not ranks:
+        return ""
+    cells = []
+    for r in sorted(ranks, key=lambda x: int(x)):
+        ent = ranks[r] or {}
+        score = float(ent.get("ewma", ent.get("score", 1.0)) or 1.0)
+        cell = (f"r{r} {score:.2f}"
+                f"{_trend_arrow(float(ent.get('trend', 0.0) or 0.0))}")
+        state = str(ent.get("state", "ok"))
+        if state != "ok":
+            cell += f" {state.upper()} {float(ent.get('since_s', 0)):.0f}s"
+        cells.append(cell)
+    out = "health: " + "   ".join(cells)
+    tr = int((doc.get("health") or {}).get("transitions", 0) or 0)
+    if tr:
+        out += f"   ({tr} transition{'s' if tr != 1 else ''})"
+    return out
+
+
 def render_status(doc: dict, metrics: dict) -> str:
     lines = []
     svc = doc.get("service") or {}
@@ -74,6 +103,9 @@ def render_status(doc: dict, metrics: dict) -> str:
         f"running={svc.get('running', '-')} "
         f"degraded={svc.get('degraded', '-')}  "
         f"stragglers={doc.get('stragglers_total', 0)}")
+    health = render_health(doc)
+    if health:
+        lines.append(health)
     hdr = (f"{'job':>5} {'name':<16} {'status':<9} {'done':>7} "
            f"{'left':>7} {'exec/queue/comm/idle':<24} {'eta':>8}")
     lines.append(hdr)

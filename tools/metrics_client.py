@@ -76,6 +76,33 @@ def _parse_counters(text: str):
     return out
 
 
+def render_health_table(doc: dict) -> str:
+    """Per-rank health table from the status document's ``health``
+    block (prof/health.py): smoothed score, raw last fold, trend
+    arrow, state, time in state, and which rank's view won the
+    pessimistic merge."""
+    health = doc.get("health") or {}
+    ranks = health.get("ranks") or {}
+    if not ranks:
+        return "(health plane disarmed or no observations yet)"
+    hdr = (f"{'rank':>5} {'score':>7} {'last':>7} {'tr':>3} "
+           f"{'state':<9} {'for':>7} {'src':>4}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in sorted(ranks, key=lambda x: int(x)):
+        ent = ranks[r] or {}
+        t = float(ent.get("trend", 0.0) or 0.0)
+        arrow = "↑" if t > 0.02 else "↓" if t < -0.02 else "→"
+        lines.append(
+            f"{r:>5} {float(ent.get('ewma', 1.0)):>7.3f} "
+            f"{float(ent.get('score', 1.0)):>7.3f} {arrow:>3} "
+            f"{str(ent.get('state', 'ok'))[:9]:<9} "
+            f"{float(ent.get('since_s', 0.0)):>6.1f}s "
+            f"{ent.get('src', '-'):>4}")
+    lines.append(f"folds={health.get('folds', 0)} "
+                 f"transitions={health.get('transitions', 0)}")
+    return "\n".join(lines)
+
+
 def _status_filtered(doc: dict, job: int | None) -> dict:
     if job is None:
         return doc
@@ -105,6 +132,11 @@ def main(argv=None) -> int:
                     help="print the live job-status document (per-job "
                          "progress, attribution split, stragglers, "
                          "ETA) instead of the Prometheus exposition")
+    ap.add_argument("--health", action="store_true",
+                    help="render the per-rank health table (smoothed "
+                         "score, trend, state, time-in-state) from the "
+                         "status document's health block instead of "
+                         "raw JSON")
     ap.add_argument("--local", action="store_true",
                     help="server rank only (skip the TAG_METRICS "
                          "cross-rank pull)")
@@ -114,14 +146,17 @@ def main(argv=None) -> int:
         from parsec_tpu.utils.mca import params
         port = int(params.get("service_port", 41990))
 
-    if args.status:
+    if args.status or args.health:
         while True:
             doc = _status_filtered(
                 scrape_status(args.host, port,
                               aggregate=not args.local), args.job)
             if args.watch > 0:
                 print(f"--- status @ {time.strftime('%H:%M:%S')} ---")
-            print(json.dumps(doc, indent=2, sort_keys=True))
+            if args.health:
+                print(render_health_table(doc))
+            else:
+                print(json.dumps(doc, indent=2, sort_keys=True))
             if args.watch <= 0:
                 return 0
             try:

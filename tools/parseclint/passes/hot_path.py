@@ -17,6 +17,12 @@ Roots of the reachability analysis:
 * any function or method marked ``# lint: hot-path`` on its ``def``
   line (ReadyQueue callbacks — scheduler ``schedule``/``select``
   methods — and future hot entry points static analysis cannot name).
+  r19 roots the health SCRAPE path this way (``HealthMonitor.refresh``
+  / ``section`` / ``samples`` in prof/health.py): it is not per-task,
+  but the fabric's dispatcher tick and every metrics pull run it, so
+  per-fold lock or allocation creep silently taxes every scrape — the
+  deliberate rate-limited monitor/liveattr locks carry waivers; any
+  NEW acquisition in the fold chain gets flagged.
 
 From the roots the pass follows same-file calls (the PCL-EVLOOP
 resolution: ``self.method`` through same-file bases, plus module-level

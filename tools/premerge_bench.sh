@@ -514,4 +514,18 @@ echo "== premerge probe: chaos soak (random recover schedules) =="
 if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --soak 4; then
     rc=1
 fi
+echo "== premerge probe: chaos degrade (drain-before-death, audited) =="
+# r19: a seeded ramped degradation of rank 1 (frame delay incl.
+# heartbeats + task-body jitter, tools/chaos.py --degrade) on a
+# 2-rank gang.  The health plane (prof/health.py) must score the
+# rank down from its heartbeat gap/jitter inflation, the serving
+# fabric must journal an evidence-carrying pre-emptive drain and
+# stop placing on the rank STRICTLY BEFORE the heartbeat detector
+# declares it dead (comm_peer_timeout_s is never approached), and
+# the journal must pass the auditor clean — including the r19 H1
+# health invariant (drains evidence-backed, drained ranks never
+# placement-targeted).
+if ! JAX_PLATFORMS=cpu python "$repo/tools/chaos.py" --degrade; then
+    rc=1
+fi
 exit $rc
